@@ -1,0 +1,6 @@
+"""Cluster discovery, topology, and multi-process bootstrap.
+
+TPU-native counterpart of the reference's ``tensorflow/python/distribute/
+cluster_resolver/`` package plus ``tensorflow/python/tpu/topology.py`` /
+``device_assignment.py`` (see SURVEY.md §2.4, §2.6).
+"""

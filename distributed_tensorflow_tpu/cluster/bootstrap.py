@@ -1,0 +1,115 @@
+"""Multi-process runtime bootstrap.
+
+TPU-native replacement for the reference's server/context bootstrap
+(reference: tensorflow/python/eager/context.py:1014 ``enable_collective_ops``
+— which starts an in-process grpc server — and context.py:903
+``configure_coordination_service``; SURVEY.md §3.2). On TPU there is no grpc
+data plane to start: bootstrap is exactly ``jax.distributed.initialize``,
+which connects every process to the TSL coordination service (heartbeats, KV
+store, barriers) and exchanges PJRT device topology. Collectives then ride
+ICI/DCN inside compiled XLA programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+import jax
+
+from distributed_tensorflow_tpu.cluster.resolver import (
+    ClusterResolver,
+    TFConfigClusterResolver,
+)
+
+_LOCK = threading.Lock()
+_RUNTIME: "DistributedRuntime | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedRuntime:
+    """Facts about the initialized distributed runtime."""
+
+    coordinator_address: str | None
+    num_processes: int
+    process_id: int
+    initialized_jax_distributed: bool
+
+    @property
+    def is_chief(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize(resolver: ClusterResolver | None = None,
+               coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> DistributedRuntime:
+    """Initialize the multi-process runtime (idempotent).
+
+    Single-process (no cluster found): no-op, returns a local runtime.
+    Multi-process: calls ``jax.distributed.initialize`` with facts from the
+    resolver (default: ``TF_CONFIG`` then TPU-VM env), connecting this
+    process to the coordination service — the TPU-native equivalent of the
+    reference's grpc server + coordination-service startup
+    (collective_all_reduce_strategy.py:507 ``_initialize_multi_worker``).
+    """
+    global _RUNTIME
+    with _LOCK:
+        if _RUNTIME is not None:
+            return _RUNTIME
+
+        if coordinator_address is None and resolver is None:
+            resolver = _default_resolver()
+
+        if resolver is not None:
+            spec = resolver.cluster_spec()
+            if coordinator_address is None:
+                coordinator_address = resolver.master() or None
+            if num_processes is None:
+                num_processes = resolver.num_processes()
+            if process_id is None:
+                process_id = resolver.process_id() if spec else 0
+
+        num_processes = num_processes or 1
+        process_id = process_id or 0
+
+        did_init = False
+        if num_processes > 1 and coordinator_address:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            did_init = True
+
+        _RUNTIME = DistributedRuntime(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialized_jax_distributed=did_init,
+        )
+        return _RUNTIME
+
+
+def _default_resolver() -> ClusterResolver | None:
+    if os.environ.get("TF_CONFIG"):
+        return TFConfigClusterResolver()
+    if os.environ.get("TPU_WORKER_HOSTNAMES"):
+        from distributed_tensorflow_tpu.cluster.resolver import TPUClusterResolver
+        return TPUClusterResolver()
+    return None
+
+
+def runtime() -> DistributedRuntime:
+    """The current runtime, initializing a local one if needed."""
+    return _RUNTIME if _RUNTIME is not None else initialize()
+
+
+def shutdown():
+    """Tear down the coordination-service connection (tests)."""
+    global _RUNTIME
+    with _LOCK:
+        if _RUNTIME is not None and _RUNTIME.initialized_jax_distributed:
+            jax.distributed.shutdown()
+        _RUNTIME = None

@@ -1,0 +1,179 @@
+"""TPU topology, device assignment, and mesh construction.
+
+TPU-native redesign of the reference's topology layer
+(reference: tensorflow/python/tpu/topology.py:41 ``Topology``,
+tensorflow/python/tpu/device_assignment.py:70 ``DeviceAssignment``, per
+SURVEY.md §2.6). Instead of mapping logical replicas onto physical cores by
+hand-building ring orders for the torus, the TPU-native design delegates
+device ordering to ``jax.make_mesh`` (which knows the ICI fabric) and exposes
+the result as a ``jax.sharding.Mesh`` — the single object every parallelism
+axis (dp/fsdp/tp/sp/pp/ep) hangs off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical logical axis names, in priority order. Outer axes are the ones
+# whose collectives tolerate lower bandwidth (DCN), inner axes want ICI.
+DATA_AXIS = "dp"          # data parallel (gradient allreduce)
+FSDP_AXIS = "fsdp"        # fully-sharded data parallel (param all-gather)
+TENSOR_AXIS = "tp"        # tensor/model parallel (activation collectives)
+SEQUENCE_AXIS = "sp"      # sequence/context parallel (ring attention)
+PIPELINE_AXIS = "pp"      # pipeline parallel (ppermute between stages)
+EXPERT_AXIS = "ep"        # expert parallel (all_to_all dispatch)
+
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS, PIPELINE_AXIS,
+            EXPERT_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Physical accelerator topology of the current job.
+
+    Counterpart of ``tf.tpu.experimental.Topology``
+    (reference: tensorflow/python/tpu/topology.py:41): the reference
+    deserializes a TopologyProto returned by the ``ConfigureDistributedTPU``
+    op; here the information comes straight from the PJRT client
+    (``jax.devices()``), which already reflects libtpu's view of the slice.
+    """
+
+    devices: tuple  # all global devices, PJRT enumeration order
+    num_processes: int
+    process_index: int
+    platform: str
+
+    @classmethod
+    def detect(cls, devices: Sequence | None = None) -> "Topology":
+        devices = tuple(devices if devices is not None else jax.devices())
+        return cls(
+            devices=devices,
+            num_processes=jax.process_count(),
+            process_index=jax.process_index(),
+            platform=devices[0].platform if devices else "none",
+        )
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def num_devices_per_process(self) -> int:
+        return max(1, self.num_devices // max(1, self.num_processes))
+
+    def local_devices(self) -> list:
+        return [d for d in self.devices
+                if getattr(d, "process_index", 0) == self.process_index]
+
+    @property
+    def mesh_shape(self) -> tuple:
+        """Physical mesh shape (x, y, z, core) when the backend reports
+        coords; falls back to a flat (num_devices,) shape on CPU/GPU."""
+        coords = [getattr(d, "coords", None) for d in self.devices]
+        if any(c is None for c in coords):
+            return (self.num_devices,)
+        dims = tuple(max(c[i] for c in coords) + 1 for i in range(len(coords[0])))
+        cores = max(getattr(d, "core_on_chip", 0) for d in self.devices) + 1
+        return dims + (cores,)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceAssignment:
+    """Maps logical replicas to physical devices.
+
+    Counterpart of tensorflow/python/tpu/device_assignment.py:70. The
+    reference computes per-replica core rings (``_ring_3d``,
+    device_assignment.py:241) because TF's TPUStrategy launches one program
+    per replica; under single-program SPMD the assignment degenerates to "in
+    which mesh position does each logical replica live", which is what this
+    class records. Kept as an explicit object for API parity and for the
+    coordinator/PS path, which still addresses individual devices.
+    """
+
+    topology: Topology
+    num_replicas: int
+    num_cores_per_replica: int = 1
+
+    @classmethod
+    def build(cls, topology: Topology | None = None,
+              num_replicas: int | None = None,
+              num_cores_per_replica: int = 1) -> "DeviceAssignment":
+        topology = topology or Topology.detect()
+        if num_replicas is None:
+            num_replicas = topology.num_devices // num_cores_per_replica
+        if num_replicas * num_cores_per_replica > topology.num_devices:
+            raise ValueError(
+                f"Requested {num_replicas} replicas x {num_cores_per_replica} "
+                f"cores > {topology.num_devices} devices")
+        return cls(topology, num_replicas, num_cores_per_replica)
+
+    def device(self, replica: int, logical_core: int = 0):
+        idx = replica * self.num_cores_per_replica + logical_core
+        return self.topology.devices[idx]
+
+    def replica_devices(self, replica: int) -> list:
+        base = replica * self.num_cores_per_replica
+        return list(self.topology.devices[base:base + self.num_cores_per_replica])
+
+
+def _normalize_axes(axes, num_devices: int):
+    """Resolve an axis spec into (names, sizes), filling one -1 wildcard."""
+    if isinstance(axes, Mapping):
+        names = tuple(axes.keys())
+        sizes = list(axes.values())
+    else:
+        names, sizes = zip(*axes)
+        sizes = list(sizes)
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError("At most one axis size may be -1")
+    if wild:
+        known = math.prod(s for s in sizes if s != -1)
+        if num_devices % known:
+            raise ValueError(
+                f"{num_devices} devices not divisible by fixed axes {known}")
+        sizes[wild[0]] = num_devices // known
+    if math.prod(sizes) != num_devices:
+        raise ValueError(
+            f"Mesh axes {dict(zip(names, sizes))} need {math.prod(sizes)} "
+            f"devices but {num_devices} are available")
+    return names, tuple(sizes)
+
+
+def make_mesh(axes: Mapping[str, int] | Sequence[tuple] | None = None,
+              *, devices: Sequence | None = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` over the slice.
+
+    ``axes`` maps logical axis name -> size, e.g. ``{"dp": 4, "tp": 2}``;
+    one size may be ``-1`` (inferred). Defaults to pure data parallelism over
+    every device. Axis order is semantic: earlier axes are "outer" (their
+    collectives cross the slower links on multi-host topologies), later axes
+    are "inner" (mapped to the fastest ICI neighbourhoods by
+    ``jax.make_mesh``'s device ordering).
+
+    This replaces the reference's hand-built core rings
+    (tensorflow/python/tpu/device_assignment.py:343) with the mesh-first
+    design XLA GSPMD expects.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devs)}
+    names, sizes = _normalize_axes(axes, len(devs))
+    if devices is None:
+        try:
+            return jax.make_mesh(sizes, names)
+        except (ValueError, RuntimeError):
+            pass  # fall through to explicit reshaping
+    arr = np.asarray(devs, dtype=object).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def mesh_axis_size(mesh: Mesh, *names: str) -> int:
+    """Product of the sizes of ``names`` that exist on ``mesh``."""
+    return math.prod(mesh.shape[n] for n in names if n in mesh.shape)

@@ -1,0 +1,1 @@
+"""Distributed input pipeline (SURVEY.md §2.3 input layer)."""

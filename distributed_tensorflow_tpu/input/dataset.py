@@ -1,0 +1,395 @@
+"""Datasets, auto-sharding, and distributed iteration.
+
+TPU-native counterpart of tensorflow/python/distribute/input_lib.py /
+input_ops.py (SURVEY.md §2.3):
+
+- ``Dataset``            — a small functional dataset (tensor slices / files
+  / generators, map/shuffle/batch/repeat/shard/prefetch) standing in for
+  tf.data on the host; a tf.data.Dataset or any iterable adapts directly.
+- ``AutoShardPolicy``    ≙ input_ops.auto_shard_dataset (input_ops.py:28):
+  FILE shards the file list across input pipelines, DATA takes every Nth
+  element, AUTO prefers FILE when files exist.
+- ``DistributedDataset`` ≙ input_lib.DistributedDataset (input_lib.py:729):
+  per-worker iterators producing either PerReplica batches (TF-parity
+  ``Strategy.run`` path) or globally-sharded ``jax.Array`` batches (native
+  jit path), with background host->device prefetch (≙ infeed,
+  tpu_feed.py) and ``get_next_as_optional`` partial-batch handling
+  (input_lib.py:574).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import itertools
+import math
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AutoShardPolicy(enum.Enum):
+    """≙ tf.data.experimental.AutoShardPolicy (input_ops.py:28)."""
+
+    AUTO = "auto"
+    FILE = "file"
+    DATA = "data"
+    OFF = "off"
+
+
+@dataclasses.dataclass(frozen=True)
+class InputOptions:
+    """≙ tf.distribute.InputOptions (distribute_lib.py:1015)."""
+
+    experimental_fetch_to_device: bool = True
+    experimental_per_replica_buffer_size: int = 2
+    experimental_replication_mode: str = "per_worker"
+    auto_shard_policy: AutoShardPolicy = AutoShardPolicy.AUTO
+
+
+class InputContext:
+    """≙ tf.distribute.InputContext (distribute_lib.py:841)."""
+
+    def __init__(self, num_input_pipelines: int = 1,
+                 input_pipeline_id: int = 0,
+                 num_replicas_in_sync: int = 1):
+        self.num_input_pipelines = num_input_pipelines
+        self.input_pipeline_id = input_pipeline_id
+        self.num_replicas_in_sync = num_replicas_in_sync
+
+    def get_per_replica_batch_size(self, global_batch_size: int) -> int:
+        if global_batch_size % self.num_replicas_in_sync:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.num_replicas_in_sync} replicas")
+        return global_batch_size // self.num_replicas_in_sync
+
+
+# ---------------------------------------------------------------------------
+# Host dataset
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    """A minimal functional host dataset.
+
+    Sources: ``from_tensor_slices``, ``from_files``, ``from_generator``,
+    ``range``. Transforms are lazy and compose: map, filter, shuffle, batch,
+    repeat, take, skip, shard, prefetch. Iteration yields numpy pytrees.
+    """
+
+    def __init__(self, gen_fn: Callable[[], Iterator], *,
+                 files: Sequence[str] | None = None,
+                 element_count: int | None = None):
+        self._gen_fn = gen_fn
+        self._files = list(files) if files else None
+        self._element_count = element_count
+
+    # -- sources ----------------------------------------------------------
+    @classmethod
+    def from_tensor_slices(cls, tensors) -> "Dataset":
+        leaves = jax.tree_util.tree_leaves(tensors)
+        n = len(np.asarray(leaves[0]))
+
+        def gen():
+            arrs = jax.tree_util.tree_map(np.asarray, tensors)
+            for i in range(n):
+                yield jax.tree_util.tree_map(lambda a: a[i], arrs)
+
+        return cls(gen, element_count=n)
+
+    @classmethod
+    def from_generator(cls, gen_fn: Callable[[], Iterator]) -> "Dataset":
+        return cls(gen_fn)
+
+    @classmethod
+    def from_iterable(cls, it: Iterable) -> "Dataset":
+        if isinstance(it, Dataset):
+            return it
+        # tf.data adapter: duck-typed on as_numpy_iterator
+        if hasattr(it, "as_numpy_iterator"):
+            return cls(lambda: iter(it.as_numpy_iterator()))
+        if callable(it):
+            return cls(it)
+        materialized = list(it)
+        return cls(lambda: iter(materialized),
+                   element_count=len(materialized))
+
+    @classmethod
+    def from_files(cls, files: Sequence[str],
+                   reader: Callable[[str], Iterator]) -> "Dataset":
+        """File-based source; keeps the file list visible so AutoShardPolicy
+        FILE can shard it (≙ input_ops.py FILE policy)."""
+        files = list(files)
+
+        def gen():
+            for f in files:
+                yield from reader(f)
+
+        ds = cls(gen, files=files)
+        ds._reader = reader
+        return ds
+
+    @classmethod
+    def range(cls, *args) -> "Dataset":
+        r = range(*args)
+        return cls(lambda: iter(r), element_count=len(r))
+
+    # -- transforms -------------------------------------------------------
+    def _derive(self, gen_fn, element_count=None) -> "Dataset":
+        ds = Dataset(gen_fn, files=self._files, element_count=element_count)
+        if hasattr(self, "_reader"):
+            ds._reader = self._reader
+        return ds
+
+    def map(self, fn: Callable) -> "Dataset":
+        src = self._gen_fn
+        return self._derive(lambda: (fn(x) for x in src()),
+                            self._element_count)
+
+    def filter(self, pred: Callable) -> "Dataset":
+        src = self._gen_fn
+        return self._derive(lambda: (x for x in src() if pred(x)))
+
+    def shuffle(self, buffer_size: int, seed: int | None = None) -> "Dataset":
+        src = self._gen_fn
+
+        def gen():
+            rng = np.random.default_rng(seed)
+            buf = []
+            for x in src():
+                buf.append(x)
+                if len(buf) >= buffer_size:
+                    i = rng.integers(len(buf))
+                    buf[i], buf[-1] = buf[-1], buf[i]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+
+        return self._derive(gen, self._element_count)
+
+    def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
+        src = self._gen_fn
+
+        def gen():
+            it = src()
+            while True:
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < batch_size and drop_remainder:
+                    return
+                yield jax.tree_util.tree_map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]), *chunk)
+
+        count = None
+        if self._element_count is not None:
+            count = (self._element_count // batch_size if drop_remainder
+                     else -(-self._element_count // batch_size))
+        return self._derive(gen, count)
+
+    def repeat(self, count: int | None = None) -> "Dataset":
+        src = self._gen_fn
+
+        def gen():
+            n = 0
+            while count is None or n < count:
+                yield from src()
+                n += 1
+
+        return self._derive(
+            gen, None if count is None or self._element_count is None
+            else self._element_count * count)
+
+    def take(self, n: int) -> "Dataset":
+        src = self._gen_fn
+        return self._derive(lambda: itertools.islice(src(), n))
+
+    def skip(self, n: int) -> "Dataset":
+        src = self._gen_fn
+        return self._derive(lambda: itertools.islice(src(), n, None))
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """DATA-policy sharding: every ``num_shards``-th element
+        (≙ tf.data Dataset.shard used by auto_shard_dataset)."""
+        src = self._gen_fn
+        return self._derive(
+            lambda: itertools.islice(src(), index, None, num_shards))
+
+    def shard_files(self, num_shards: int, index: int) -> "Dataset":
+        """FILE-policy sharding (≙ input_ops.py:28 FILE branch)."""
+        if not self._files:
+            raise ValueError("Dataset has no file list; use DATA sharding")
+        files = self._files[index::num_shards]
+        reader = self._reader
+
+        def gen():
+            for f in files:
+                yield from reader(f)
+
+        ds = Dataset(gen, files=files)
+        ds._reader = reader
+        return ds
+
+    def prefetch(self, buffer_size: int = 2) -> "Dataset":
+        src = self._gen_fn
+
+        def gen():
+            yield from _BackgroundIterator(src(), buffer_size)
+
+        return self._derive(gen, self._element_count)
+
+    def cardinality(self) -> int | None:
+        return self._element_count
+
+    def __iter__(self) -> Iterator:
+        return self._gen_fn()
+
+
+class _BackgroundIterator:
+    """Background-thread prefetch with a bounded queue."""
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterator, buffer_size: int):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, buffer_size))
+        self._err: BaseException | None = None
+
+        def worker():
+            try:
+                for x in it:
+                    self._q.put(x)
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                self._q.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        x = self._q.get()
+        if x is self._SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Auto-sharding (≙ input_ops.auto_shard_dataset, input_ops.py:28)
+# ---------------------------------------------------------------------------
+
+def auto_shard_dataset(dataset: Dataset, num_shards: int, index: int,
+                       policy: AutoShardPolicy = AutoShardPolicy.AUTO
+                       ) -> Dataset:
+    if num_shards <= 1 or policy is AutoShardPolicy.OFF:
+        return dataset
+    if policy is AutoShardPolicy.FILE:
+        return dataset.shard_files(num_shards, index)
+    if policy is AutoShardPolicy.DATA:
+        return dataset.shard(num_shards, index)
+    # AUTO: FILE when a file list exists and has enough files, else DATA.
+    if dataset._files and len(dataset._files) >= num_shards:
+        return dataset.shard_files(num_shards, index)
+    return dataset.shard(num_shards, index)
+
+
+# ---------------------------------------------------------------------------
+# Distributed dataset
+# ---------------------------------------------------------------------------
+
+class DistributedDataset:
+    """Per-worker view of a dataset, batches placed on the mesh.
+
+    ≙ input_lib.DistributedDataset (input_lib.py:729). The incoming dataset
+    yields *per-worker global* batches (leading dim = per-worker batch).
+    Iteration yields batches as sharded ``jax.Array`` pytrees — the leading
+    axis sharded over the strategy's data axes (native path). Under
+    ``Strategy.run`` these shard correctly with no extra copies; for TF-style
+    per-replica access, ``iter_per_replica`` yields ``PerReplica`` values.
+    """
+
+    def __init__(self, dataset, strategy, options: InputOptions | None = None):
+        self._options = options or InputOptions()
+        ds = Dataset.from_iterable(dataset)
+        n_pipelines = jax.process_count()
+        if n_pipelines > 1:
+            ds = auto_shard_dataset(ds, n_pipelines, jax.process_index(),
+                                    self._options.auto_shard_policy)
+        self._dataset = ds
+        self._strategy = strategy
+
+    @property
+    def element_spec(self):
+        first = next(iter(self._dataset), None)
+        if first is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            first)
+
+    def __iter__(self) -> "DistributedIterator":
+        return DistributedIterator(self._dataset, self._strategy,
+                                   self._options)
+
+    def iter_per_replica(self) -> Iterator:
+        """TF-parity iteration: PerReplica values for Strategy.run."""
+        from distributed_tensorflow_tpu.parallel.values import PerReplica
+        R = self._strategy.num_replicas_in_sync
+        for batch in self._dataset:
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            n = np.shape(leaves[0])[0] if leaves else 0
+            if n % R:
+                raise ValueError(
+                    f"Per-worker batch size {n} is not divisible by "
+                    f"{R} replicas; use drop_remainder=True or a divisible "
+                    f"batch size")
+            split = [np.split(np.asarray(l), R, axis=0) for l in leaves]
+            yield jax.tree_util.tree_unflatten(
+                treedef, [PerReplica(s) for s in split])
+
+
+class DistributedIterator:
+    """≙ input_lib.DistributedIterator (input_lib.py:574), with background
+    host->device prefetch standing in for infeed (tpu_feed.py)."""
+
+    def __init__(self, dataset: Dataset, strategy,
+                 options: InputOptions):
+        self._strategy = strategy
+        self._fetch = options.experimental_fetch_to_device
+        src = iter(dataset)
+        if self._fetch:
+            buffered = _BackgroundIterator(
+                map(self._place, src),
+                options.experimental_per_replica_buffer_size)
+            self._it = iter(buffered)
+        else:
+            self._it = src
+
+    def _place(self, batch):
+        return self._strategy.shard_batch(batch)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def get_next(self):
+        return next(self._it)
+
+    def get_next_as_optional(self):
+        """≙ get_next_as_optional (input_lib partial-batch handling):
+        returns None at end instead of raising."""
+        try:
+            return next(self._it)
+        except StopIteration:
+            return None

@@ -1,0 +1,19 @@
+"""Model zoo matching the reference's benchmark workloads (BASELINE.md):
+
+1. MNIST CNN          — ``mnist_cnn``
+2. ResNet-50          — ``resnet``
+3. BERT               — ``bert``
+4. Wide&Deep / DLRM   — ``wide_deep``
+5. Transformer (WMT)  — ``transformer``
+"""
+
+import importlib
+
+__all__ = ["mnist_cnn", "resnet", "bert", "wide_deep", "transformer"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(
+            f"distributed_tensorflow_tpu.models.{name}")
+    raise AttributeError(name)

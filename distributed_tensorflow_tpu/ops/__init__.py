@@ -1,0 +1,3 @@
+"""Compute ops: pallas kernels, attention, embeddings, optim utilities."""
+
+from distributed_tensorflow_tpu.parallel import collectives as collective_ops  # re-export

@@ -1,0 +1,5 @@
+"""Parallelism: strategies, collectives, distributed values, SP/TP/PP.
+
+TPU-native counterpart of the reference's ``tensorflow/python/distribute/``
+package (SURVEY.md §2.1–§2.3, §2.8).
+"""

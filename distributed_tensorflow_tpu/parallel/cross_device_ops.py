@@ -1,0 +1,271 @@
+"""Cross-device reduction algorithms (host-level API).
+
+TPU-native counterpart of tensorflow/python/distribute/cross_device_ops.py
+(SURVEY.md §2.2). The reference builds reduction *graphs* (NCCL op chains,
+hierarchical copy trees, collective-V2 launches with instance keys); here
+each implementation compiles ONE tiny XLA program over the mesh and lets the
+compiler schedule ICI traffic:
+
+- ``ReductionToOneDevice``   ≙ cross_device_ops.py:582 — gather-to-one then
+  broadcast; the fallback path.
+- ``IciAllReduce``           ≙ ``NcclAllReduce`` (cross_device_ops.py:960):
+  batched allreduce with gradient packing (pack-by-size semantics of
+  cross_device_utils.py:436-449 / group_by_size :679).
+- ``HierarchicalAllReduce``  ≙ ``HierarchicalCopyAllReduce``
+  (cross_device_ops.py:997): two-level reduce — fast axis (ICI) scatter,
+  slow axis (DCN) reduce, fast axis gather.
+- ``select_cross_device_ops`` ≙ cross_device_ops.py:1355.
+
+This layer exists for eager/host-driven use (the coordinator/PS path, tests,
+metric aggregation). The training hot path never calls it — gradient
+reductions happen inside the jitted SPMD step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import collectives
+from distributed_tensorflow_tpu.parallel.collectives import (
+    CommunicationOptions,
+    ReduceOp,
+)
+from distributed_tensorflow_tpu.parallel.values import (
+    DistributedValues,
+    Mirrored,
+    PerReplica,
+)
+
+
+def _as_per_replica_list(value, num_replicas: int) -> list:
+    if isinstance(value, DistributedValues):
+        return list(value.values)
+    return [value] * num_replicas
+
+
+class CrossDeviceOps:
+    """Abstract reduction API (≙ cross_device_ops.py:252 ``CrossDeviceOps``).
+
+    ``reduce``/``batch_reduce`` consume ``PerReplica`` values (one leaf per
+    replica) and return ``Mirrored`` results.
+    """
+
+    def __init__(self, mesh: Mesh, axis_names: Sequence[str] = ("dp",),
+                 options: CommunicationOptions | None = None):
+        self.mesh = mesh
+        self.axis_names = tuple(a for a in axis_names if a in mesh.shape)
+        if not self.axis_names:
+            raise ValueError(f"No reduction axes among {axis_names} on mesh "
+                             f"{tuple(mesh.shape)}")
+        self.options = options or CommunicationOptions()
+
+    @property
+    def num_replicas(self) -> int:
+        import math
+        return math.prod(self.mesh.shape[a] for a in self.axis_names)
+
+    # -- public API -------------------------------------------------------
+    def reduce(self, reduce_op, per_replica_value, options=None) -> Mirrored:
+        op = ReduceOp.from_any(reduce_op)
+        vals = _as_per_replica_list(per_replica_value, self.num_replicas)
+        out = self._reduce_list([vals], op, self.options.merge(options))[0]
+        return Mirrored([out] * self.num_replicas)
+
+    def batch_reduce(self, reduce_op, value_list, options=None) -> list:
+        """≙ batch_reduce_implementation: reduce many tensors in one launch
+        (the gradient-sync shape)."""
+        op = ReduceOp.from_any(reduce_op)
+        lists = [_as_per_replica_list(v, self.num_replicas) for v in value_list]
+        outs = self._reduce_list(lists, op, self.options.merge(options))
+        return [Mirrored([o] * self.num_replicas) for o in outs]
+
+    def broadcast(self, value, source_replica: int = 0) -> Mirrored:
+        vals = _as_per_replica_list(value, self.num_replicas)
+        return Mirrored([vals[source_replica]] * self.num_replicas)
+
+    def gather(self, per_replica_value, axis: int = 0) -> jax.Array:
+        """≙ _gather_implementation / _batch_all_gather
+        (cross_device_ops.py:1306)."""
+        vals = _as_per_replica_list(per_replica_value, self.num_replicas)
+        return jnp.concatenate([jnp.asarray(v) for v in vals], axis=axis)
+
+    # -- implementation ---------------------------------------------------
+    def _reduce_list(self, lists: list[list], op: ReduceOp,
+                     options: CommunicationOptions) -> list:
+        raise NotImplementedError
+
+
+class ReductionToOneDevice(CrossDeviceOps):
+    """Sum on one device, then broadcast (≙ cross_device_ops.py:582)."""
+
+    def _reduce_list(self, lists, op, options):
+        outs = []
+        for vals in lists:
+            stacked = jnp.stack([jnp.asarray(v) for v in vals])
+            if op is ReduceOp.SUM:
+                outs.append(jnp.sum(stacked, axis=0))
+            elif op is ReduceOp.MEAN:
+                outs.append(jnp.mean(stacked, axis=0))
+            elif op is ReduceOp.MAX:
+                outs.append(jnp.max(stacked, axis=0))
+            elif op is ReduceOp.MIN:
+                outs.append(jnp.min(stacked, axis=0))
+            else:
+                raise ValueError(f"Unsupported op {op}")
+        return outs
+
+
+class IciAllReduce(CrossDeviceOps):
+    """Batched allreduce over ICI (≙ NcclAllReduce, cross_device_ops.py:960).
+
+    Packing: tensors are flattened and concatenated into buckets of
+    ``options.bytes_per_pack`` (0 = one bucket), reduced as single launches,
+    then split back — same wire behavior as the reference's
+    aggregate-with-concat path (_do_batch_all_reduce,
+    cross_device_ops.py:898) without the Python graph surgery.
+    """
+
+    def _reduce_list(self, lists, op, options):
+        if op not in (ReduceOp.SUM, ReduceOp.MEAN):
+            return ReductionToOneDevice._reduce_list(self, lists, op, options)
+        n = len(lists)
+        shapes = [np.shape(vals[0]) for vals in lists]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        dtypes = [jnp.asarray(vals[0]).dtype for vals in lists]
+
+        outs: list = [None] * n
+        # Tensors keep their own dtype: pack per dtype group, then per
+        # size bucket; each bucket is one collective launch.
+        for dt in dict.fromkeys(dtypes):  # stable unique order
+            idxs = [i for i in range(n) if dtypes[i] == dt]
+            buckets = self._pack_buckets(
+                [sizes[i] for i in idxs], options.bytes_per_pack,
+                jnp.dtype(dt).itemsize)
+            for bucket in buckets:
+                members = [idxs[j] for j in bucket]
+                flat_per_replica = [
+                    jnp.concatenate([jnp.ravel(jnp.asarray(lists[i][r]))
+                                     for i in members])
+                    for r in range(self.num_replicas)]
+                stacked = jnp.stack(flat_per_replica)  # (R, bucket_total)
+                integer_mean = (op is ReduceOp.MEAN
+                                and not jnp.issubdtype(dt, jnp.inexact))
+                if integer_mean:
+                    stacked = stacked.astype(jnp.float32)
+                reduced = self._compiled_allreduce(op)(stacked)
+                if integer_mean:
+                    reduced = reduced.astype(dt)
+                off = 0
+                for i in members:
+                    outs[i] = jnp.reshape(reduced[off: off + sizes[i]],
+                                          shapes[i])
+                    off += sizes[i]
+        return outs
+
+    @staticmethod
+    def _pack_buckets(sizes, bytes_per_pack, itemsize):
+        """≙ cross_device_utils.group_by_size (cross_device_utils.py:679)."""
+        if not bytes_per_pack:
+            return [list(range(len(sizes)))]
+        buckets, cur, cur_bytes = [], [], 0
+        for i, s in enumerate(sizes):
+            cur.append(i)
+            cur_bytes += s * itemsize
+            if cur_bytes >= bytes_per_pack:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    def _compiled_allreduce(self, op: ReduceOp):
+        # cached per-instance (an lru_cache on the method would pin self,
+        # the mesh, and compiled executables in a class-level cache forever)
+        cache = self.__dict__.setdefault("_fn_cache", {})
+        if op in cache:
+            return cache[op]
+        axes = self.axis_names
+        n_total = self.num_replicas
+
+        def f(x):  # x: (R/|axes|, n) local shard of the replica-stacked buf
+            out = collectives.all_reduce(jnp.sum(x, axis=0), axes,
+                                         ReduceOp.SUM)
+            if op is ReduceOp.MEAN:
+                out = out / n_total
+            return out
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=self.mesh, in_specs=P(axes), out_specs=P(),
+            check_vma=False))
+        cache[op] = fn
+        return fn
+
+
+# Alias kept for config compatibility with the reference's class name.
+NcclAllReduce = IciAllReduce
+
+
+class HierarchicalAllReduce(CrossDeviceOps):
+    """Two-level reduce (≙ HierarchicalCopyAllReduce, cross_device_ops.py:997).
+
+    Requires a 2-axis reduction: ``axis_names = (outer, inner)`` where inner
+    is the fast fabric (ICI within a slice) and outer the slow one (DCN
+    across slices). Uses reduce-scatter(inner) -> allreduce(outer) ->
+    all-gather(inner) so each slow hop carries 1/|inner| of the bytes.
+    """
+
+    def __init__(self, mesh, axis_names=("dcn", "dp"), options=None):
+        super().__init__(mesh, axis_names, options)
+        if len(self.axis_names) != 2:
+            raise ValueError("HierarchicalAllReduce needs exactly 2 axes "
+                             "(outer/slow, inner/fast)")
+
+    def _reduce_list(self, lists, op, options):
+        outer, inner = self.axis_names
+        outs = []
+        fn = self._compiled(op)
+        for vals in lists:
+            stacked = jnp.stack([jnp.asarray(v) for v in vals])
+            outs.append(fn(stacked))
+        return outs
+
+    def _compiled(self, op: ReduceOp):
+        cache = self.__dict__.setdefault("_fn_cache", {})
+        if op in cache:
+            return cache[op]
+        outer, inner = self.axis_names
+        n_total = self.num_replicas
+
+        def f(x):  # x: (R_local, ...) local shard of the replica-stacked buf
+            local = jnp.sum(x, axis=0)
+            out = collectives.hierarchical_all_reduce(
+                local, inner_axis=inner, outer_axis=outer, op=ReduceOp.SUM)
+            if op is ReduceOp.MEAN:
+                out = out / n_total
+            return out
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=self.mesh, in_specs=P((outer, inner)), out_specs=P(),
+            check_vma=False))
+        cache[op] = fn
+        return fn
+
+
+def select_cross_device_ops(mesh: Mesh, axis_names: Sequence[str] = ("dp",),
+                            options: CommunicationOptions | None = None
+                            ) -> CrossDeviceOps:
+    """≙ cross_device_ops.select_cross_device_ops (cross_device_ops.py:1355):
+    the reference picks NcclAllReduce iff the NCCL kernel is registered;
+    here ICI allreduce is always available, and a 2-axis request selects the
+    hierarchical form."""
+    names = tuple(a for a in axis_names if a in mesh.shape)
+    if len(names) == 2 and all(mesh.shape[a] > 1 for a in names):
+        return HierarchicalAllReduce(mesh, names, options)
+    if sum(mesh.shape[a] for a in names) <= len(names):  # all axes size 1
+        return ReductionToOneDevice(mesh, names, options)
+    return IciAllReduce(mesh, names, options)

@@ -1,0 +1,47 @@
+"""MirroredStrategy — synchronous data parallelism on local devices.
+
+≙ tensorflow/python/distribute/mirrored_strategy.py:200 (SURVEY.md §2.1).
+
+The reference replicates the graph and runs one Python thread per device
+with a merge_call rendezvous (mirrored_run.py:289). Here the strategy is a
+thin configuration over the shared SPMD core: a 1-axis mesh over the local
+devices, variables replicated (mirrored = replicated NamedSharding), and
+``run`` compiling a single program whose gradient sync is an ICI psum.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from distributed_tensorflow_tpu.cluster import topology as topo_lib
+from distributed_tensorflow_tpu.parallel.collectives import CommunicationOptions
+from distributed_tensorflow_tpu.parallel.cross_device_ops import CrossDeviceOps
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+
+
+class MirroredStrategy(Strategy):
+    """Sync data-parallel over the given (default: all local) devices."""
+
+    def __init__(self, devices: Sequence | None = None,
+                 cross_device_ops: CrossDeviceOps | None = None,
+                 communication_options: CommunicationOptions | None = None):
+        if devices is None:
+            devices = jax.local_devices()
+        devices = [self._resolve(d) for d in devices]
+        mesh = topo_lib.make_mesh({topo_lib.DATA_AXIS: len(devices)},
+                                  devices=devices)
+        super().__init__(mesh=mesh, data_axis_names=(topo_lib.DATA_AXIS,),
+                         cross_device_ops=cross_device_ops,
+                         communication_options=communication_options)
+
+    @staticmethod
+    def _resolve(d):
+        if not isinstance(d, str):
+            return d
+        kind, _, idx = d.lower().rpartition(":")
+        idx = int(idx) if idx.isdigit() else 0
+        kind = kind.strip("/").replace("device:", "")
+        devs = jax.devices(kind) if kind else jax.devices()
+        return devs[idx]

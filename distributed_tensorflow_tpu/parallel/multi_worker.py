@@ -1,0 +1,77 @@
+"""MultiWorkerMirroredStrategy — synchronous DP across hosts.
+
+≙ tensorflow/python/distribute/collective_all_reduce_strategy.py:57
+``CollectiveAllReduceStrategy`` (SURVEY.md §2.1, §3.2).
+
+What the reference's ``_initialize_multi_worker`` (:507) does — parse
+TF_CONFIG, start an in-process grpc server, configure the coordination
+service, build a CollectiveAllReduce over group_size = hosts x local devices
+— maps here to: resolve cluster, ``jax.distributed.initialize`` (coordination
+service over DCN), and build ONE global mesh whose data axis spans every
+chip in the slice. Gradient allreduce is an XLA collective on ICI; no grpc
+data plane exists to configure.
+
+Health checking (≙ ``_check_health`` thread, :990): the TSL coordination
+service heartbeats every process; a missing peer fails the job fast, the
+same observable behavior as the reference's abort-collectives poisoning
+(context.py:1090) with none of the machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from distributed_tensorflow_tpu.cluster import bootstrap, topology as topo_lib
+from distributed_tensorflow_tpu.cluster.resolver import ClusterResolver
+from distributed_tensorflow_tpu.parallel.collectives import (
+    CommunicationImplementation,
+    CommunicationOptions,
+)
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+
+
+class CollectiveAllReduceStrategy(Strategy):
+    """Multi-worker sync data parallelism over the global device set."""
+
+    def __init__(self, cluster_resolver: ClusterResolver | None = None,
+                 communication_options: CommunicationOptions | None = None,
+                 mesh=None):
+        # ≙ _initialize_multi_worker: connect control plane first.
+        self._runtime = bootstrap.initialize(cluster_resolver)
+        self._cluster_resolver = cluster_resolver
+        if mesh is None:
+            mesh = topo_lib.make_mesh(
+                {topo_lib.DATA_AXIS: len(jax.devices())})
+        super().__init__(mesh=mesh, data_axis_names=(topo_lib.DATA_AXIS,),
+                         communication_options=communication_options)
+
+    @property
+    def cluster_resolver(self) -> ClusterResolver | None:
+        return self._cluster_resolver
+
+    @property
+    def task_type(self) -> str | None:
+        return getattr(self._cluster_resolver, "task_type", None)
+
+    @property
+    def task_id(self) -> int | None:
+        return getattr(self._cluster_resolver, "task_id", None)
+
+    def check_health(self) -> bool:
+        """≙ context.check_collective_ops_peer_health (context.py:1105).
+        Under the coordination service, liveness is continuously enforced;
+        an explicit check runs a tiny global barrier collective."""
+        try:
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("dtx_health_check")
+            return True
+        except Exception:
+            return False
+
+
+# The user-facing alias, matching tf.distribute.MultiWorkerMirroredStrategy.
+class MultiWorkerMirroredStrategy(CollectiveAllReduceStrategy):
+    pass

@@ -1,0 +1,31 @@
+"""OneDeviceStrategy — trivial single-device strategy for API conformance.
+
+≙ tensorflow/python/distribute/one_device_strategy.py (SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh
+
+import jax
+
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+
+
+class OneDeviceStrategy(Strategy):
+    """All variables and computation on one device (≙ one_device_strategy.py:~40)."""
+
+    def __init__(self, device=None):
+        if device is None:
+            device = jax.devices()[0]
+        elif isinstance(device, str):
+            # accept "tpu:0"-style strings for parity with "/gpu:0"
+            kind, _, idx = device.lower().rpartition(":")
+            idx = int(idx) if idx.isdigit() else 0
+            kind = kind.strip("/").replace("device:", "") or None
+            devs = jax.devices(kind) if kind not in (None, "") else jax.devices()
+            device = devs[idx]
+        mesh = Mesh(np.array([device], dtype=object), ("dp",))
+        super().__init__(mesh=mesh, data_axis_names=("dp",))
+        self.device = device

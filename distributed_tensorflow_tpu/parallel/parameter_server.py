@@ -1,0 +1,88 @@
+"""ParameterServerStrategy — asynchronous training with sharded variables.
+
+≙ tensorflow/python/distribute/parameter_server_strategy_v2.py:77
+``ParameterServerStrategyV2`` (SURVEY.md §2.1, §3.3).
+
+TPU-native redesign: the reference places variable shards round-robin on
+dedicated PS *processes* (parameter_server_strategy_v2.py:872) and workers
+pull them over grpc eager contexts. On TPU the bandwidth hierarchy inverts —
+HBM + ICI are far faster than any host — so "parameter serving" becomes
+axis-0 sharding of large variables across the mesh (``ShardedVariable``,
+XLA partitions lookups), while the *asynchrony* (the actual point of PS
+training) lives in the host-side ``ClusterCoordinator``
+(coordinator/cluster_coordinator.py in this package): a closure queue
+dispatching steps to workers without a global barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from distributed_tensorflow_tpu.cluster import topology as topo_lib
+from distributed_tensorflow_tpu.cluster.resolver import ClusterResolver
+from distributed_tensorflow_tpu.parallel.sharded_variable import (
+    FixedShardsPartitioner,
+    Partitioner,
+    ShardedVariable,
+)
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+from distributed_tensorflow_tpu.parallel.values import (
+    MirroredVariable,
+    VariableAggregation,
+    VariableSynchronization,
+)
+
+
+class ParameterServerStrategy(Strategy):
+    """Async PS training: sharded variables + coordinator-driven dispatch.
+
+    ``variable_partitioner`` decides which variables get axis-0 sharding
+    (≙ parameter_server_strategy_v2.py:689 ``_create_variable``: variables
+    matching the partitioner become ShardedVariable; small ones stay
+    replicated).
+    """
+
+    SHARD_AXIS = "ps_shard"
+
+    def __init__(self, cluster_resolver: ClusterResolver | None = None,
+                 variable_partitioner: Partitioner | None = None,
+                 mesh: Mesh | None = None):
+        self._cluster_resolver = cluster_resolver
+        if mesh is None:
+            n = len(jax.devices())
+            mesh = topo_lib.make_mesh(
+                [(topo_lib.DATA_AXIS, 1), (self.SHARD_AXIS, n)])
+        self.variable_partitioner = (variable_partitioner
+                                     or FixedShardsPartitioner(1))
+        super().__init__(mesh=mesh, data_axis_names=(topo_lib.DATA_AXIS,))
+
+    @property
+    def cluster_resolver(self) -> ClusterResolver | None:
+        return self._cluster_resolver
+
+    def create_variable(self, value, *, name=None, trainable=True,
+                        synchronization=VariableSynchronization.AUTO,
+                        aggregation=VariableAggregation.NONE, dtype=None):
+        """Shard large variables on axis 0, mirror the rest
+        (≙ _create_variable, parameter_server_strategy_v2.py:689)."""
+        import jax.numpy as jnp
+        arr = jnp.asarray(value, dtype=dtype)
+        parts = self.variable_partitioner(arr.shape, arr.dtype) \
+            if arr.ndim >= 1 else [1]
+        if parts and parts[0] > 1:
+            var = ShardedVariable(
+                arr, mesh=self.mesh, shard_axis_name=self.SHARD_AXIS,
+                num_shards=parts[0], name=name, trainable=trainable)
+            self._variables.append(var)
+            return var
+        return super().create_variable(
+            value, name=name, trainable=trainable,
+            synchronization=synchronization, aggregation=aggregation,
+            dtype=dtype)
+
+
+# Alias for the V2 name used in reference scripts.
+ParameterServerStrategyV2 = ParameterServerStrategy

@@ -1,0 +1,165 @@
+"""ShardedVariable and partitioners.
+
+TPU-native counterpart of tensorflow/python/distribute/sharded_variable.py
+(SURVEY.md §2.3): first-axis div-sharding of large (embedding) variables.
+The reference materializes N separate ``tf.Variable`` shards placed
+round-robin on parameter servers (parameter_server_strategy_v2.py:872); here
+a ShardedVariable is ONE ``jax.Array`` sharded on axis 0 across a mesh axis
+— XLA partitions the lookup/apply, and per-shard views are still addressable
+for the PS/coordinator path and for sharded checkpointing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.values import (
+    DistributedVariable,
+    VariableAggregation,
+    VariableSynchronization,
+)
+
+
+class Partitioner:
+    """Base partitioner (≙ sharded_variable.py:47 ``Partitioner``).
+
+    Callable: ``partitioner(shape, dtype) -> list[int]`` with one entry per
+    axis; exactly one axis may have >1 partitions (axis-0 div sharding, the
+    reference's supported form).
+    """
+
+    def __call__(self, shape, dtype) -> list[int]:
+        raise NotImplementedError
+
+    @staticmethod
+    def _dtype_size(dtype) -> int:
+        return jnp.dtype(dtype).itemsize
+
+
+class FixedShardsPartitioner(Partitioner):
+    """≙ sharded_variable.py:84."""
+
+    def __init__(self, num_shards: int):
+        self.num_shards = num_shards
+
+    def __call__(self, shape, dtype) -> list[int]:
+        result = [1] * len(shape)
+        result[0] = min(self.num_shards, shape[0])
+        return result
+
+
+class MinSizePartitioner(Partitioner):
+    """≙ sharded_variable.py:115: as many shards as possible while keeping
+    each shard at least ``min_shard_bytes``."""
+
+    def __init__(self, min_shard_bytes: int = 256 << 10, max_shards: int = 1):
+        if min_shard_bytes < 1:
+            raise ValueError("min_shard_bytes must be positive")
+        self.min_shard_bytes = min_shard_bytes
+        self.max_shards = max_shards
+
+    def __call__(self, shape, dtype) -> list[int]:
+        total = math.prod(shape) * self._dtype_size(dtype)
+        shards = min(self.max_shards, max(1, total // self.min_shard_bytes),
+                     shape[0] if shape else 1)
+        result = [1] * len(shape)
+        result[0] = max(1, int(shards))
+        return result
+
+
+class MaxSizePartitioner(Partitioner):
+    """≙ sharded_variable.py:176: as few shards as possible while keeping
+    each shard at most ``max_shard_bytes``."""
+
+    def __init__(self, max_shard_bytes: int, max_shards: int | None = None):
+        if max_shard_bytes < 1:
+            raise ValueError("max_shard_bytes must be positive")
+        self.max_shard_bytes = max_shard_bytes
+        self.max_shards = max_shards
+
+    def __call__(self, shape, dtype) -> list[int]:
+        total = math.prod(shape) * self._dtype_size(dtype)
+        shards = max(1, -(-total // self.max_shard_bytes))  # ceil div
+        if self.max_shards is not None:
+            shards = min(shards, self.max_shards)
+        shards = min(shards, shape[0] if shape else 1)
+        result = [1] * len(shape)
+        result[0] = int(shards)
+        return result
+
+
+class ShardedVariable(DistributedVariable):
+    """Axis-0 sharded variable (≙ sharded_variable.py:843).
+
+    ``shard_axis_name`` picks the mesh axis the rows are divided over. The
+    number of *logical* shards (``num_shards``, from the partitioner) is
+    recorded for checkpoint layout parity, but physically XLA divides rows
+    evenly over the mesh axis.
+    """
+
+    def __init__(self, value, *, mesh: Mesh, shard_axis_name: str,
+                 num_shards: int | None = None, name=None,
+                 trainable: bool = True, dtype=None):
+        if shard_axis_name not in mesh.shape:
+            raise ValueError(
+                f"axis {shard_axis_name!r} not in mesh {tuple(mesh.shape)}")
+        self.shard_axis_name = shard_axis_name
+        self.num_shards = num_shards or mesh.shape[shard_axis_name]
+        value = jnp.asarray(value, dtype=dtype)
+        if value.ndim < 1:
+            raise ValueError("ShardedVariable requires rank >= 1")
+        self._pad_rows = (-value.shape[0]) % mesh.shape[shard_axis_name]
+        self._num_rows = value.shape[0]
+        if self._pad_rows:
+            value = jnp.pad(value,
+                            [(0, self._pad_rows)] + [(0, 0)] * (value.ndim - 1))
+        spec = P(shard_axis_name)
+        super().__init__(
+            value, name=name, mesh=mesh, spec=spec, trainable=trainable,
+            synchronization=VariableSynchronization.ON_WRITE,
+            aggregation=VariableAggregation.NONE, dtype=dtype)
+
+    @property
+    def shape(self):
+        # logical (unpadded) shape
+        full = self._value.shape
+        return (self._num_rows,) + tuple(full[1:])
+
+    def read_value(self) -> jax.Array:
+        v = super().read_value()
+        return v[: self._num_rows] if self._pad_rows else v
+
+    def assign(self, value) -> "ShardedVariable":
+        value = jnp.asarray(value, dtype=self.dtype)
+        if value.shape != self.shape:
+            raise ValueError(
+                f"assign shape {value.shape} != variable shape {self.shape}")
+        if self._pad_rows:
+            value = jnp.pad(value,
+                            [(0, self._pad_rows)] + [(0, 0)] * (value.ndim - 1))
+        value = jax.device_put(value, NamedSharding(self._mesh, self._spec))
+        self._value = value
+        return self
+
+    @property
+    def variables(self) -> list[np.ndarray]:
+        """Per-logical-shard views (≙ ShardedVariable.variables) — used by
+        the checkpoint layer to save shards as slices of one logical tensor
+        (sharded_variable save-slice behavior, SURVEY §5.4)."""
+        rows = self.shape[0]
+        per = -(-rows // self.num_shards)
+        full = np.asarray(self.read_value())
+        return [full[i * per: min((i + 1) * per, rows)]
+                for i in range(self.num_shards)]
+
+    def embedding_lookup(self, ids) -> jax.Array:
+        """Sharded gather (≙ sharded_variable.embedding_lookup,
+        sharded_variable.py:995). Under jit, XLA partitions the gather
+        across the shard axis; rows land where the batch needs them."""
+        return jnp.take(self._value, ids, axis=0)

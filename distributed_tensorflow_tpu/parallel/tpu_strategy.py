@@ -1,0 +1,90 @@
+"""TPUStrategy — sync DP plus SPMD model parallelism on TPU.
+
+≙ tensorflow/python/distribute/tpu_strategy.py:243 ``TPUStrategyV2``
+(SURVEY.md §2.1, §3.4). The reference's TPUStrategy is the one place where
+it already does what this framework does everywhere — trace once, compile
+one XLA program, let CrossReplicaSum handle gradients (tpu_strategy.py:1826
+``_tpu_function_creator`` wrapping tpu.replicate). Here that is simply the
+base Strategy over a mesh that may carry model-parallel axes.
+
+``experimental_split_to_logical_devices`` (tpu_strategy.py:516) — the
+reference's manual SPMD annotation — becomes ``split_to_logical_devices``,
+a ``jax.lax.with_sharding_constraint`` wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.cluster import topology as topo_lib
+from distributed_tensorflow_tpu.cluster.resolver import TPUClusterResolver
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+
+
+class TPUStrategy(Strategy):
+    """Synchronous training on TPU over an explicit mesh.
+
+    ``model_axes`` (e.g. ``{"tp": 2}``) reserves mesh axes for model
+    parallelism — the ≙ of ``experimental_device_assignment`` with
+    ``num_cores_per_replica > 1``.
+    """
+
+    def __init__(self, tpu_cluster_resolver: TPUClusterResolver | None = None,
+                 mesh: Mesh | None = None,
+                 model_axes: dict | None = None):
+        self._cluster_resolver = tpu_cluster_resolver
+        if mesh is None:
+            devices = jax.devices()
+            axes = {topo_lib.DATA_AXIS: -1}
+            if model_axes:
+                axes.update(model_axes)
+            mesh = topo_lib.make_mesh(axes, devices=devices)
+        super().__init__(mesh=mesh, data_axis_names=(topo_lib.DATA_AXIS,))
+
+    @property
+    def cluster_resolver(self) -> TPUClusterResolver | None:
+        return self._cluster_resolver
+
+    # -- SPMD annotations (≙ tpu_strategy.py:453/:516) ---------------------
+    def assign_to_logical_device(self, tensor, logical_device_id: int):
+        """≙ experimental_assign_to_logical_device (tpu_strategy.py:453).
+        Under GSPMD the notion collapses to "replicated" placement; kept for
+        API parity."""
+        return jax.lax.with_sharding_constraint(
+            tensor, NamedSharding(self.mesh, P()))
+
+    def split_to_logical_devices(self, tensor, partition_dimensions):
+        """≙ experimental_split_to_logical_devices (tpu_strategy.py:516):
+        shard ``tensor`` so that dim i is split ``partition_dimensions[i]``
+        ways across the mesh's model axes."""
+        model_axes = [a for a in self.mesh.axis_names
+                      if a not in self.data_axis_names
+                      and self.mesh.shape[a] > 1]
+        spec = []
+        ax_iter = iter(model_axes)
+        for nsplit in partition_dimensions:
+            if nsplit == 1:
+                spec.append(None)
+            else:
+                try:
+                    spec.append(next(ax_iter))
+                except StopIteration:
+                    raise ValueError(
+                        f"Not enough model axes on mesh {tuple(self.mesh.shape)}"
+                        f" for partition_dimensions={partition_dimensions}")
+        return jax.lax.with_sharding_constraint(
+            tensor, NamedSharding(self.mesh, P(*spec)))
+
+    def replicate_to_logical_devices(self, tensor):
+        return self.assign_to_logical_device(tensor, 0)
+
+
+def initialize_tpu_system(resolver: TPUClusterResolver | None = None):
+    """≙ tpu_strategy_util.initialize_tpu_system (tpu_strategy_util.py:43).
+    PJRT initializes the TPU system at backend creation; this forces backend
+    init and returns the detected topology."""
+    topo = topo_lib.Topology.detect()
+    return topo

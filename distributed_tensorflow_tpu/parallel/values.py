@@ -1,0 +1,254 @@
+"""Distributed values and variables backed by sharded ``jax.Array``.
+
+TPU-native counterpart of the reference's
+tensorflow/python/distribute/values.py (SURVEY.md §2.3):
+
+- ``PerReplica``        ≙ values.py:356 — one value per replica.
+- ``Mirrored``          ≙ values.py:436 — identical value on every replica.
+- ``DistributedVariable`` ≙ values.py:506 — but instead of N per-device
+  ``tf.Variable`` handles kept in sync by the strategy, the state is ONE
+  ``jax.Array`` whose ``NamedSharding`` encodes the replication/sharding
+  policy. Mirroring is "replicated sharding", not N copies plus a runtime
+  that updates each — XLA keeps the copies consistent by construction.
+- sync policies         ≙ values.py:1564 (OnRead) / :1705 (OnWrite).
+
+Variables here are host-side mutable containers over immutable device
+arrays. Jitted SPMD steps are functional (state pytree in/out) — the
+strategy reads variables into the step and writes results back, which is the
+single point where "TF variable semantics" meet "JAX functional semantics".
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.collectives import ReduceOp
+
+
+class VariableSynchronization(enum.Enum):
+    """≙ tf.VariableSynchronization (values.py sync policies)."""
+
+    AUTO = "auto"
+    ON_WRITE = "on_write"   # mirrored: every replica holds the same value
+    ON_READ = "on_read"     # per-replica state, reduced when read globally
+
+
+class VariableAggregation(enum.Enum):
+    """≙ tf.VariableAggregation."""
+
+    NONE = "none"
+    SUM = "sum"
+    MEAN = "mean"
+    ONLY_FIRST_REPLICA = "only_first_replica"
+
+
+class DistributedValues:
+    """Base for PerReplica/Mirrored (≙ values.py DistributedValues)."""
+
+    def __init__(self, values: Sequence):
+        if not values:
+            raise ValueError("DistributedValues requires at least one value")
+        self._values = tuple(values)
+
+    @property
+    def values(self) -> tuple:
+        return self._values
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __getitem__(self, i):
+        return self._values[i]
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({list(self._values)!r})"
+
+
+class PerReplica(DistributedValues):
+    """One (possibly different) value per replica (≙ values.py:356)."""
+
+
+class Mirrored(DistributedValues):
+    """Same value on each replica (≙ values.py:436)."""
+
+    @property
+    def primary(self):
+        return self._values[0]
+
+
+def _regroup_leaves(structs: Sequence):
+    """≙ distribute_utils.regroup: list of per-replica pytrees -> pytree of
+    PerReplica leaves."""
+    treedef = jax.tree_util.tree_structure(structs[0])
+    leaves = [jax.tree_util.tree_leaves(s) for s in structs]
+    grouped = [PerReplica(vals) for vals in zip(*leaves)]
+    return jax.tree_util.tree_unflatten(treedef, grouped)
+
+
+def select_replica(replica_id: int, structured):
+    """≙ distribute_utils.select_replica."""
+    def pick(v):
+        return v.values[replica_id] if isinstance(v, DistributedValues) else v
+    return jax.tree_util.tree_map(
+        pick, structured, is_leaf=lambda v: isinstance(v, DistributedValues))
+
+
+class DistributedVariable:
+    """A named, mutable, sharded training variable (≙ values.py:506).
+
+    The device state is one ``jax.Array`` with a ``NamedSharding`` over the
+    strategy's mesh. Policy mapping from the reference:
+
+    - MirroredVariable (values.py:1196): spec ``P()`` — replicated on every
+      device; writes happen identically on all (SPMD), so consistency is
+      structural, and the reference's cross-replica assign dance
+      (values.py OnWrite policy :1705) vanishes.
+    - SyncOnReadVariable (values.py:1294): spec with a leading replica axis;
+      global reads reduce with ``aggregation``.
+    - ShardedVariable (sharded_variable.py:843): axis-0 div sharding — see
+      ``sharded_variable.py`` in this package.
+    """
+
+    _NAME_LOCK = threading.Lock()
+    _UID = 0
+
+    def __init__(self, value, *, name: str | None = None,
+                 mesh: Mesh | None = None, spec: P | None = None,
+                 trainable: bool = True,
+                 synchronization: VariableSynchronization = VariableSynchronization.ON_WRITE,
+                 aggregation: VariableAggregation = VariableAggregation.NONE,
+                 dtype=None):
+        if name is None:
+            with DistributedVariable._NAME_LOCK:
+                name = f"variable_{DistributedVariable._UID}"
+                DistributedVariable._UID += 1
+        self.name = name
+        self.trainable = trainable
+        self.synchronization = synchronization
+        self.aggregation = aggregation
+        self._mesh = mesh
+        self._spec = spec if spec is not None else P()
+        value = jnp.asarray(value, dtype=dtype)
+        if mesh is not None:
+            sharding = NamedSharding(mesh, self._spec)
+            value = jax.device_put(value, sharding)
+        self._value = value
+
+    # -- reads ------------------------------------------------------------
+    @property
+    def value(self) -> jax.Array:
+        return self._value
+
+    def read_value(self) -> jax.Array:
+        if self.synchronization is VariableSynchronization.ON_READ:
+            return self._reduce_on_read()
+        return self._value
+
+    def _reduce_on_read(self) -> jax.Array:
+        # ON_READ state carries a leading per-replica axis (sharded over the
+        # data axes); the global read aggregates it (≙ values.py:1294).
+        v = self._value
+        if self.aggregation is VariableAggregation.SUM:
+            return jnp.sum(v, axis=0)
+        if self.aggregation is VariableAggregation.MEAN:
+            return jnp.mean(v, axis=0)
+        if self.aggregation is VariableAggregation.ONLY_FIRST_REPLICA:
+            return v[0]
+        return v
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.read_value())
+
+    @property
+    def shape(self):
+        return self._value.shape
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def sharding(self):
+        return getattr(self._value, "sharding", None)
+
+    @property
+    def spec(self) -> P:
+        return self._spec
+
+    # -- writes -----------------------------------------------------------
+    def assign(self, value) -> "DistributedVariable":
+        value = jnp.asarray(value, dtype=self.dtype)
+        if value.shape != self._value.shape:
+            raise ValueError(
+                f"assign shape {value.shape} != variable shape {self._value.shape}")
+        if self._mesh is not None:
+            value = jax.device_put(value, NamedSharding(self._mesh, self._spec))
+        self._value = value
+        return self
+
+    def assign_add(self, delta) -> "DistributedVariable":
+        return self.assign(self._value + jnp.asarray(delta, dtype=self.dtype))
+
+    def assign_sub(self, delta) -> "DistributedVariable":
+        return self.assign(self._value - jnp.asarray(delta, dtype=self.dtype))
+
+    # internal fast-path for strategy write-back (already sharded correctly)
+    def _set_raw(self, value: jax.Array):
+        self._value = value
+
+    def __repr__(self) -> str:
+        return (f"DistributedVariable(name={self.name!r}, "
+                f"shape={tuple(self.shape)}, dtype={self.dtype}, "
+                f"spec={self._spec}, sync={self.synchronization.value})")
+
+    # arithmetic sugar so variables read naturally in host-side math
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.read_value())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __add__(self, o): return self.read_value() + o
+    def __radd__(self, o): return o + self.read_value()
+    def __mul__(self, o): return self.read_value() * o
+    def __rmul__(self, o): return o * self.read_value()
+    def __sub__(self, o): return self.read_value() - o
+    def __rsub__(self, o): return o - self.read_value()
+
+
+class MirroredVariable(DistributedVariable):
+    """Replicated variable (≙ values.py:1196 MirroredVariable)."""
+
+    def __init__(self, value, *, mesh: Mesh | None = None, name=None,
+                 trainable: bool = True,
+                 aggregation: VariableAggregation = VariableAggregation.MEAN,
+                 dtype=None):
+        super().__init__(
+            value, name=name, mesh=mesh, spec=P(), trainable=trainable,
+            synchronization=VariableSynchronization.ON_WRITE,
+            aggregation=aggregation, dtype=dtype)
+
+
+class SyncOnReadVariable(DistributedVariable):
+    """Per-replica state reduced on global read (≙ values.py:1294).
+
+    The device value has a leading axis of size ``num_replicas`` sharded
+    over the data axes — e.g. batch-norm statistics or per-replica metric
+    accumulators.
+    """
+
+    def __init__(self, per_replica_value, *, mesh: Mesh,
+                 data_axes: tuple = ("dp",), name=None,
+                 aggregation: VariableAggregation = VariableAggregation.SUM,
+                 dtype=None):
+        spec = P(data_axes)
+        super().__init__(
+            per_replica_value, name=name, mesh=mesh, spec=spec,
+            trainable=False,
+            synchronization=VariableSynchronization.ON_READ,
+            aggregation=aggregation, dtype=dtype)

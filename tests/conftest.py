@@ -1,0 +1,38 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's fake-multi-device test vehicle
+(test_util.set_logical_devices_to_at_least, SURVEY.md §4): strategies that
+target an 8-chip slice run on CPU-only CI by splitting the host into 8
+XLA devices. Must run before any jax backend initialization.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8(devices):
+    from distributed_tensorflow_tpu.cluster.topology import make_mesh
+    return make_mesh({"dp": 8})
+
+
+@pytest.fixture()
+def mesh2d(devices):
+    from distributed_tensorflow_tpu.cluster.topology import make_mesh
+    return make_mesh({"dp": 4, "tp": 2})

@@ -1,0 +1,110 @@
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster.resolver import (
+    ClusterSpec,
+    SimpleClusterResolver,
+    TFConfigClusterResolver,
+    TPUClusterResolver,
+    coordinator_address,
+    id_in_cluster,
+    is_chief,
+    validate_cluster_spec,
+    worker_count,
+)
+
+
+def test_cluster_spec_basic():
+    spec = ClusterSpec({"worker": ["a:1", "b:2"], "ps": ["c:3"]})
+    assert spec.jobs == ["ps", "worker"]
+    assert spec.num_tasks("worker") == 2
+    assert spec.task_address("worker", 1) == "b:2"
+    assert spec.num_total_tasks == 3
+    assert bool(spec)
+    assert not bool(ClusterSpec({}))
+
+
+def test_cluster_spec_dict_form():
+    spec = ClusterSpec({"worker": {0: "a:1", 2: "c:3"}})
+    assert spec.num_tasks("worker") == 3
+    assert spec.task_address("worker", 2) == "c:3"
+
+
+def test_validate():
+    spec = ClusterSpec({"worker": ["a:1"], "chief": ["c:0"]})
+    validate_cluster_spec(spec, "worker", 0)
+    with pytest.raises(ValueError):
+        validate_cluster_spec(spec, "worker", 5)
+    with pytest.raises(ValueError):
+        validate_cluster_spec(
+            ClusterSpec({"chief": ["a", "b"]}), "chief", 0)
+
+
+def test_tf_config_resolver(monkeypatch):
+    cfg = {"cluster": {"worker": ["h0:2222", "h1:2222"],
+                       "chief": ["hc:2222"]},
+           "task": {"type": "worker", "index": 1}}
+    monkeypatch.setenv("TF_CONFIG", json.dumps(cfg))
+    r = TFConfigClusterResolver()
+    assert r.task_type == "worker"
+    assert r.task_id == 1
+    assert r.cluster_spec().num_tasks("worker") == 2
+    assert r.master() == "hc:2222"
+    assert not r.is_chief()
+    assert r.num_processes() == 3
+    assert r.process_id() == 2  # chief=0, worker0=1, worker1=2
+
+
+def test_tf_config_empty(monkeypatch):
+    monkeypatch.delenv("TF_CONFIG", raising=False)
+    r = TFConfigClusterResolver()
+    assert not r.cluster_spec()
+    assert r.is_chief()
+    assert r.num_processes() == 1
+
+
+def test_tf_config_malformed(monkeypatch):
+    monkeypatch.setenv("TF_CONFIG", "{not json")
+    with pytest.raises(ValueError):
+        TFConfigClusterResolver()
+
+
+def test_tpu_resolver(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1,t2,t3")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    r = TPUClusterResolver()
+    spec = r.cluster_spec()
+    assert spec.num_tasks("worker") == 4
+    assert r.task_id == 2
+    assert r.master().startswith("t0:")
+    md = r.get_tpu_system_metadata()
+    assert md["num_cores"] == 8
+
+
+def test_tpu_resolver_local(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    r = TPUClusterResolver()
+    assert not r.cluster_spec()
+    assert r.is_chief()
+
+
+def test_multi_worker_util():
+    spec = ClusterSpec({"chief": ["c:1"], "worker": ["a:1", "b:2"]})
+    assert is_chief(spec, "chief", 0)
+    assert not is_chief(spec, "worker", 0)
+    no_chief = ClusterSpec({"worker": ["a:1", "b:2"]})
+    assert is_chief(no_chief, "worker", 0)
+    assert coordinator_address(spec) == "c:1"
+    assert coordinator_address(no_chief) == "a:1"
+    assert id_in_cluster(spec, "worker", 1) == 2
+    assert worker_count(spec) == 3
+
+
+def test_simple_resolver():
+    spec = ClusterSpec({"worker": ["a:1"]})
+    r = SimpleClusterResolver(spec, task_type="worker", task_id=0)
+    assert r.cluster_spec() == spec
+    assert r.is_chief()

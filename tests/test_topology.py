@@ -1,0 +1,51 @@
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.topology import (
+    DeviceAssignment,
+    Topology,
+    make_mesh,
+    mesh_axis_size,
+)
+
+
+def test_topology_detect(devices):
+    topo = Topology.detect()
+    assert topo.num_devices == 8
+    assert topo.num_processes == 1
+    assert topo.platform == "cpu"
+    assert len(topo.local_devices()) == 8
+
+
+def test_device_assignment(devices):
+    da = DeviceAssignment.build(num_replicas=4, num_cores_per_replica=2)
+    assert da.device(0, 0) is devices[0]
+    assert da.device(1, 0) is devices[2]
+    assert len(da.replica_devices(3)) == 2
+
+
+def test_device_assignment_overflow(devices):
+    with pytest.raises(ValueError):
+        DeviceAssignment.build(num_replicas=8, num_cores_per_replica=2)
+
+
+def test_make_mesh_default(devices):
+    mesh = make_mesh()
+    assert mesh.shape == {"dp": 8}
+
+
+def test_make_mesh_wildcard(devices):
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+
+
+def test_make_mesh_mismatch(devices):
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 2})
+
+
+def test_mesh_axis_size(mesh2d):
+    assert mesh_axis_size(mesh2d, "dp") == 4
+    assert mesh_axis_size(mesh2d, "dp", "tp") == 8
+    assert mesh_axis_size(mesh2d, "missing") == 1
