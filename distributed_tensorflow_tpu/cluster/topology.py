@@ -165,13 +165,16 @@ def make_mesh(axes: Mapping[str, int] | Sequence[tuple] | None = None,
     if axes is None:
         axes = {DATA_AXIS: len(devs)}
     names, sizes = _normalize_axes(axes, len(devs))
+    # Auto axis types: the framework works in GSPMD mode (sharding
+    # constraints + propagation), not the explicit-sharding-in-types mode.
+    axis_types = (jax.sharding.AxisType.Auto,) * len(names)
     if devices is None:
         try:
-            return jax.make_mesh(sizes, names)
+            return jax.make_mesh(sizes, names, axis_types=axis_types)
         except (ValueError, RuntimeError):
             pass  # fall through to explicit reshaping
     arr = np.asarray(devs, dtype=object).reshape(sizes)
-    return Mesh(arr, names)
+    return Mesh(arr, names, axis_types=axis_types)
 
 
 def mesh_axis_size(mesh: Mesh, *names: str) -> int:

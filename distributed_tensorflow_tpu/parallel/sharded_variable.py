@@ -133,7 +133,12 @@ class ShardedVariable(DistributedVariable):
 
     def read_value(self) -> jax.Array:
         v = super().read_value()
-        return v[: self._num_rows] if self._pad_rows else v
+        if self._pad_rows:
+            # gather to replicated before the unpadding slice — a partial
+            # slice of a row-sharded array has no unambiguous sharding
+            v = jax.device_put(v, NamedSharding(self._mesh, P()))
+            v = v[: self._num_rows]
+        return v
 
     def assign(self, value) -> "ShardedVariable":
         value = jnp.asarray(value, dtype=self.dtype)
@@ -160,6 +165,14 @@ class ShardedVariable(DistributedVariable):
 
     def embedding_lookup(self, ids) -> jax.Array:
         """Sharded gather (≙ sharded_variable.embedding_lookup,
-        sharded_variable.py:995). Under jit, XLA partitions the gather
-        across the shard axis; rows land where the batch needs them."""
-        return jnp.take(self._value, ids, axis=0)
+        sharded_variable.py:995). XLA partitions the gather across the
+        shard axis; the result is materialized where the batch needs it
+        (replicated by default — pass through jit with sharding constraints
+        for a data-sharded result)."""
+        try:
+            return jnp.take(self._value, ids, axis=0)
+        except Exception:
+            # eager gather over a row-sharded operand needs an explicit
+            # output sharding
+            return self._value.at[ids].get(
+                out_sharding=NamedSharding(self._mesh, P()))

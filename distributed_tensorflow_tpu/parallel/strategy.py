@@ -436,10 +436,7 @@ class Strategy:
             new_var_vals, out_stacked = cached(tuple(var_vals), *stacked)
             for v, val in zip(variables, new_var_vals):
                 v._set_raw(val)
-
-            def unstack_hit(x):
-                return PerReplica([x[i] for i in range(R)])
-            return jax.tree_util.tree_map(unstack_hit, out_stacked)
+            return self._unstack_outputs(out_stacked)
 
         def spmd_fn(var_vals_in, *leaves):
             on_read = [v.synchronization is VariableSynchronization.ON_READ
@@ -451,7 +448,9 @@ class Strategy:
                      for v, m in zip(leaves, split_mask)]
             (largs, lkwargs) = jax.tree_util.tree_unflatten(args_treedef, local)
             ctx = ReplicaContext(self, axes)
-            with _spmd_trace(), _variable_overlay(overlay), \
+            # run() implicitly enters the strategy's scope (TF semantics:
+            # get_strategy() works inside a replica fn)
+            with self.scope(), _spmd_trace(), _variable_overlay(overlay), \
                     _replica_context(ctx):
                 out = fn(*largs, **lkwargs)
             new_vals = []
@@ -497,8 +496,18 @@ class Strategy:
 
         for v, val in zip(variables, new_var_vals):
             v._set_raw(val)
+        return self._unstack_outputs(out_stacked)
+
+    def _unstack_outputs(self, out_stacked):
+        """Split stacked (R, ...) outputs into PerReplica host views. The
+        stacked array is replica-sharded; indexing it eagerly is ambiguous
+        to GSPMD, so re-place replicated first (outputs of the TF-parity
+        path are host-consumed, not hot-path)."""
+        R = self.num_replicas_in_sync
+        repl = self.replicated_sharding()
 
         def unstack(x):
+            x = jax.device_put(x, repl)
             return PerReplica([x[i] for i in range(R)])
         return jax.tree_util.tree_map(unstack, out_stacked)
 

@@ -1,0 +1,139 @@
+import numpy as np
+import pytest
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu.input.dataset import (
+    AutoShardPolicy,
+    Dataset,
+    InputContext,
+    auto_shard_dataset,
+)
+
+
+def test_from_tensor_slices_batch():
+    ds = Dataset.from_tensor_slices(
+        {"x": np.arange(10), "y": np.arange(10) * 2}).batch(4)
+    batches = list(ds)
+    assert [b["x"].shape[0] for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(batches[0]["y"], [0, 2, 4, 6])
+
+
+def test_batch_drop_remainder():
+    ds = Dataset.range(10).batch(4, drop_remainder=True)
+    assert [np.shape(b)[0] for b in ds] == [4, 4]
+    assert ds.cardinality() == 2
+
+
+def test_map_filter_take_skip():
+    ds = Dataset.range(10).map(lambda x: x * x).filter(lambda x: x % 2 == 0)
+    assert list(ds) == [0, 4, 16, 36, 64]
+    assert list(Dataset.range(10).skip(7)) == [7, 8, 9]
+    assert list(Dataset.range(10).take(2)) == [0, 1]
+
+
+def test_shuffle_deterministic_and_complete():
+    ds = Dataset.range(20).shuffle(8, seed=42)
+    out = list(ds)
+    assert sorted(out) == list(range(20))
+    assert out != list(range(20))
+    assert list(Dataset.range(20).shuffle(8, seed=42)) == out
+
+
+def test_repeat():
+    assert list(Dataset.range(3).repeat(2)) == [0, 1, 2, 0, 1, 2]
+
+
+def test_shard_data_policy():
+    ds = Dataset.range(10).shard(4, 1)
+    assert list(ds) == [1, 5, 9]
+
+
+def test_shard_files_policy():
+    files = [f"f{i}" for i in range(4)]
+    ds = Dataset.from_files(files, reader=lambda f: iter([f + "_a", f + "_b"]))
+    sharded = ds.shard_files(2, 0)
+    assert list(sharded) == ["f0_a", "f0_b", "f2_a", "f2_b"]
+
+
+def test_auto_shard_policy_selection():
+    files = [f"f{i}" for i in range(4)]
+    file_ds = Dataset.from_files(files, reader=lambda f: iter([f]))
+    assert list(auto_shard_dataset(file_ds, 2, 1)) == ["f1", "f3"]  # FILE
+    plain = Dataset.range(6)
+    assert list(auto_shard_dataset(plain, 2, 1)) == [1, 3, 5]  # DATA
+    assert list(auto_shard_dataset(plain, 2, 1, AutoShardPolicy.OFF)) == \
+        list(range(6))
+    with pytest.raises(ValueError):
+        auto_shard_dataset(plain, 2, 1, AutoShardPolicy.FILE)
+
+
+def test_prefetch_matches():
+    assert list(Dataset.range(50).prefetch(4)) == list(range(50))
+
+
+def test_prefetch_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    ds = Dataset.from_generator(gen).prefetch(2)
+    it = iter(ds)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_input_context():
+    ctx = InputContext(num_input_pipelines=2, input_pipeline_id=1,
+                       num_replicas_in_sync=8)
+    assert ctx.get_per_replica_batch_size(64) == 8
+    with pytest.raises(ValueError):
+        ctx.get_per_replica_batch_size(63)
+
+
+def test_distributed_dataset_sharded_batches(devices):
+    s = dtx.MirroredStrategy()
+    ds = Dataset.from_tensor_slices(
+        {"x": np.arange(64, dtype="float32").reshape(32, 2)}).batch(16)
+    dist = s.experimental_distribute_dataset(ds)
+    batches = list(dist)
+    assert len(batches) == 2
+    b = batches[0]["x"]
+    assert b.shape == (16, 2)
+    assert str(b.sharding.spec) == "PartitionSpec('dp',)"
+
+
+def test_distributed_iterator_get_next(devices):
+    s = dtx.MirroredStrategy()
+    ds = Dataset.from_tensor_slices({"x": np.ones((8, 2), "float32")}).batch(8)
+    it = iter(s.experimental_distribute_dataset(ds))
+    assert it.get_next_as_optional() is not None
+    assert it.get_next_as_optional() is None
+    it2 = iter(s.experimental_distribute_dataset(ds))
+    it2.get_next()
+    with pytest.raises(StopIteration):
+        it2.get_next()
+
+
+def test_iter_per_replica(devices):
+    s = dtx.MirroredStrategy()
+    ds = Dataset.from_tensor_slices(
+        {"x": np.arange(16, dtype="float32")}).batch(16)
+    pr_batches = list(s.experimental_distribute_dataset(ds).iter_per_replica())
+    pr = pr_batches[0]["x"]
+    assert len(pr) == 8
+    np.testing.assert_array_equal(pr.values[1], [2.0, 3.0])
+
+
+def test_distribute_datasets_from_function(devices):
+    s = dtx.MirroredStrategy()
+
+    def dataset_fn(ctx):
+        per_replica = ctx.get_per_replica_batch_size(32)
+        return Dataset.from_tensor_slices(
+            {"x": np.ones((64, 1), "float32")}).batch(
+                per_replica * s.num_replicas_in_sync)
+
+    dist = s.distribute_datasets_from_function(dataset_fn)
+    b = next(iter(dist))
+    assert b["x"].shape == (32, 1)

@@ -1,0 +1,89 @@
+"""End-to-end MNIST training (workload #1, BASELINE.md) on the virtual mesh.
+
+Correctness-vs-single-device pattern ≙ keras_correctness_test_base
+(SURVEY.md §4): the distributed run must match a single-device run
+step-for-step, and training must actually reduce the loss.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu.models import mnist_cnn
+
+
+@pytest.fixture(scope="module")
+def data():
+    return mnist_cnn.synthetic_data(n=256, seed=0)
+
+
+def _train(strategy, data, steps=8, lr=1e-2):
+    rng = jax.random.PRNGKey(0)
+    state, model, tx = mnist_cnn.create_train_state(rng, lr)
+    state = strategy.replicate(state)
+    step_fn = strategy.compile_step(mnist_cnn.make_train_step(model, tx))
+    ds = dtx.Dataset.from_tensor_slices(data).batch(64, drop_remainder=True)
+    dist = strategy.experimental_distribute_dataset(ds.repeat())
+    losses = []
+    it = iter(dist)
+    for _ in range(steps):
+        state, metrics = step_fn(state, next(it))
+        losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+def test_mnist_trains_and_matches_single_device(devices, data):
+    mirrored = dtx.MirroredStrategy()
+    one = dtx.OneDeviceStrategy()
+
+    state_m, losses_m = _train(mirrored, data)
+    state_o, losses_o = _train(one, data)
+
+    # loss must decrease
+    assert losses_m[-1] < losses_m[0]
+    # distributed == single device at matched step count (same global batch)
+    np.testing.assert_allclose(losses_m, losses_o, rtol=2e-4, atol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        state_m["params"], state_o["params"])
+
+
+def test_mnist_tf_parity_path(devices, data):
+    """Same workload through scope/Variable/run — the reference-script
+    shape."""
+    strategy = dtx.MirroredStrategy()
+    import jax.numpy as jnp
+    import optax
+
+    rng = jax.random.PRNGKey(0)
+    state, model, tx = mnist_cnn.create_train_state(rng, 1e-2)
+
+    with strategy.scope():
+        params_var = strategy.create_variable(
+            jax.flatten_util.ravel_pytree(state["params"])[0], name="params")
+    unravel = jax.flatten_util.ravel_pytree(state["params"])[1]
+
+    def train_step(batch):
+        def loss_fn(flat):
+            params = unravel(flat)
+            logits = model.apply({"params": params}, batch["image"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["label"]).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(params_var.value)
+        ctx = dtx.get_replica_context()
+        g = ctx.all_reduce("mean", g)
+        params_var.assign_sub(1e-2 * g)
+        return loss
+
+    ds = dtx.Dataset.from_tensor_slices(data).batch(64, drop_remainder=True)
+    dist = strategy.experimental_distribute_dataset(ds.repeat())
+    losses = []
+    for i, pr in enumerate(dist.iter_per_replica()):
+        if i >= 6:
+            break
+        out = strategy.run(train_step, args=(pr,))
+        losses.append(float(strategy.reduce("mean", out)))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,120 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.parallel.sharded_variable import (
+    FixedShardsPartitioner,
+    MaxSizePartitioner,
+    MinSizePartitioner,
+    ShardedVariable,
+)
+from distributed_tensorflow_tpu.parallel.values import (
+    DistributedVariable,
+    Mirrored,
+    MirroredVariable,
+    PerReplica,
+    SyncOnReadVariable,
+    VariableAggregation,
+    select_replica,
+)
+
+
+def test_per_replica():
+    pr = PerReplica([1, 2, 3])
+    assert len(pr) == 3
+    assert pr[1] == 2
+    with pytest.raises(ValueError):
+        PerReplica([])
+
+
+def test_mirrored_primary():
+    m = Mirrored([5, 5])
+    assert m.primary == 5
+
+
+def test_select_replica():
+    tree = {"a": PerReplica([1, 2]), "b": 7}
+    assert select_replica(1, tree) == {"a": 2, "b": 7}
+
+
+def test_mirrored_variable(mesh8):
+    v = MirroredVariable(np.arange(4.0), mesh=mesh8, name="w")
+    assert v.shape == (4,)
+    assert v.sharding.is_fully_replicated
+    v.assign_add(np.ones(4))
+    np.testing.assert_allclose(v.numpy(), np.arange(4.0) + 1)
+    v.assign_sub(np.ones(4))
+    np.testing.assert_allclose(v.numpy(), np.arange(4.0))
+    with pytest.raises(ValueError):
+        v.assign(np.zeros(5))
+
+
+def test_sync_on_read_variable(mesh8):
+    v = SyncOnReadVariable(np.ones((8, 3)), mesh=mesh8,
+                           aggregation=VariableAggregation.SUM)
+    np.testing.assert_allclose(v.read_value(), np.full(3, 8.0))
+    v2 = SyncOnReadVariable(np.ones((8, 3)), mesh=mesh8,
+                            aggregation=VariableAggregation.MEAN)
+    np.testing.assert_allclose(v2.read_value(), np.ones(3))
+
+
+def test_variable_arithmetic(mesh8):
+    v = MirroredVariable(np.full(2, 3.0), mesh=mesh8)
+    np.testing.assert_allclose(np.asarray(v + 1), np.full(2, 4.0))
+    np.testing.assert_allclose(np.asarray(2 * v), np.full(2, 6.0))
+
+
+# -- partitioners ----------------------------------------------------------
+
+def test_fixed_shards_partitioner():
+    p = FixedShardsPartitioner(4)
+    assert p((100, 8), jnp.float32) == [4, 1]
+    assert p((2,), jnp.float32) == [2]
+
+
+def test_min_size_partitioner():
+    p = MinSizePartitioner(min_shard_bytes=400, max_shards=8)
+    # 100 rows x 1 col x 4B = 400B -> 1 shard of >=400B
+    assert p((100, 1), jnp.float32)[0] == 1
+    # 1000 rows x 4B = 4000B -> up to 8 shards of >=400B
+    assert p((1000, 1), jnp.float32)[0] == 8
+    with pytest.raises(ValueError):
+        MinSizePartitioner(min_shard_bytes=0)
+
+
+def test_max_size_partitioner():
+    p = MaxSizePartitioner(max_shard_bytes=400)
+    assert p((100, 1), jnp.float32)[0] == 1
+    assert p((200, 1), jnp.float32)[0] == 2
+    p2 = MaxSizePartitioner(max_shard_bytes=4, max_shards=3)
+    assert p2((100, 1), jnp.float32)[0] == 3
+
+
+def test_sharded_variable(mesh8):
+    v = ShardedVariable(np.arange(16.0).reshape(16, 1), mesh=mesh8,
+                        shard_axis_name="dp", num_shards=4)
+    assert v.shape == (16, 1)
+    np.testing.assert_allclose(v.read_value(),
+                               np.arange(16.0).reshape(16, 1))
+    shards = v.variables
+    assert len(shards) == 4
+    assert shards[0].shape == (4, 1)
+    np.testing.assert_allclose(shards[1][0, 0], 4.0)
+
+
+def test_sharded_variable_padding(mesh8):
+    # 13 rows over 8 shards -> padded to 16 internally, logical shape kept
+    v = ShardedVariable(np.arange(13.0).reshape(13, 1), mesh=mesh8,
+                        shard_axis_name="dp")
+    assert v.shape == (13, 1)
+    np.testing.assert_allclose(v.read_value().squeeze(), np.arange(13.0))
+    v.assign(np.zeros((13, 1)))
+    np.testing.assert_allclose(v.read_value(), np.zeros((13, 1)))
+
+
+def test_sharded_embedding_lookup(mesh8):
+    table = np.arange(32.0).reshape(16, 2)
+    v = ShardedVariable(table, mesh=mesh8, shard_axis_name="dp")
+    ids = jnp.array([0, 5, 15])
+    out = v.embedding_lookup(ids)
+    np.testing.assert_allclose(out, table[np.array([0, 5, 15])])
