@@ -1,0 +1,17 @@
+"""Checkpointing: object save/restore, rotation, preemption safety.
+
+TPU-native counterpart of the reference's checkpoint stack (SURVEY.md §5.4):
+tf.train.Checkpoint / CheckpointManager / PreemptionCheckpointHandler.
+"""
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+)
+from distributed_tensorflow_tpu.checkpoint.failure_handling import (
+    PreemptionCheckpointHandler,
+    TerminationConfig,
+)
+from distributed_tensorflow_tpu.checkpoint.preemption_watcher import (
+    PreemptionWatcher,
+)

@@ -1,0 +1,176 @@
+"""Preemption-safe coordinated checkpointing.
+
+TPU-native counterpart of tensorflow/python/distribute/failure_handling/
+failure_handling.py (SURVEY.md §2.5, §3.5):
+
+- ``TerminationConfig``            ≙ failure_handling.py:75-244 (platform
+  matrix: Borg/GCE x CPU/GPU/TPU). Here the platform signal set collapses to
+  SIGTERM plus the GCE/TPU-VM maintenance-event file hook.
+- ``PreemptionCheckpointHandler``  ≙ failure_handling.py:337: wraps the
+  train loop; on a preemption signal every process agrees on a "step to
+  save at", checkpoints there, and exits (or counts down a grace period).
+
+The cross-process agreement protocol in the reference rides the
+coordination-service KV store plus a collective (_watch_step_to_save_key,
+failure_handling.py:1222). Here the same two primitives are
+``jax.experimental.multihost_utils`` broadcast (coordination-service backed)
+— on a single process it degenerates to a local flag, which is what the
+tests exercise; the multi-host path reuses the identical code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+)
+
+
+@dataclasses.dataclass
+class TerminationConfig:
+    """≙ failure_handling.py:75 ``TerminationConfig``."""
+
+    termination_watcher_fn: Callable[[], bool] | None = None
+    exit_fn: Callable[[], None] | None = None
+    grace_period: float = 0.0
+    save_fn: Callable[[], None] | None = None
+
+    @classmethod
+    def for_platform(cls) -> "TerminationConfig":
+        """Platform sniffing (≙ failure_handling.py:245): on GCE/TPU-VM,
+        watch the maintenance-event metadata; default is signal-only."""
+        watcher = None
+        event_file = os.environ.get("DTX_MAINTENANCE_EVENT_FILE")
+        if event_file:
+            def watcher() -> bool:  # noqa: F811
+                try:
+                    with open(event_file) as f:
+                        return "TERMINATE" in f.read().upper()
+                except OSError:
+                    return False
+        return cls(termination_watcher_fn=watcher)
+
+
+class PreemptionCheckpointHandler:
+    """Wraps a training loop with preemption-triggered checkpointing.
+
+    Usage (≙ failure_handling.py:805 ``run``):
+
+        handler = PreemptionCheckpointHandler(manager)
+        for _ in range(steps):
+            handler.run(train_step_fn)   # runs fn; checkpoints+exits on
+                                         # preemption at a step boundary
+    """
+
+    def __init__(self, checkpoint_manager: CheckpointManager,
+                 termination_config: TerminationConfig | None = None,
+                 watch_interval: float = 1.0):
+        self._manager = checkpoint_manager
+        self._config = termination_config or TerminationConfig.for_platform()
+        self._received = threading.Event()
+        self._step = 0
+        self._run_count_restored = 0
+        self._exited = False
+        self._poller: threading.Thread | None = None
+
+        # restore first (≙ failure_handling.py:647 restore-on-init)
+        latest = self._manager.restore_or_initialize()
+        if latest is not None:
+            self._run_count_restored = self._manager.checkpoint.save_counter
+
+        self._install_signal_handler()
+        if self._config.termination_watcher_fn is not None:
+            self._poller = threading.Thread(target=self._poll, daemon=True)
+            self._poller.start()
+
+    # -- signal plumbing ---------------------------------------------------
+    def _install_signal_handler(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def handler(signum, frame):
+                self._received.set()
+                if callable(prev) and prev not in (signal.SIG_IGN,
+                                                   signal.SIG_DFL):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGTERM, handler)
+        except (ValueError, OSError):
+            pass  # non-main thread / restricted env
+
+    def _poll(self):
+        while not self._received.is_set():
+            try:
+                if self._config.termination_watcher_fn():
+                    self._received.set()
+                    return
+            except Exception:
+                pass
+            time.sleep(1.0)
+
+    # -- public API --------------------------------------------------------
+    @property
+    def total_run_calls(self) -> int:
+        """≙ PreemptionCheckpointHandler.total_run_calls: steps run across
+        all incarnations (restored + this process)."""
+        return self._step
+
+    def watch_preemption(self):
+        """Manually mark a preemption notice (tests/fault injection)."""
+        self._received.set()
+
+    def run(self, distributed_train_fn: Callable, *args, **kwargs):
+        """Run one step, then checkpoint-and-exit if preemption was
+        signalled (≙ failure_handling.py:805/:1082)."""
+        result = distributed_train_fn(*args, **kwargs)
+        self._step += 1
+        self._check_preemption_and_maybe_checkpoint()
+        return result
+
+    def _agree_on_preemption(self) -> bool:
+        """All processes must agree before saving (≙ the KV-store
+        "step to save at" protocol, failure_handling.py:1222). Any process
+        that saw the signal wins."""
+        local = self._received.is_set()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            agreed = multihost_utils.process_allgather(
+                np.asarray([local], dtype=np.bool_))
+            return bool(np.any(agreed))
+        return local
+
+    def _check_preemption_and_maybe_checkpoint(self):
+        if self._exited or not self._agree_on_preemption():
+            return
+        deadline = time.time() + (self._config.grace_period or 0.0)
+        if self._config.save_fn is not None:
+            self._config.save_fn()
+        else:
+            self._manager.save(checkpoint_number=self._step +
+                               self._run_count_restored)
+            self._manager.checkpoint.sync()
+        # grace-period countdown (≙ failure_handling.py:1204)
+        remaining = deadline - time.time()
+        if remaining > 0:
+            time.sleep(min(remaining, 0.1))
+        self._exited = True
+        if self._config.exit_fn is not None:
+            self._config.exit_fn()
+        else:
+            raise SystemExit(42)  # platform restarts the job
+
+
+def _default_exit():
+    os._exit(42)

@@ -1,0 +1,15 @@
+"""Chief-side async dispatch for parameter-server training.
+
+TPU-native counterpart of tensorflow/python/distribute/coordinator/
+(SURVEY.md §2.5).
+"""
+
+from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
+    ClusterCoordinator,
+    Closure,
+    PerWorkerValues,
+    PSUnavailableError,
+    RemoteValue,
+    WorkerPreemptionError,
+)
+from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog

@@ -1,0 +1,382 @@
+"""ClusterCoordinator: closure queue + per-worker dispatch threads.
+
+TPU-native counterpart of tensorflow/python/distribute/coordinator/
+cluster_coordinator.py (SURVEY.md §2.5, §3.3):
+
+- ``ClusterCoordinator``        ≙ :1399 — ``schedule``/``join``/``fetch``
+- ``Closure``                   ≙ :193  — a scheduled fn + its RemoteValue
+- ``_CoordinatedClosureQueue``  ≙ :322  — bounded queue, put_back on worker
+  failure, error propagation, cancellation on application error
+- ``Worker``                    ≙ :1027 — one dispatch thread per worker
+- ``Cluster``                   ≙ :1247
+- ``RemoteValue``/``PerWorkerValues`` ≙ remote_value.py / values.py
+
+Redesign note: the reference dispatches closures to remote *processes* over
+the grpc eager service; worker failure shows up as grpc UnavailableError and
+is retried (``WorkerPreemptionHandler.wait_on_failure``, :879), PS failure
+surfaces as ``PSUnavailableError`` (:130) for user-level restore. Here a
+"worker" is a dispatch lane bound to a local accelerator (or a remote host
+in the multi-process runtime); the same queue/retry semantics apply with
+``WorkerPreemptionError`` as the retryable class. The asynchrony — the
+actual point of PS training — is identical: no global barrier, workers pull
+independently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import threading
+import traceback
+from typing import Any, Callable, Sequence
+
+import jax
+
+from distributed_tensorflow_tpu.coordinator import metric_utils
+from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+
+
+class WorkerPreemptionError(RuntimeError):
+    """Retryable worker failure (≙ grpc UnavailableError in the reference:
+    the closure is re-queued and run on another worker)."""
+
+
+class PSUnavailableError(RuntimeError):
+    """Parameter-server state lost (≙ cluster_coordinator.py:130): not
+    retryable — user restores from checkpoint."""
+
+
+class ClosureCancelledError(RuntimeError):
+    pass
+
+
+class _Status(enum.Enum):
+    PENDING = "pending"
+    READY = "ready"
+    ABORTED = "aborted"
+    CANCELLED = "cancelled"
+
+
+class RemoteValue:
+    """Future for a scheduled closure's result (≙ remote_value.py)."""
+
+    def __init__(self):
+        self._status = _Status.PENDING
+        self._value = None
+        self._error: BaseException | None = None
+        self._cv = threading.Condition()
+
+    def _set_value(self, value):
+        with self._cv:
+            self._value = value
+            self._status = _Status.READY
+            self._cv.notify_all()
+
+    def _set_error(self, err: BaseException):
+        with self._cv:
+            self._error = err
+            self._status = _Status.ABORTED
+            self._cv.notify_all()
+
+    def _cancel(self):
+        with self._cv:
+            if self._status is _Status.PENDING:
+                self._status = _Status.CANCELLED
+                self._cv.notify_all()
+
+    def fetch(self, timeout: float | None = None):
+        """Block until ready; raises the closure's error if it failed."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._status is not _Status.PENDING, timeout)
+            if self._status is _Status.PENDING:
+                raise TimeoutError("RemoteValue not ready")
+            if self._status is _Status.CANCELLED:
+                raise ClosureCancelledError("closure cancelled")
+            if self._status is _Status.ABORTED:
+                raise self._error
+            return self._value
+
+    get = fetch
+
+
+class PerWorkerValues:
+    """One value per worker (≙ coordinator/values.py PerWorkerValues)."""
+
+    def __init__(self, values: Sequence):
+        self._values = tuple(values)
+
+    @property
+    def values(self) -> tuple:
+        return self._values
+
+    def __getitem__(self, i):
+        return self._values[i]
+
+    def __len__(self):
+        return len(self._values)
+
+
+class Closure:
+    """A schedulable unit (≙ cluster_coordinator.py:193)."""
+
+    def __init__(self, fn: Callable, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs or {}
+        self.output = RemoteValue()
+
+    def execute_on(self, worker: "Worker"):
+        def resolve(v):
+            return v.values[worker.worker_index] \
+                if isinstance(v, PerWorkerValues) else v
+
+        args = jax.tree_util.tree_map(
+            resolve, self.args,
+            is_leaf=lambda v: isinstance(v, PerWorkerValues))
+        kwargs = jax.tree_util.tree_map(
+            resolve, self.kwargs,
+            is_leaf=lambda v: isinstance(v, PerWorkerValues))
+        with worker.device_scope():
+            result = self.fn(*args, **kwargs)
+        self.output._set_value(result)
+
+    def mark_cancelled(self):
+        self.output._cancel()
+
+
+class _CoordinatedClosureQueue:
+    """Bounded closure queue with failure semantics
+    (≙ cluster_coordinator.py:322).
+
+    - ``put``/``get`` with backpressure
+    - ``put_back`` returns an in-flight closure after a retryable worker
+      failure (≙ :514)
+    - ``mark_failed`` records an application error: the queue cancels all
+      pending closures and re-raises from ``wait``/``put``
+    """
+
+    def __init__(self, max_pending: int = 1024):
+        self._queue: list[Closure] = []
+        self._inflight = 0
+        self._error: BaseException | None = None
+        self._cancelled = False
+        self._max = max_pending
+        self._cv = threading.Condition()
+        self.closures_queued = metric_utils.Counter("queued_closures")
+        self.closures_done = metric_utils.Counter("done_closures")
+
+    def _raise_if_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            self._cancelled = False
+            raise err
+
+    def put(self, closure: Closure):
+        with self._cv:
+            self._raise_if_error()
+            self._cv.wait_for(lambda: len(self._queue) < self._max
+                              or self._error is not None)
+            self._raise_if_error()
+            self._queue.append(closure)
+            self.closures_queued.increment()
+            self._cv.notify_all()
+
+    def get(self, timeout: float | None = None) -> Closure | None:
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._queue or self._cancelled, timeout)
+            if not self._queue:
+                return None
+            closure = self._queue.pop(0)
+            self._inflight += 1
+            self._cv.notify_all()
+            return closure
+
+    def put_back(self, closure: Closure):
+        with self._cv:
+            self._inflight -= 1
+            if self._cancelled:
+                closure.mark_cancelled()
+            else:
+                self._queue.insert(0, closure)
+            self._cv.notify_all()
+
+    def mark_finished(self, closure: Closure):
+        with self._cv:
+            self._inflight -= 1
+            self.closures_done.increment()
+            self._cv.notify_all()
+
+    def mark_failed(self, err: BaseException):
+        with self._cv:
+            self._error = err
+            self._cancelled = True
+            for c in self._queue:
+                c.mark_cancelled()
+            self._queue.clear()
+            self._cv.notify_all()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until queue drained and nothing in flight."""
+        with self._cv:
+            done = self._cv.wait_for(
+                lambda: (not self._queue and self._inflight == 0)
+                or self._error is not None, timeout)
+            self._raise_if_error()
+            return done
+
+    def done(self) -> bool:
+        with self._cv:
+            self._raise_if_error()
+            return not self._queue and self._inflight == 0
+
+    def stop(self):
+        with self._cv:
+            self._cancelled = True
+            self._cv.notify_all()
+
+
+class Worker:
+    """One dispatch lane (≙ cluster_coordinator.py:1027): a thread pulling
+    closures and executing them against this worker's device."""
+
+    def __init__(self, worker_index: int, cluster: "Cluster", device=None):
+        self.worker_index = worker_index
+        self.cluster = cluster
+        self.device = device
+        self.failures = 0
+        self._stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._process_queue, daemon=True,
+            name=f"dtx-worker-{worker_index}")
+        self.thread.start()
+
+    @contextlib.contextmanager
+    def device_scope(self):
+        if self.device is not None:
+            with jax.default_device(self.device):
+                yield
+        else:
+            yield
+
+    def _process_queue(self):
+        # ≙ Worker._process_queue (:1173)
+        queue = self.cluster.closure_queue
+        while not self._stop.is_set():
+            closure = queue.get(timeout=0.2)
+            if closure is None:
+                continue
+            self._process_closure(closure, queue)
+
+    def _process_closure(self, closure: Closure, queue):
+        try:
+            with self.cluster.coordinator_metrics.closure_execution.time():
+                closure.execute_on(self)
+            queue.mark_finished(closure)
+        except WorkerPreemptionError:
+            # ≙ WorkerPreemptionHandler.wait_on_failure (:879): transparent
+            # retry on another worker; this lane backs off
+            self.failures += 1
+            queue.put_back(closure)
+        except PSUnavailableError as e:
+            closure.output._set_error(e)
+            queue.mark_failed(e)
+        except BaseException as e:  # application error -> surface to user
+            e.__traceback__ = e.__traceback__
+            closure.output._set_error(e)
+            queue.mark_failed(e)
+
+    def stop(self):
+        self._stop.set()
+
+
+class Cluster:
+    """Owns workers + the closure queue (≙ cluster_coordinator.py:1247)."""
+
+    def __init__(self, num_workers: int, devices=None):
+        self.closure_queue = _CoordinatedClosureQueue()
+        self.coordinator_metrics = metric_utils.CoordinatorMetrics()
+        if devices is None:
+            local = jax.local_devices()
+            devices = [local[i % len(local)] for i in range(num_workers)]
+        self.workers = [Worker(i, self, devices[i])
+                        for i in range(num_workers)]
+
+    def schedule(self, fn, args, kwargs) -> RemoteValue:
+        closure = Closure(fn, args, kwargs)
+        self.closure_queue.put(closure)
+        return closure.output
+
+    def join(self, timeout=None):
+        self.closure_queue.wait(timeout)
+
+    def done(self) -> bool:
+        return self.closure_queue.done()
+
+    def stop(self):
+        self.closure_queue.stop()
+        for w in self.workers:
+            w.stop()
+
+
+class ClusterCoordinator:
+    """Async training driver (≙ cluster_coordinator.py:1399).
+
+    ``schedule`` enqueues ``fn`` for any free worker and returns a
+    ``RemoteValue``; ``join`` blocks until all scheduled closures ran.
+    Worker preemption is retried transparently; application errors cancel
+    the queue and re-raise at ``schedule``/``join`` — exactly the reference
+    contract.
+    """
+
+    def __init__(self, strategy=None, num_workers: int | None = None,
+                 devices=None, watchdog_timeout: float = 300.0):
+        self.strategy = strategy
+        if num_workers is None:
+            resolver = getattr(strategy, "cluster_resolver", None)
+            if resolver is not None and resolver.cluster_spec():
+                num_workers = resolver.cluster_spec().num_tasks("worker") or 1
+            else:
+                num_workers = len(jax.local_devices())
+        self.cluster = Cluster(num_workers, devices)
+        self._per_worker_resources: list = []
+        self._watchdog = WatchDog(timeout=watchdog_timeout)
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.cluster.workers)
+
+    def schedule(self, fn: Callable, args=(), kwargs=None) -> RemoteValue:
+        self._watchdog.report_activity()
+        return self.cluster.schedule(fn, args, kwargs)
+
+    def join(self, timeout: float | None = None):
+        self._watchdog.report_activity()
+        self.cluster.join(timeout)
+
+    def done(self) -> bool:
+        return self.cluster.done()
+
+    def fetch(self, values, timeout: float | None = None):
+        """Fetch RemoteValue(s) (structure-preserving)."""
+        return jax.tree_util.tree_map(
+            lambda v: v.fetch(timeout) if isinstance(v, RemoteValue) else v,
+            values, is_leaf=lambda v: isinstance(v, RemoteValue))
+
+    def create_per_worker_dataset(self, dataset_fn: Callable) -> PerWorkerValues:
+        """≙ create_per_worker_dataset (:1604): one iterator per worker."""
+        iters = []
+        for i in range(self.num_workers):
+            ds = dataset_fn()
+            iters.append(iter(ds))
+        return PerWorkerValues(iters)
+
+    def create_per_worker_resource(self, resource_fn: Callable) -> PerWorkerValues:
+        vals = PerWorkerValues([resource_fn() for _ in range(self.num_workers)])
+        self._per_worker_resources.append(vals)
+        return vals
+
+    def shutdown(self):
+        self.cluster.stop()
+        self._watchdog.stop()

@@ -1,0 +1,97 @@
+"""Coordinator metrics: counters and timers.
+
+≙ tensorflow/python/distribute/coordinator/metric_utils.py (SURVEY.md §2.5,
+:89 ``monitored_timer``) and the tf.monitoring gauges in distribute_lib
+(SURVEY §5.5). Plain-Python instruments: thread-safe, inspectable, no
+backend dependency.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """≙ tf.monitoring StringGauge/IntGauge (distribution_strategy_gauge)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Timer:
+    """Accumulating timer (≙ monitored_timer, metric_utils.py:89)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._total = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def time(self):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - start
+            with self._lock:
+                self._total += dt
+                self._count += 1
+
+    @property
+    def total_seconds(self) -> float:
+        with self._lock:
+            return self._total
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def average_seconds(self) -> float:
+        with self._lock:
+            return self._total / self._count if self._count else 0.0
+
+
+class CoordinatorMetrics:
+    """The queued/inflight/execution instrument set (≙ metric_utils.py)."""
+
+    def __init__(self):
+        self.closure_execution = Timer("closure_execution")
+        self.remote_value_fetch = Timer("remote_value_fetch")
+        self.queued = Gauge("queued_closures")
+        self.inflight = Gauge("inflight_closures")
+
+# global gauges ≙ distribution_strategy_gauge (distribute_lib.py top)
+strategy_gauge = Gauge("distribution_strategy")
+replica_gauge = Gauge("num_replicas")

@@ -1,0 +1,173 @@
+"""ClusterCoordinator tests incl. fault injection.
+
+≙ the reference's coordinator tests + fault_tolerance_test_base pattern
+(SURVEY.md §4): worker "preemption" retries transparently; application
+errors surface at join(); PS loss is fatal.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu.coordinator import (
+    ClusterCoordinator,
+    PerWorkerValues,
+    PSUnavailableError,
+    RemoteValue,
+    WorkerPreemptionError,
+)
+
+
+@pytest.fixture()
+def coord(devices):
+    c = ClusterCoordinator(num_workers=4)
+    yield c
+    c.shutdown()
+
+
+def test_schedule_and_fetch(coord):
+    rv = coord.schedule(lambda x: x * 2, args=(21,))
+    assert coord.fetch(rv) == 42
+
+
+def test_schedule_many_join(coord):
+    results = [coord.schedule(lambda i=i: i * i) for i in range(32)]
+    coord.join()
+    assert coord.done()
+    assert [r.fetch() for r in results] == [i * i for i in range(32)]
+
+
+def test_parallel_dispatch_uses_multiple_workers(coord):
+    seen = set()
+    lock = threading.Lock()
+
+    def fn():
+        with lock:
+            seen.add(threading.current_thread().name)
+        time.sleep(0.05)
+
+    for _ in range(16):
+        coord.schedule(fn)
+    coord.join()
+    assert len(seen) > 1  # really dispatched across lanes
+
+
+def test_worker_preemption_retries(coord):
+    """First two executions die like a preempted worker; closure still
+    completes on retry (≙ wait_on_failure/put_back, :879/:514)."""
+    attempts = {"n": 0}
+    lock = threading.Lock()
+
+    def flaky():
+        with lock:
+            attempts["n"] += 1
+            if attempts["n"] <= 2:
+                raise WorkerPreemptionError("worker gone")
+        return "ok"
+
+    rv = coord.schedule(flaky)
+    assert rv.fetch(timeout=10) == "ok"
+    assert attempts["n"] == 3
+    coord.join()
+
+
+def test_application_error_propagates(coord):
+    def boom():
+        raise ValueError("bad step")
+
+    rv = coord.schedule(boom)
+    with pytest.raises(ValueError, match="bad step"):
+        rv.fetch(timeout=10)
+    # queue poisoned -> join surfaces the error once
+    with pytest.raises(ValueError):
+        coord.join()
+    # after the error is consumed the coordinator is usable again
+    rv2 = coord.schedule(lambda: 1)
+    assert rv2.fetch(timeout=10) == 1
+
+
+def test_ps_unavailable_fatal(coord):
+    def lose_ps():
+        raise PSUnavailableError("ps0 lost")
+
+    rv = coord.schedule(lose_ps)
+    with pytest.raises(PSUnavailableError):
+        rv.fetch(timeout=10)
+    with pytest.raises(PSUnavailableError):
+        coord.join()
+
+
+def test_per_worker_values(coord):
+    pw = PerWorkerValues([f"res{i}" for i in range(4)])
+
+    def fn(res):
+        return res
+
+    outs = {coord.schedule(fn, args=(pw,)).fetch(timeout=10)
+            for _ in range(12)}
+    assert outs <= {f"res{i}" for i in range(4)}
+    assert len(outs) >= 2
+
+
+def test_per_worker_dataset(coord):
+    pwds = coord.create_per_worker_dataset(
+        lambda: dtx.Dataset.range(100).batch(4))
+    rv = coord.schedule(lambda it: np.asarray(next(it)).sum(), args=(pwds,))
+    assert rv.fetch(timeout=10) == 0 + 1 + 2 + 3
+
+
+def test_async_training_loop_with_sharded_vars(devices):
+    """Mini PS training: sharded embedding + async closure updates."""
+    strategy = dtx.ParameterServerStrategy()
+    coord = ClusterCoordinator(strategy, num_workers=2)
+    try:
+        with strategy.scope():
+            from distributed_tensorflow_tpu.parallel.sharded_variable import (
+                FixedShardsPartitioner)
+            strategy.variable_partitioner = FixedShardsPartitioner(8)
+            emb = strategy.create_variable(np.zeros((32, 4)), name="emb")
+
+        lock = threading.Lock()
+
+        def train_step(rows):
+            with lock:  # host-side PS update must be atomic
+                emb.assign(np.asarray(emb.read_value()) +
+                           np.eye(32, 4)[rows].sum(0) * 0)
+                emb.assign_add(np.ones((32, 4)) * 0.5)
+            return 1
+
+        rvs = [coord.schedule(train_step, args=([i],)) for i in range(4)]
+        coord.join()
+        assert sum(rv.fetch() for rv in rvs) == 4
+        np.testing.assert_allclose(np.asarray(emb.read_value()),
+                                   np.full((32, 4), 2.0))
+    finally:
+        coord.shutdown()
+
+
+def test_watchdog_triggers():
+    import io
+    from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+    buf = io.StringIO()
+    fired = threading.Event()
+    w = WatchDog(timeout=0.3, on_triggered=fired.set, output=buf)
+    assert fired.wait(5)
+    w.stop()
+    assert w.triggered_count >= 1
+
+
+def test_metrics():
+    from distributed_tensorflow_tpu.coordinator.metric_utils import (
+        Counter, Timer)
+    c = Counter("c")
+    c.increment()
+    c.increment(2)
+    assert c.value == 3
+    t = Timer("t")
+    with t.time():
+        time.sleep(0.01)
+    assert t.count == 1
+    assert t.total_seconds > 0.005
