@@ -1,0 +1,115 @@
+"""Headline benchmark: flagship Transformer LM training throughput.
+
+Runs the full bf16 train step (flash attention + remat + adamw) on the
+available accelerator and prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Timing methodology (important over the axon tunnel, where dispatch is
+async and `block_until_ready` can return early): the train step runs
+inside an on-device `lax.fori_loop`; we time a 1-iteration and an
+(N+1)-iteration compiled loop, min-of-reps, and take the delta — tunnel
+RTT and dispatch overhead cancel out.
+
+`vs_baseline`: BASELINE.md records no published reference numbers (the
+reference mount was empty — see SURVEY.md §0), so the baseline is defined
+as 40% MFU on the chip's peak bf16 FLOPs, a strong hand-tuned-reference
+proxy for transformer pretraining. vs_baseline = measured_MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, make_optimizer, make_train_step,
+    synthetic_tokens)
+
+# Peak bf16 TFLOP/s per chip by platform (v5e = 197).
+PEAK_TFLOPS = {"tpu": 197.0, "cpu": 1.0}
+BASELINE_MFU = 0.40
+
+
+def param_count(params):
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def main():
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig.transformer_big(max_seq_len=1024)
+        batch, n_iters, reps = 16, 20, 5
+    else:  # local smoke run
+        cfg = TransformerConfig.tiny()
+        batch, n_iters, reps = 8, 5, 2
+
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    rng = jax.random.PRNGKey(0)
+    tokens = synthetic_tokens(batch, cfg.max_seq_len, cfg.vocab_size)
+
+    @jax.jit
+    def init_fn(rng):
+        params = model.init(rng, tokens)["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    state = jax.block_until_ready(init_fn(rng))
+    n_params = param_count(state["params"])
+
+    step = make_train_step(cfg, model, tx)
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def loop(state, batch_tokens, n):
+        def body(_, s):
+            s2, _metrics = step(s, {"tokens": batch_tokens})
+            return s2
+        return jax.lax.fori_loop(0, n, body, state)
+
+    def timed(n):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = loop(state, tokens, n)
+            float(out["step"])        # scalar readback = true completion
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # Warm both compilations.
+    jax.block_until_ready(loop(state, tokens, 1))
+    jax.block_until_ready(loop(state, tokens, 1 + n_iters))
+
+    dt = (timed(1 + n_iters) - timed(1)) / n_iters
+    tokens_per_step = batch * cfg.max_seq_len
+    tokens_per_sec = tokens_per_step / dt
+
+    # Model FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term.
+    attn_flops = (cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2
+                  * cfg.d_model * 0.5)
+    step_flops = 6 * n_params * tokens_per_step + attn_flops
+    mfu = (step_flops / dt) / (PEAK_TFLOPS.get(backend, 1.0) * 1e12)
+
+    result = {
+        "metric": "transformer_big_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "extra": {
+            "backend": backend,
+            "params_millions": round(n_params / 1e6, 1),
+            "step_time_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 4),
+            "global_batch": batch,
+            "seq_len": cfg.max_seq_len,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,7 @@ class Checkpoint:
         self._objects = objects
         self._save_counter = 0
         self._async_thread: threading.Thread | None = None
+        self._async_error: BaseException | None = None
 
     @property
     def save_counter(self) -> int:
@@ -97,17 +98,25 @@ class Checkpoint:
         index: dict[str, Any] = {"leaves": {}, "format": 1}
         host_arrays: dict[str, np.ndarray] = {}
         for name, leaf in flat.items():
-            arr, meta = self._extract(name, leaf)
+            arr, meta, offset = self._extract(name, leaf)
             index["leaves"][name] = meta
             if arr is not None:
-                host_arrays[self._fname(name)] = arr
+                key = self._fname(name)
+                host_arrays[key] = arr
+                if offset is not None:
+                    host_arrays[key + "::off"] = np.asarray([offset],
+                                                            dtype=np.int64)
 
         def finish():
-            np.savez(os.path.join(tmp, f"shard_{proc}.npz"), **host_arrays)
-            if proc == 0:
-                with open(os.path.join(tmp, _INDEX_FILE), "w") as f:
-                    json.dump(index, f)
-            self._commit(tmp, path)
+            try:
+                np.savez(os.path.join(tmp, f"shard_{proc}.npz"),
+                         **host_arrays)
+                if proc == 0:
+                    with open(os.path.join(tmp, _INDEX_FILE), "w") as f:
+                        json.dump(index, f)
+                self._commit(tmp, path)
+            except BaseException as e:   # surfaced on next sync/save/restore
+                self._async_error = e
 
         if async_write:
             # device->host already done above (np arrays); file IO async
@@ -129,6 +138,9 @@ class Checkpoint:
     def _join_pending(self):
         if self._async_thread is not None and self._async_thread.is_alive():
             self._async_thread.join()
+        if self._async_error is not None:
+            err, self._async_error = self._async_error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
     def sync(self):
         """Block until any async write completed (≙ AsyncCheckpoint sync)."""
@@ -153,19 +165,24 @@ class Checkpoint:
                           for s in val.addressable_shards if s.replica_id == 0]
                 meta["kind"] = "sharded_variable"
                 meta["slices"] = [self._slice_meta(idx) for idx, _ in shards]
-                arr = None
+                arr, offset = None, None
                 if shards:
+                    shards = sorted(
+                        shards, key=lambda t: (t[0][0].start or 0))
                     arr = np.concatenate(
                         [a for _, a in shards], axis=0) \
                         if len(shards) > 1 else shards[0][1]
-                return arr, meta
+                    # This process's global axis-0 offset: restore orders
+                    # parts by it (file order is NOT slice order).
+                    offset = shards[0][0][0].start or 0
+                return arr, meta, offset
             if jax.process_index() == 0:
-                return np.asarray(val), meta
-            return None, meta
+                return np.asarray(val), meta, None
+            return None, meta, None
         arr = np.asarray(leaf)
         meta = {"kind": "array", "shape": list(arr.shape),
                 "dtype": str(arr.dtype)}
-        return (arr if jax.process_index() == 0 else None), meta
+        return (arr if jax.process_index() == 0 else None), meta, None
 
     @staticmethod
     def _slice_meta(index) -> list:
@@ -187,8 +204,11 @@ class Checkpoint:
         with open(index_path) as f:
             index = json.load(f)
         shards = {}
-        for f_name in sorted(os.listdir(path)):
-            if f_name.startswith("shard_") and f_name.endswith(".npz"):
+        shard_pat = re.compile(r"shard_(\d+)\.npz$")
+        for f_name in sorted(os.listdir(path),
+                             key=lambda n: (int(shard_pat.match(n).group(1))
+                                            if shard_pat.match(n) else -1)):
+            if shard_pat.match(f_name):
                 shards[f_name] = np.load(os.path.join(path, f_name))
 
         def lookup(name):
@@ -196,10 +216,13 @@ class Checkpoint:
             parts = []
             for shard in shards.values():
                 if key in shard.files:
-                    parts.append(shard[key])
+                    off = (int(shard[key + "::off"][0])
+                           if key + "::off" in shard.files else 0)
+                    parts.append((off, shard[key]))
             if not parts:
                 raise KeyError(f"Leaf {name!r} missing from checkpoint {path}")
-            return parts
+            parts.sort(key=lambda t: t[0])   # slice order, not file order
+            return [a for _, a in parts]
 
         flat = _flatten(self._objects)
         restored = {}
@@ -278,7 +301,10 @@ class CheckpointManager:
         return path
 
     def _sweep(self):
-        cks = self._list_checkpoints()
+        # Pinned checkpoints are permanently out of rotation: they neither
+        # count toward max_to_keep nor get deleted.
+        cks = [(n, p) for n, p in self._list_checkpoints()
+               if p not in self._kept_pinned]
         now = time.time()
         while len(cks) > self.max_to_keep:
             num, path = cks.pop(0)

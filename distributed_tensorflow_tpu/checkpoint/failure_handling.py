@@ -161,9 +161,12 @@ class PreemptionCheckpointHandler:
             self._manager.save(checkpoint_number=self._step +
                                self._run_count_restored)
             self._manager.checkpoint.sync()
-        # grace-period countdown (≙ failure_handling.py:1204)
-        remaining = deadline - time.time()
-        if remaining > 0:
+        # grace-period countdown (≙ failure_handling.py:1204): wait out
+        # the full window in small slices so tests can interrupt.
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
             time.sleep(min(remaining, 0.1))
         self._exited = True
         if self._config.exit_fn is not None:

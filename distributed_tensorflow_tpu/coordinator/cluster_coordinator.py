@@ -184,8 +184,9 @@ class _CoordinatedClosureQueue:
 
     def get(self, timeout: float | None = None) -> Closure | None:
         with self._cv:
-            self._cv.wait_for(
-                lambda: self._queue or self._cancelled, timeout)
+            # Block on work arriving; a cancelled-and-drained queue must
+            # still wait out the timeout (not spin hot in worker threads).
+            self._cv.wait_for(lambda: bool(self._queue), timeout)
             if not self._queue:
                 return None
             closure = self._queue.pop(0)
@@ -283,7 +284,6 @@ class Worker:
             closure.output._set_error(e)
             queue.mark_failed(e)
         except BaseException as e:  # application error -> surface to user
-            e.__traceback__ = e.__traceback__
             closure.output._set_error(e)
             queue.mark_failed(e)
 

@@ -1,0 +1,113 @@
+"""Flagship Transformer: sharding, training, and parallelism equivalence.
+
+The key correctness property (mirroring the reference's
+keras_correctness_test_base.py pattern, SURVEY.md §4): the same model
+trained on a dp×fsdp×tp mesh matches single-device training step-for-step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.topology import make_mesh
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, make_optimizer, make_train_step,
+    make_sharded_train_step, synthetic_tokens)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def batch(cfg):
+    return {"tokens": synthetic_tokens(8, cfg.max_seq_len, cfg.vocab_size)}
+
+
+def _single_device_losses(cfg, batch, n_steps, seed=0):
+    from flax.linen import partitioning as nn_partitioning
+    from distributed_tensorflow_tpu.models.transformer import (
+        LOGICAL_AXIS_RULES)
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    with nn_partitioning.axis_rules(list(LOGICAL_AXIS_RULES)):
+        params = model.init(jax.random.PRNGKey(seed), batch["tokens"])[
+            "params"]
+        state = {"params": params, "opt_state": tx.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        step = jax.jit(make_train_step(cfg, model, tx))
+        losses = []
+        for _ in range(n_steps):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("axes", [
+    {"dp": 8},
+    {"dp": 2, "fsdp": 2, "tp": 2},
+    {"fsdp": 4, "tp": 2},
+])
+def test_sharded_training_matches_single_device(cfg, batch, axes, devices):
+    mesh = make_mesh(axes)
+    state, step = make_sharded_train_step(cfg, mesh, global_batch=8)
+    sharded_losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        sharded_losses.append(float(m["loss"]))
+    single = _single_device_losses(cfg, batch, 3)
+    np.testing.assert_allclose(sharded_losses, single, rtol=2e-4,
+                               err_msg=f"mesh {axes} diverged from "
+                                       f"single-device")
+
+
+def test_loss_decreases(cfg, batch, devices):
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    state, step = make_sharded_train_step(cfg, mesh, global_batch=8)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert abs(losses[0] - np.log(cfg.vocab_size)) < 1.0, (
+        "initial loss should be near ln(vocab)")
+
+
+def test_param_shardings_cover_mesh(cfg, devices):
+    """fsdp/tp axes must actually shard the big matrices."""
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    state, _ = make_sharded_train_step(cfg, mesh, global_batch=8)
+
+    def named(tree, prefix=""):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out.update(named(v, prefix + k + "/"))
+            else:
+                out[prefix + k] = v
+        return out
+
+    flat = named(state["params"])
+    # MLP hidden is tp-sharded, embed axis fsdp-sharded.
+    spec = tuple(flat["layers/mlp/wi"].sharding.spec)
+    assert "tp" in spec, spec
+    assert "fsdp" in spec, spec
+    # Embedding: vocab over tp, embed over fsdp.
+    assert tuple(flat["embed"].sharding.spec) == ("tp", "fsdp")
+
+
+def test_encoder_mode(cfg, devices):
+    """causal=False gives bidirectional attention (BERT encoder mode)."""
+    enc_cfg = TransformerConfig.tiny(causal=False)
+    model = TransformerLM(enc_cfg)
+    from flax.linen import partitioning as nn_partitioning
+    from distributed_tensorflow_tpu.models.transformer import (
+        LOGICAL_AXIS_RULES)
+    tokens = synthetic_tokens(2, enc_cfg.max_seq_len, enc_cfg.vocab_size)
+    with nn_partitioning.axis_rules(list(LOGICAL_AXIS_RULES)):
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, enc_cfg.max_seq_len, enc_cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
