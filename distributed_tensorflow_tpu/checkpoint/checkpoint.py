@@ -108,23 +108,26 @@ class Checkpoint:
                                                             dtype=np.int64)
 
         def finish():
+            np.savez(os.path.join(tmp, f"shard_{proc}.npz"), **host_arrays)
+            if proc == 0:
+                with open(os.path.join(tmp, _INDEX_FILE), "w") as f:
+                    json.dump(index, f)
+            self._commit(tmp, path)
+
+        def finish_async():
             try:
-                np.savez(os.path.join(tmp, f"shard_{proc}.npz"),
-                         **host_arrays)
-                if proc == 0:
-                    with open(os.path.join(tmp, _INDEX_FILE), "w") as f:
-                        json.dump(index, f)
-                self._commit(tmp, path)
+                finish()
             except BaseException as e:   # surfaced on next sync/save/restore
                 self._async_error = e
 
         if async_write:
             # device->host already done above (np arrays); file IO async
             self._join_pending()
-            self._async_thread = threading.Thread(target=finish, daemon=True)
+            self._async_thread = threading.Thread(target=finish_async,
+                                                  daemon=True)
             self._async_thread.start()
         else:
-            finish()
+            finish()                     # sync path: raise right here
         return path
 
     def _commit(self, tmp: str, path: str):
@@ -169,6 +172,12 @@ class Checkpoint:
                 if shards:
                     shards = sorted(
                         shards, key=lambda t: (t[0][0].start or 0))
+                    for (ia, _), (ib, _) in zip(shards, shards[1:]):
+                        if (ia[0].stop or 0) != (ib[0].start or 0):
+                            raise NotImplementedError(
+                                f"process owns non-contiguous axis-0 slices "
+                                f"of {name!r} ({ia[0]} then {ib[0]}); "
+                                f"restore would permute rows silently")
                     arr = np.concatenate(
                         [a for _, a in shards], axis=0) \
                         if len(shards) > 1 else shards[0][1]

@@ -71,6 +71,11 @@ class TransformerConfig:
     attention_impl: str | None = None   # None = auto (pallas on TPU)
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
+    # Sequence/context parallelism: when mesh (threaded in by
+    # make_sharded_train_step) has an "sp" axis > 1, attention runs as
+    # ring attention over it (parallel/sequence_parallel.py).
+    mesh: Any = None
+    sp_impl: str = "ring"             # "ring" | "ulysses"
 
     @property
     def head_dim(self) -> int:
@@ -150,8 +155,22 @@ class MultiHeadAttention(nn.Module):
 
         # (B, H, S, hd) for the fused kernel.
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        o = flash_attention(q, k, v, causal=cfg.causal,
-                            implementation=cfg.attention_impl)
+        mesh = cfg.mesh
+        if (mesh is not None and "sp" in mesh.shape
+                and mesh.shape["sp"] > 1):
+            # Sequence-parallel path: ring attention over the sp axis
+            # (reference has no SP at all — SURVEY.md §5.7).
+            from distributed_tensorflow_tpu.parallel.sequence_parallel \
+                import make_ring_attention
+            batch_axes = tuple(a for a in ("dp", "fsdp")
+                               if a in mesh.shape) or None
+            head_axis = "tp" if "tp" in mesh.shape else None
+            spec = P(batch_axes, head_axis, "sp", None)
+            o = make_ring_attention(mesh, causal=cfg.causal,
+                                    impl=cfg.sp_impl, spec=spec)(q, k, v)
+        else:
+            o = flash_attention(q, k, v, causal=cfg.causal,
+                                implementation=cfg.attention_impl)
         o = o.transpose(0, 2, 1, 3)        # (B, S, H, hd)
 
         out_kernel = param_with_axes(
@@ -332,6 +351,8 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     mesh — the TPU-native replacement for the reference's
     CrossDeviceOps.batch_reduce (cross_device_ops.py:871).
     """
+    if "sp" in mesh.shape and mesh.shape["sp"] > 1 and cfg.mesh is None:
+        cfg = dataclasses.replace(cfg, mesh=mesh)
     model = TransformerLM(cfg)
     tx = make_optimizer(cfg)
     rng = jax.random.PRNGKey(seed)

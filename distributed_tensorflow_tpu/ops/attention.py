@@ -52,6 +52,31 @@ def mha_reference(q, k, v, *, causal: bool = False, sm_scale: float | None = Non
 # Forward kernel
 # ---------------------------------------------------------------------------
 
+def _block_mask(qb, kb, block_q, block_k, *, causal, causal_offset,
+                q_limit=None, k_limit=None):
+    """Validity mask for one (q-block, k-block) tile, or None if nothing
+    needs masking. Shared by forward and both backward kernels so causal
+    alignment and tail padding stay in lockstep across fwd/bwd.
+
+    causal: bottom-right aligned — query i sees key j iff
+    j <= i + causal_offset (offset = kv_len - q_len).
+    q_limit/k_limit: true (unpadded) lengths; rows/cols past them are
+    zero-padding and must not contribute.
+    """
+    if not causal and q_limit is None and k_limit is None:
+        return None
+    q_ids = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_ids = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = (q_ids + causal_offset >= k_ids) if causal else (q_ids >= 0)
+    if q_limit is not None:
+        valid = valid & (q_ids < q_limit)
+    if k_limit is not None:
+        valid = valid & (k_ids < k_limit)
+    return valid
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref,          # inputs (blocked)
                 o_ref, lse_ref,               # outputs
                 m_scr, l_scr, acc_scr,        # VMEM scratch
@@ -87,18 +112,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # inputs (blocked)
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
 
-        needs_kv_mask = kv_len % block_k != 0
-        if causal or needs_kv_mask:
-            q_ids = (qb * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 0))
-            k_ids = (kb * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32,
-                                                (block_q, block_k), 1))
-            valid = ((q_ids + causal_offset >= k_ids) if causal
-                     else (q_ids >= 0))
-            if needs_kv_mask:        # mask the padded kv tail
-                valid = valid & (k_ids < kv_len)
+        valid = _block_mask(
+            qb, kb, block_q, block_k, causal=causal,
+            causal_offset=causal_offset,
+            k_limit=kv_len if kv_len % block_k != 0 else None)
+        if valid is not None:
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_scr[:]                  # (block_q, 1)
@@ -201,16 +219,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        needs_kv_mask = kv_len % block_k != 0
-        if causal or needs_kv_mask:
-            q_ids = (qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            k_ids = (kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
-            valid = ((q_ids + causal_offset >= k_ids) if causal
-                     else (q_ids >= 0))
-            if needs_kv_mask:
-                valid = valid & (k_ids < kv_len)
+        valid = _block_mask(
+            qb, kb, block_q, block_k, causal=causal,
+            causal_offset=causal_offset,
+            k_limit=kv_len if kv_len % block_k != 0 else None)
+        if valid is not None:
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)               # (block_q, block_k)
         dp = jax.lax.dot_general(do, vv.astype(jnp.float32),
@@ -253,16 +266,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        needs_q_mask = q_len % block_q != 0
-        if causal or needs_q_mask:
-            q_ids = (qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0))
-            k_ids = (kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1))
-            valid = ((q_ids + causal_offset >= k_ids) if causal
-                     else (k_ids >= 0))
-            if needs_q_mask:       # padded q rows must not contribute
-                valid = valid & (q_ids < q_len)
+        valid = _block_mask(
+            qb, kb, block_q, block_k, causal=causal,
+            causal_offset=causal_offset,
+            q_limit=q_len if q_len % block_q != 0 else None)
+        if valid is not None:
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dv_scr[:] += jax.lax.dot_general(

@@ -1,0 +1,190 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has NO sequence-parallel support (SURVEY.md §5.7: no ring
+attention, Ulysses, or blockwise attention anywhere in its tree — only the
+raw ``collective_permute``/``all_to_all`` ops, reference
+tensorflow/python/tpu/ops/tpu_ops.py:111/:43). Long-context training is a
+capability gap the TPU-native framework fills as a first-class feature:
+
+- **Ring attention** (`ring_attention`): each device holds a sequence
+  chunk of Q/K/V; K/V blocks rotate around the "sp" ring via
+  ``jax.lax.ppermute`` over ICI while each device accumulates flash-style
+  online softmax over the blocks it sees. Memory stays O(S/n) per device;
+  comm overlaps compute under XLA latency hiding. Causal masking uses
+  block-position logic so each device does ~half the work, like the
+  single-chip causal kernel.
+
+- **Ulysses** (`ulysses_attention`): all-to-all re-shard — heads gather
+  the full sequence, attention runs locally per head subset, then
+  re-shard back. Better when n_heads >= ring size and ICI all-to-all
+  bandwidth beats ring latency.
+
+Both are pure shard_map-region functions: call them inside
+``shard_map``/``pjit`` with the sequence axis sharded over "sp".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.ops.attention import (
+    DEFAULT_MASK_VALUE, flash_attention, mha_reference)
+
+
+def _local_attn_stats(q, k, v, *, sm_scale, mask=None):
+    """Local attention block returning (out_unnormalized, m, l) for
+    online-softmax combination across ring steps.
+
+    q: (b, h, sq, d); k/v: (b, h, sk, d). mask: broadcastable (sq, sk).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)          # (b,h,sq,1)
+    # Guard fully-masked rows (exp would overflow at MASK - MASK).
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)          # (b,h,sq,1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   sm_scale: float | None = None):
+    """Ring attention over the ``axis_name`` mesh axis (shard_map region).
+
+    Inputs are the LOCAL sequence chunks (b, h, s_local, d); output is the
+    local chunk of the attention result, numerically identical to full
+    attention over the gathered sequence.
+
+    ≙ capability gap in the reference (SURVEY.md §5.7); comm primitive ≙
+    collective_permute (tpu_ops.py:111) lowered to XLA CollectivePermute
+    over ICI.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]     # ring: i -> i+1
+
+    def mask_for(src_idx):
+        """Causal mask between my q chunk and the k chunk from src_idx."""
+        if not causal:
+            return None
+        q_ids = my_idx * s_local + jax.lax.broadcasted_iota(
+            jnp.int32, (s_local, s_local), 0)
+        k_ids = src_idx * s_local + jax.lax.broadcasted_iota(
+            jnp.int32, (s_local, s_local), 1)
+        return q_ids >= k_ids
+
+    # Online-softmax accumulators.
+    o_acc = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m_acc = jnp.full(q.shape[:3] + (1,), -jnp.inf, jnp.float32)
+    l_acc = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src_idx = (my_idx - step) % n                # owner of current k/v
+        if causal:
+            # Skip blocks strictly in the future: src chunk entirely after
+            # my chunk. With equal chunk sizes that is src_idx > my_idx.
+            relevant = src_idx <= my_idx
+        else:
+            relevant = None
+
+        o_b, m_b, l_b = _local_attn_stats(q, k_cur, v_cur,
+                                          sm_scale=sm_scale,
+                                          mask=mask_for(src_idx))
+        if relevant is not None:
+            # Zero-out contributions from future blocks (traced cond-free).
+            o_b = jnp.where(relevant, o_b, 0.0)
+            l_b = jnp.where(relevant, l_b, 0.0)
+            m_b = jnp.where(relevant, m_b, -jnp.inf)
+
+        m_new = jnp.maximum(m_acc, m_b)
+        # exp(-inf - -inf) guard: where both -inf, keep 0 contribution.
+        alpha = jnp.where(jnp.isinf(m_acc) & (m_acc < 0), 0.0,
+                          jnp.exp(m_acc - jnp.where(jnp.isinf(m_new),
+                                                    0.0, m_new)))
+        beta = jnp.where(jnp.isinf(m_b) & (m_b < 0), 0.0,
+                         jnp.exp(m_b - jnp.where(jnp.isinf(m_new),
+                                                 0.0, m_new)))
+        o_acc = o_acc * alpha + o_b * beta
+        l_acc = l_acc * alpha + l_b * beta
+        m_acc = m_new
+
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    l_safe = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    return (o_acc / l_safe).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False,
+                      sm_scale: float | None = None,
+                      attn_fn: Callable | None = None):
+    """Ulysses-style SP: all-to-all from sequence-sharded to head-sharded,
+    run full-sequence attention on the local head subset, all-to-all back.
+
+    Inputs (b, h, s_local, d) sequence-sharded; requires h % axis_size == 0.
+    ≙ all_to_all op surface (reference tpu_ops.py:43) used for an SP scheme
+    the reference never implemented.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[1]
+    assert h % n == 0, f"heads {h} not divisible by sp={n}"
+
+    def to_heads(x):
+        # (b, h, s/n, d) -> n chunks of heads, gather seq:
+        # all_to_all splits axis 1 (heads) and concats axis 2 (seq).
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # (b, h/n, S, d)
+    if attn_fn is None:
+        out = mha_reference(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return to_seq(out)
+
+
+def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
+                        causal: bool = False, impl: str = "ring",
+                        spec: P | None = None):
+    """Wrap ring/ulysses attention in shard_map for (b, h, S, d) global
+    arrays whose sequence axis is sharded over ``axis_name``.
+
+    ``spec`` describes the full (b, h, S, d) sharding — pass the model's
+    batch/head shardings too when calling inside a dp×tp×sp jit, so
+    shard_map only ring-communicates over ``axis_name``.
+    """
+    fn = ring_attention if impl == "ring" else ulysses_attention
+
+    if spec is None:
+        spec = P(None, None, axis_name, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    def sharded(q, k, v):
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
+
+    return sharded

@@ -49,6 +49,7 @@ def _single_device_losses(cfg, batch, n_steps, seed=0):
     {"dp": 8},
     {"dp": 2, "fsdp": 2, "tp": 2},
     {"fsdp": 4, "tp": 2},
+    {"dp": 2, "sp": 4},      # ring-attention sequence parallelism
 ])
 def test_sharded_training_matches_single_device(cfg, batch, axes, devices):
     mesh = make_mesh(axes)
