@@ -1,0 +1,137 @@
+"""BERT-style MLM pretraining — benchmark workload #3
+(BASELINE.md: CollectiveAllReduceStrategy reference).
+
+Reuses the flagship transformer in bidirectional-encoder mode
+(cfg.causal=False) and adds masked-language-model machinery: dynamic
+masking, masked-position cross-entropy, and the sharded train step. Where
+the reference runs BERT through `CollectiveAllReduceStrategy` (reference:
+tensorflow/python/distribute/collective_all_reduce_strategy.py:57) with
+collective-V2 allreduce ops, gradients here are psum'd by GSPMD over the
+same dp×fsdp×tp mesh the flagship uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.linen import partitioning as nn_partitioning
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, make_optimizer, mesh_axis_rules,
+    state_shardings_for)
+
+MASK_TOKEN = 1           # convention: [MASK] id
+IGNORE_LABEL = -100
+
+
+def bert_config(**kw) -> TransformerConfig:
+    return TransformerConfig.bert_base(**kw)
+
+
+def tiny_bert_config(**kw) -> TransformerConfig:
+    return TransformerConfig.tiny(causal=False, **kw)
+
+
+def apply_mlm_masking(rng, tokens, *, mask_rate: float = 0.15,
+                      mask_token: int = MASK_TOKEN, vocab_size: int = 256):
+    """BERT 80/10/10 dynamic masking. Returns (input_ids, labels) where
+    labels is IGNORE_LABEL at unmasked positions."""
+    r_select, r_kind, r_rand = jax.random.split(rng, 3)
+    selected = jax.random.uniform(r_select, tokens.shape) < mask_rate
+    kind = jax.random.uniform(r_kind, tokens.shape)
+    random_tokens = jax.random.randint(r_rand, tokens.shape, 0, vocab_size)
+    inputs = jnp.where(selected & (kind < 0.8), mask_token, tokens)
+    inputs = jnp.where(selected & (kind >= 0.8) & (kind < 0.9),
+                       random_tokens, inputs)
+    labels = jnp.where(selected, tokens, IGNORE_LABEL)
+    return inputs, labels
+
+
+def mlm_loss(logits, labels):
+    """Cross-entropy over masked positions only."""
+    mask = labels != IGNORE_LABEL
+    safe_labels = jnp.where(mask, labels, 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, safe_labels)
+    denom = jnp.maximum(mask.sum(), 1)
+    return (losses * mask).sum() / denom
+
+
+def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
+    """(state, batch{tokens, rng}) -> (state, metrics): masking is done
+    on-device inside the step (dynamic masking, fresh every epoch)."""
+
+    def loss_fn(params, inputs, labels):
+        logits = model.apply({"params": params}, inputs)
+        return mlm_loss(logits, labels)
+
+    def train_step(state, batch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), state["step"])
+        inputs, labels = apply_mlm_masking(
+            rng, batch["tokens"], vocab_size=cfg.vocab_size)
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], inputs,
+                                                  labels)
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    return train_step
+
+
+def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
+                            global_batch: int, seed: int = 0):
+    assert not cfg.causal, "BERT requires causal=False (encoder mode)"
+    if "sp" in mesh.shape and mesh.shape["sp"] > 1 and cfg.mesh is None:
+        cfg = dataclasses.replace(cfg, mesh=mesh)
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    rng = jax.random.PRNGKey(seed)
+    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
+
+    state_shardings = state_shardings_for(model, tx, mesh, tokens_shape)
+
+    def init_fn(rng):
+        params = model.init(rng, tokens_shape)["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    replicated = NamedSharding(mesh, P())
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    seq_axis = "sp" if "sp" in mesh.shape else None
+    batch_shardings = {"tokens": NamedSharding(
+        mesh, P(data_axes if data_axes else None, seq_axis))}
+
+    rules = mesh_axis_rules(mesh)
+    step = make_train_step(cfg, model, tx)
+    with mesh, nn_partitioning.axis_rules(rules):
+        state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
+        step_jit = jax.jit(
+            step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(state_shardings, replicated),
+            donate_argnums=(0,))
+
+    def wrapped(state, batch):
+        with mesh, nn_partitioning.axis_rules(rules):
+            return step_jit(state, batch)
+
+    return state, wrapped
+
+
+def synthetic_corpus(global_batch: int, seq_len: int, vocab_size: int,
+                     seed: int = 0):
+    rng = np.random.default_rng(seed)
+    # Zipfian-ish token distribution so MLM has learnable structure.
+    probs = 1.0 / np.arange(2, vocab_size + 2)
+    probs /= probs.sum()
+    toks = rng.choice(vocab_size, size=(global_batch, seq_len), p=probs)
+    return {"tokens": jnp.asarray(toks, jnp.int32)}

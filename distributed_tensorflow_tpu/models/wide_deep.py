@@ -1,0 +1,217 @@
+"""Wide&Deep / DLRM — benchmark workload #4
+(BASELINE.md: ParameterServerStrategy async-PS reference).
+
+The reference shards its embedding tables across parameter servers with
+axis-0 partitioners and looks them up remotely per step (reference:
+tensorflow/python/distribute/sharded_variable.py:843 ``ShardedVariable``,
+:995 ``embedding_lookup``; parameter_server_strategy_v2.py:689 variable
+round-robin). The TPU-native redesign keeps tables *on device*, sharded
+over the mesh's model axis ("tp"), and lets GSPMD turn gather + combine
+into the same partitioned-lookup pattern SparseCore embedding uses
+(reference tpu_embedding_v3.py:498) — no RPC per lookup.
+
+Two training modes:
+- **SPMD sync** (`make_sharded_train_step`): embeddings row-sharded over
+  tp, dense layers replicated, batch over dp. One jit program.
+- **Async PS** (`examples`/coordinator): the ClusterCoordinator schedules
+  steps on workers with host-memory tables via ShardedVariable
+  (parallel/sharded_variable.py) — API-parity path with the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from flax.linen import partitioning as nn_partitioning
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+@dataclasses.dataclass(frozen=True)
+class WideDeepConfig:
+    vocab_sizes: tuple = (1000, 1000, 500, 100)   # one per categorical col
+    embed_dim: int = 32
+    num_dense_features: int = 13
+    mlp_dims: tuple = (256, 128, 64)
+    dtype: Any = jnp.float32
+    learning_rate: float = 1e-3
+    # "dot" = DLRM pairwise feature interaction; "concat" = Wide&Deep
+    interaction: str = "concat"
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_sizes=(64, 64, 32), embed_dim=8,
+                        num_dense_features=4, mlp_dims=(32, 16))
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def dlrm_like(cls, **kw):
+        defaults = dict(vocab_sizes=(int(1e5),) * 26, embed_dim=64,
+                        num_dense_features=13, mlp_dims=(512, 256, 128),
+                        interaction="dot")
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+# Logical axes: embedding rows shard over the model axis, the TPU-native
+# form of the reference's axis-0 PS sharding (sharded_variable.py:47
+# Partitioner family).
+WIDE_DEEP_RULES = (
+    ("table_rows", "tp"),
+    ("table_cols", None),
+    ("hidden", None),
+    ("features", None),
+)
+
+
+class WideDeep(nn.Module):
+    cfg: WideDeepConfig
+
+    @nn.compact
+    def __call__(self, dense, categorical):
+        """dense: (B, num_dense); categorical: (B, n_tables) int ids."""
+        cfg = self.cfg
+        embs = []
+        wide_logits = []
+        for i, vocab in enumerate(cfg.vocab_sizes):
+            table = param_with_axes(
+                f"table_{i}", nn.initializers.normal(0.01),
+                (vocab, cfg.embed_dim), jnp.float32,
+                axes=("table_rows", "table_cols"))
+            # Row gather — GSPMD partitions this lookup across the tp
+            # shards of the table (SparseCore-style), ≙ reference
+            # sharded_variable.embedding_lookup (:995).
+            embs.append(table[categorical[:, i]])
+            wide = param_with_axes(
+                f"wide_{i}", nn.initializers.zeros, (vocab,), jnp.float32,
+                axes=("table_rows",))
+            wide_logits.append(wide[categorical[:, i]])
+
+        if cfg.interaction == "dot":
+            # DLRM: pairwise dots between embedding vectors + dense proj
+            stacked = jnp.stack(embs, axis=1)          # (B, T, E)
+            inter = jnp.einsum("bte,bse->bts", stacked, stacked)
+            iu = jnp.triu_indices(len(embs), k=1)
+            feats = [inter[:, iu[0], iu[1]], dense]
+        else:
+            feats = embs + [dense]
+        x = jnp.concatenate(feats, axis=-1).astype(cfg.dtype)
+
+        for j, width in enumerate(cfg.mlp_dims):
+            w = param_with_axes(
+                f"mlp_{j}", nn.initializers.lecun_normal(),
+                (x.shape[-1], width), jnp.float32,
+                axes=("features", "hidden"))
+            b = param_with_axes(f"bias_{j}", nn.initializers.zeros,
+                                (width,), jnp.float32, axes=("hidden",))
+            x = nn.relu(jnp.dot(x, w.astype(cfg.dtype)) + b)
+
+        w_out = param_with_axes("out", nn.initializers.lecun_normal(),
+                                (x.shape[-1], 1), jnp.float32,
+                                axes=("features", None))
+        deep_logit = jnp.dot(x, w_out.astype(cfg.dtype))[:, 0]
+        return deep_logit.astype(jnp.float32) + sum(wide_logits)
+
+
+def make_optimizer(cfg: WideDeepConfig):
+    return optax.adagrad(cfg.learning_rate)   # the classic W&D/DLRM choice
+
+
+def make_train_step(cfg: WideDeepConfig, model: WideDeep, tx):
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["dense"],
+                             batch["categorical"])
+        return optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"].astype(jnp.float32)).mean()
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    return train_step
+
+
+def make_sharded_train_step(cfg: WideDeepConfig, mesh: Mesh,
+                            global_batch: int, seed: int = 0):
+    """SPMD: tables row-sharded over tp, batch over dp, one jit program."""
+    model = WideDeep(cfg)
+    tx = make_optimizer(cfg)
+    rng = jax.random.PRNGKey(seed)
+    n_tables = len(cfg.vocab_sizes)
+    dense_shape = jnp.zeros((global_batch, cfg.num_dense_features))
+    cat_shape = jnp.zeros((global_batch, n_tables), jnp.int32)
+
+    rules = [(l, t if (t is None or t in mesh.shape) else None)
+             for l, t in WIDE_DEEP_RULES]
+
+    with nn_partitioning.axis_rules(rules):
+        var_shapes = jax.eval_shape(
+            lambda r: model.init(r, dense_shape, cat_shape), rng)
+        logical = nn_partitioning.get_axis_names(var_shapes["params_axes"])
+        mesh_specs = nn_partitioning.logical_to_mesh(logical)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), mesh_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    if hasattr(param_shardings, "unfreeze"):
+        param_shardings = param_shardings.unfreeze()
+
+    replicated = NamedSharding(mesh, P())
+    # adagrad state mirrors params
+    from distributed_tensorflow_tpu.models.transformer import _shard_like
+    params_treedef = jax.tree_util.tree_structure(var_shapes["params"])
+    opt_shapes = jax.eval_shape(tx.init, var_shapes["params"])
+    opt_shardings = _shard_like(opt_shapes, params_treedef,
+                                param_shardings, replicated)
+    state_shardings = {"params": param_shardings,
+                       "opt_state": opt_shardings, "step": replicated}
+
+    def init_fn(rng):
+        params = model.init(rng, dense_shape, cat_shape)["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape) or None
+    batch_shardings = {
+        "dense": NamedSharding(mesh, P(data_axes)),
+        "categorical": NamedSharding(mesh, P(data_axes)),
+        "label": NamedSharding(mesh, P(data_axes)),
+    }
+
+    step = make_train_step(cfg, model, tx)
+    with mesh, nn_partitioning.axis_rules(rules):
+        state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
+        step_jit = jax.jit(step,
+                           in_shardings=(state_shardings, batch_shardings),
+                           out_shardings=(state_shardings, replicated),
+                           donate_argnums=(0,))
+
+    def wrapped(state, batch):
+        with mesh, nn_partitioning.axis_rules(rules):
+            return step_jit(state, batch)
+
+    return state, wrapped
+
+
+def synthetic_clicks(cfg: WideDeepConfig, n: int, seed: int = 0):
+    """Click-through data where the label depends on feature crosses."""
+    rng = np.random.default_rng(seed)
+    dense = rng.normal(size=(n, cfg.num_dense_features)).astype("float32")
+    cat = np.stack([rng.integers(0, v, size=n) for v in cfg.vocab_sizes],
+                   axis=1).astype("int32")
+    score = dense.mean(1) + 0.3 * np.cos(cat.sum(1))
+    label = (score > np.median(score)).astype("int32")
+    return {"dense": jnp.asarray(dense), "categorical": jnp.asarray(cat),
+            "label": jnp.asarray(label)}

@@ -1,3 +1,5 @@
 """Compute ops: pallas kernels, attention, embeddings, optim utilities."""
 
 from distributed_tensorflow_tpu.parallel import collectives as collective_ops  # re-export
+from distributed_tensorflow_tpu.ops.attention import (  # noqa: F401
+    flash_attention, mha_reference)

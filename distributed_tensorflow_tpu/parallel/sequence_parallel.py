@@ -10,9 +10,10 @@ capability gap the TPU-native framework fills as a first-class feature:
   chunk of Q/K/V; K/V blocks rotate around the "sp" ring via
   ``jax.lax.ppermute`` over ICI while each device accumulates flash-style
   online softmax over the blocks it sees. Memory stays O(S/n) per device;
-  comm overlaps compute under XLA latency hiding. Causal masking uses
-  block-position logic so each device does ~half the work, like the
-  single-chip causal kernel.
+  comm overlaps compute under XLA latency hiding. Causal masking is
+  applied per block pair; compute is NOT skipped for future blocks (the
+  ring synchronizes every step, so wall-clock is set by the last rank
+  regardless — a load-balanced "striped" schedule is future work).
 
 - **Ulysses** (`ulysses_attention`): all-to-all re-shard — heads gather
   the full sequence, attention runs locally per head subset, then
@@ -96,21 +97,13 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     k_cur, v_cur = k, v
     for step in range(n):
         src_idx = (my_idx - step) % n                # owner of current k/v
-        if causal:
-            # Skip blocks strictly in the future: src chunk entirely after
-            # my chunk. With equal chunk sizes that is src_idx > my_idx.
-            relevant = src_idx <= my_idx
-        else:
-            relevant = None
-
+        # Future blocks (src_idx > my_idx under causal) are excluded by
+        # mask_for: the all-False mask yields o_b=0, l_b=0 and a very
+        # negative m_b, which contribute exactly zero through the
+        # alpha/beta combine below.
         o_b, m_b, l_b = _local_attn_stats(q, k_cur, v_cur,
                                           sm_scale=sm_scale,
                                           mask=mask_for(src_idx))
-        if relevant is not None:
-            # Zero-out contributions from future blocks (traced cond-free).
-            o_b = jnp.where(relevant, o_b, 0.0)
-            l_b = jnp.where(relevant, l_b, 0.0)
-            m_b = jnp.where(relevant, m_b, -jnp.inf)
 
         m_new = jnp.maximum(m_acc, m_b)
         # exp(-inf - -inf) guard: where both -inf, keep 0 contribution.
