@@ -19,12 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from flax.linen import partitioning as nn_partitioning
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from distributed_tensorflow_tpu.models.transformer import (
-    TransformerConfig, TransformerLM, make_optimizer, mesh_axis_rules,
-    state_shardings_for)
+    TransformerConfig, TransformerLM, make_optimizer)
 
 MASK_TOKEN = 1           # convention: [MASK] id
 IGNORE_LABEL = -100
@@ -63,16 +61,18 @@ def mlm_loss(logits, labels):
     return (losses * mask).sum() / denom
 
 
-def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
-    """(state, batch{tokens, rng}) -> (state, metrics): masking is done
-    on-device inside the step (dynamic masking, fresh every epoch)."""
+def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
+                    seed: int = 0):
+    """(state, batch{"tokens"}) -> (state, metrics). 80/10/10 masking is
+    applied on-device inside the step, re-drawn per step from
+    fold_in(seed, step) — dynamic masking, fresh every epoch."""
 
     def loss_fn(params, inputs, labels):
         logits = model.apply({"params": params}, inputs)
         return mlm_loss(logits, labels)
 
     def train_step(state, batch):
-        rng = jax.random.fold_in(jax.random.PRNGKey(0), state["step"])
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), state["step"])
         inputs, labels = apply_mlm_masking(
             rng, batch["tokens"], vocab_size=cfg.vocab_size)
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], inputs,
@@ -89,42 +89,14 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
 
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
                             global_batch: int, seed: int = 0):
+    """All sharding/jit wiring is the flagship transformer's — only the
+    per-step loss (MLM with dynamic masking) is swapped in."""
     assert not cfg.causal, "BERT requires causal=False (encoder mode)"
-    if "sp" in mesh.shape and mesh.shape["sp"] > 1 and cfg.mesh is None:
-        cfg = dataclasses.replace(cfg, mesh=mesh)
-    model = TransformerLM(cfg)
-    tx = make_optimizer(cfg)
-    rng = jax.random.PRNGKey(seed)
-    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
-
-    state_shardings = state_shardings_for(model, tx, mesh, tokens_shape)
-
-    def init_fn(rng):
-        params = model.init(rng, tokens_shape)["params"]
-        return {"params": params, "opt_state": tx.init(params),
-                "step": jnp.zeros((), jnp.int32)}
-
-    replicated = NamedSharding(mesh, P())
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
-    seq_axis = "sp" if "sp" in mesh.shape else None
-    batch_shardings = {"tokens": NamedSharding(
-        mesh, P(data_axes if data_axes else None, seq_axis))}
-
-    rules = mesh_axis_rules(mesh)
-    step = make_train_step(cfg, model, tx)
-    with mesh, nn_partitioning.axis_rules(rules):
-        state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
-        step_jit = jax.jit(
-            step,
-            in_shardings=(state_shardings, batch_shardings),
-            out_shardings=(state_shardings, replicated),
-            donate_argnums=(0,))
-
-    def wrapped(state, batch):
-        with mesh, nn_partitioning.axis_rules(rules):
-            return step_jit(state, batch)
-
-    return state, wrapped
+    from distributed_tensorflow_tpu.models.transformer import (
+        make_sharded_train_step as _transformer_sharded_step)
+    return _transformer_sharded_step(
+        cfg, mesh, global_batch, seed=seed,
+        step_factory=lambda c, m, t: make_train_step(c, m, t, seed=seed))
 
 
 def synthetic_corpus(global_batch: int, seq_len: int, vocab_size: int,

@@ -44,7 +44,7 @@ with_sharding_constraint = nn_partitioning.with_sharding_constraint
 # Logical axis name -> mesh axes. "sp" shards the sequence axis of
 # activations when the mesh has it (ring attention path).
 LOGICAL_AXIS_RULES = (
-    ("batch", ("dp", "fsdp")),
+    ("batch", ("dcn", "dp", "fsdp")),
     ("seq", "sp"),
     ("embed", "fsdp"),
     ("heads", "tp"),
@@ -162,8 +162,9 @@ class MultiHeadAttention(nn.Module):
             # (reference has no SP at all — SURVEY.md §5.7).
             from distributed_tensorflow_tpu.parallel.sequence_parallel \
                 import make_ring_attention
-            batch_axes = tuple(a for a in ("dp", "fsdp")
-                               if a in mesh.shape) or None
+            from distributed_tensorflow_tpu.cluster.topology import \
+                data_axes as mesh_data_axes
+            batch_axes = mesh_data_axes(mesh) or None
             head_axis = "tp" if "tp" in mesh.shape else None
             spec = P(batch_axes, head_axis, "sp", None)
             o = make_ring_attention(mesh, causal=cfg.causal,
@@ -342,15 +343,21 @@ def state_shardings_for(model, tx, mesh: Mesh, example_tokens,
 
 
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
-                            global_batch: int, seed: int = 0):
+                            global_batch: int, seed: int = 0,
+                            step_factory=None):
     """Initialize sharded state and return (state, jitted step_fn).
 
     The returned step consumes batches of shape (global_batch, seq);
-    inputs are sharded ("batch" over dp×fsdp, "seq" over sp if present)
-    and all gradient/weight collectives are inserted by GSPMD over the
-    mesh — the TPU-native replacement for the reference's
+    inputs are sharded ("batch" over dcn×dp×fsdp, "seq" over sp if
+    present) and all gradient/weight collectives are inserted by GSPMD
+    over the mesh — the TPU-native replacement for the reference's
     CrossDeviceOps.batch_reduce (cross_device_ops.py:871).
+
+    ``step_factory(cfg, model, tx)`` lets variants (BERT MLM) swap the
+    per-step loss while reusing all sharding/jit wiring.
     """
+    from distributed_tensorflow_tpu.cluster.topology import \
+        data_axes as mesh_data_axes
     if "sp" in mesh.shape and mesh.shape["sp"] > 1 and cfg.mesh is None:
         cfg = dataclasses.replace(cfg, mesh=mesh)
     model = TransformerLM(cfg)
@@ -366,13 +373,13 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
                 "step": jnp.zeros((), jnp.int32)}
 
     replicated = NamedSharding(mesh, P())
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    data_axes = mesh_data_axes(mesh)
     seq_axis = "sp" if "sp" in mesh.shape else None
     batch_shardings = {"tokens": NamedSharding(
         mesh, P(data_axes if data_axes else None, seq_axis))}
 
     rules = mesh_axis_rules(mesh)
-    step = make_train_step(cfg, model, tx)
+    step = (step_factory or make_train_step)(cfg, model, tx)
     with mesh, nn_partitioning.axis_rules(rules):
         state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
         step_jit = jax.jit(

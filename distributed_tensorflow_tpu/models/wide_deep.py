@@ -154,8 +154,9 @@ def make_sharded_train_step(cfg: WideDeepConfig, mesh: Mesh,
     dense_shape = jnp.zeros((global_batch, cfg.num_dense_features))
     cat_shape = jnp.zeros((global_batch, n_tables), jnp.int32)
 
-    rules = [(l, t if (t is None or t in mesh.shape) else None)
-             for l, t in WIDE_DEEP_RULES]
+    from distributed_tensorflow_tpu.models.transformer import \
+        mesh_axis_rules
+    rules = mesh_axis_rules(mesh, WIDE_DEEP_RULES)
 
     with nn_partitioning.axis_rules(rules):
         var_shapes = jax.eval_shape(
@@ -183,7 +184,9 @@ def make_sharded_train_step(cfg: WideDeepConfig, mesh: Mesh,
         return {"params": params, "opt_state": tx.init(params),
                 "step": jnp.zeros((), jnp.int32)}
 
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape) or None
+    from distributed_tensorflow_tpu.cluster.topology import \
+        data_axes as mesh_data_axes
+    data_axes = mesh_data_axes(mesh) or None
     batch_shardings = {
         "dense": NamedSharding(mesh, P(data_axes)),
         "categorical": NamedSharding(mesh, P(data_axes)),

@@ -1,0 +1,115 @@
+"""Mixture-of-Experts with expert parallelism over the "ep" mesh axis.
+
+The reference has NO expert parallelism (SURVEY.md §2.8: EP "out of scope
+unless fork adds it" — nothing in its distribute layer). TPU-native MoE
+here uses the Mesh-TF/GSPMD dispatch formulation: a capacity-bounded
+one-hot dispatch tensor turns token routing into two einsums, and
+sharding expert weights + expert-major activations over "ep" makes GSPMD
+lower the dispatch/combine einsums to all-to-alls over ICI — the same
+communication pattern hand-written EP frameworks schedule manually.
+
+Layer: Switch-style top-1 routing (optionally top-2), fp32 router,
+load-balancing auxiliary loss (Shazeer et al.), capacity factor with
+token dropping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn_partitioning.param_with_axes
+
+# Logical axes for MoE; merge with a model's rules as needed.
+MOE_AXIS_RULES = (
+    ("expert", "ep"),
+    ("expert_mlp", None),
+    ("expert_embed", None),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    d_model: int = 64
+    d_ff: int = 128
+    capacity_factor: float = 1.25
+    top_k: int = 1
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+
+
+class MoELayer(nn.Module):
+    """Switch-style MoE FFN. Call: (B, S, D) -> ((B, S, D), aux_loss)."""
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        E = cfg.num_experts
+        T = B * S
+        C = max(1, int(cfg.capacity_factor * T * cfg.top_k / E))
+
+        tokens = x.reshape(T, D)
+
+        router_w = param_with_axes(
+            "router", nn.initializers.normal(0.02), (D, E), jnp.float32,
+            axes=("expert_embed", "expert"))
+        logits = jnp.dot(tokens.astype(jnp.float32), router_w)   # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # Top-k expert choice per token.
+        gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # (T, k)
+
+        # Capacity-bounded position of each token within its expert:
+        # rank tokens per (expert, k-slot) by arrival order.
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        aux_me = jnp.mean(probs, axis=0)                         # (E,)
+        frac_tokens = jnp.zeros((E,), jnp.float32)
+        for k in range(cfg.top_k):
+            e_k = expert_idx[:, k]                               # (T,)
+            onehot = jax.nn.one_hot(e_k, E, dtype=jnp.float32)   # (T, E)
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # (T, E)
+            pos_k = jnp.sum(pos, axis=-1)                        # (T,)
+            keep = pos_k < C
+            gate = gate_vals[:, k] * keep
+            pos_oh = jax.nn.one_hot(pos_k.astype(jnp.int32), C,
+                                    dtype=jnp.float32)           # (T, C)
+            combine = combine + (gate[:, None, None]
+                                 * onehot[:, :, None]
+                                 * pos_oh[:, None, :])
+            frac_tokens = frac_tokens + jnp.mean(onehot, axis=0)
+        dispatch = (combine > 0).astype(x.dtype)                 # (T, E, C)
+
+        # Load-balancing aux loss (Switch Transformer eq. 4).
+        aux_loss = (cfg.aux_loss_weight * E
+                    * jnp.sum(frac_tokens / cfg.top_k * aux_me))
+
+        wi = param_with_axes("wi", nn.initializers.normal(D ** -0.5),
+                             (E, D, cfg.d_ff), jnp.float32,
+                             axes=("expert", "expert_embed", "expert_mlp"))
+        wo = param_with_axes("wo", nn.initializers.normal(cfg.d_ff ** -0.5),
+                             (E, cfg.d_ff, D), jnp.float32,
+                             axes=("expert", "expert_mlp", "expert_embed"))
+
+        # Dispatch: (T,D),(T,E,C) -> (E,C,D). Expert-major tensors are
+        # ep-sharded; GSPMD inserts the all-to-all over ICI.
+        expert_in = jnp.einsum("td,tec->ecd", tokens, dispatch)
+        expert_in = nn_partitioning.with_sharding_constraint(
+            expert_in, ("expert", None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(x.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(x.dtype))
+        expert_out = nn_partitioning.with_sharding_constraint(
+            expert_out, ("expert", None, None))
+
+        # Combine back to token order, weighted by gates.
+        out = jnp.einsum("ecd,tec->td", expert_out,
+                         combine.astype(x.dtype))
+        return out.reshape(B, S, D), aux_loss

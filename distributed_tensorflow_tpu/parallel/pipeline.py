@@ -1,0 +1,123 @@
+"""Pipeline parallelism over the "pp" mesh axis (GPipe schedule).
+
+The reference has NO pipeline parallelism (SURVEY.md §2.8: "nothing in
+TF/python/distribute/; delegated to GPipe/Mesh-TF out-of-tree"). The
+TPU-native framework provides it as a first-class schedule:
+
+- Stage parameters are stacked on a leading axis and sharded over "pp"
+  (each device holds exactly its stage's weights — no duplication).
+- Microbatches flow stage-to-stage via ``jax.lax.ppermute`` over ICI,
+  the canonical neighbor-exchange on a TPU torus.
+- The whole schedule is a ``lax.scan`` over ticks inside ``shard_map``,
+  so XLA sees one compiled loop body; autodiff through ppermute/scan
+  gives the backward pipeline (reverse schedule) for free.
+
+Bubble fraction is (n_stages-1)/(n_micro+n_stages-1) — standard GPipe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, params_local, x_microbatches,
+                   *, axis_name: str = "pp"):
+    """Run a GPipe pipeline inside a shard_map region.
+
+    stage_fn(params, x) -> y: one stage's computation (same shape in/out).
+    params_local: this device's stage parameters (leading "pp" axis
+        already sliced away by shard_map).
+    x_microbatches: (n_micro, mb, ...) — replicated across pp; stage 0
+        injects microbatch t at tick t.
+
+    Returns (n_micro, mb, ...) outputs of the LAST stage, valid on every
+    device (psum-broadcast at the end).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    n_ticks = n_micro + n_stages - 1
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped; injections past n_micro
+        # are garbage that never reaches collection).
+        inject = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.minimum(t, n_micro - 1), axis=0,
+            keepdims=False)
+        state = jnp.where(stage == 0, inject, state)
+        state = stage_fn(params_local, state)
+        # Last stage collects microbatch t-(n_stages-1) at tick t.
+        out_idx = t - (n_stages - 1)
+        collect = (stage == n_stages - 1) & (out_idx >= 0)
+        outputs = jax.lax.cond(
+            collect,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, state.astype(o.dtype), jnp.maximum(out_idx, 0), axis=0),
+            lambda o: o,
+            outputs)
+        state = jax.lax.ppermute(state, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    outputs0 = jnp.zeros((n_micro,) + mb_shape, x_microbatches.dtype)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0),
+                                   jnp.arange(n_ticks))
+    # Broadcast the last stage's outputs to every device.
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    return jax.lax.psum(outputs, axis_name)
+
+
+def make_pipelined_fn(mesh: Mesh, stage_fn: Callable, *,
+                      axis_name: str = "pp",
+                      param_spec: P | None = None,
+                      data_spec: P | None = None):
+    """shard_map wrapper: (stacked_params, x_microbatches) -> outputs.
+
+    stacked_params: pytree with leading axis n_stages, sharded over "pp".
+    x_microbatches: (n_micro, mb, ...), replicated over "pp" (shard other
+        mesh axes via ``data_spec``).
+    """
+    n_stages = mesh.shape[axis_name]
+    if param_spec is None:
+        param_spec = P(axis_name)
+    if data_spec is None:
+        data_spec = P()
+
+    def run(stacked_params, x_mb):
+        def inner(params_local, x_local):
+            # shard_map leaves the (sliced) leading stage axis of size 1.
+            params_local = jax.tree_util.tree_map(
+                lambda p: jnp.squeeze(p, axis=0), params_local)
+            return pipeline_apply(stage_fn, params_local, x_local,
+                                  axis_name=axis_name)
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(param_spec, data_spec),
+            out_specs=data_spec,
+            check_rep=False)(stacked_params, x_mb)
+
+    return run
+
+
+def stack_stage_params(per_stage_params: list):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading pp axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def place_stacked_params(stacked, mesh: Mesh, axis_name: str = "pp"):
+    """Device_put the stacked params so each pp rank owns its stage."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis_name))), stacked)

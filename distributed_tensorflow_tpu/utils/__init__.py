@@ -1,0 +1,1 @@
+"""Utilities: profiling/tracing, monitoring gauges, debugging helpers."""

@@ -1,0 +1,140 @@
+"""Profiling and tracing (≙ tf.profiler surface, SURVEY.md §5.1).
+
+Maps the reference's profiler API onto jax.profiler, which shares the
+same XPlane/TraceMe backend (both sit on tsl/profiler):
+
+- ``start(logdir)`` / ``stop()``           ≙ tf.profiler.experimental.start/stop
+  (reference: tensorflow/python/profiler/profiler_v2.py:81/:130)
+- ``Trace("name")`` scoped annotation      ≙ tf.profiler.experimental.Trace
+  (reference trace.py:28; native TraceMe)
+- ``start_server(port)`` on each worker +
+  ``trace(service_addr, logdir)`` from a
+  client                                   ≙ remote/pod profiling
+  (reference profiler_v2.py:169 + profiler_client.py) — the multi-host
+  TPU profiling shape is kept identical.
+- ``annotate_function``                    decorator form of Trace.
+
+Output is XPlane protos under ``<logdir>/plugins/profile/<run>``, viewable
+with tensorboard_plugin_profile or xprof — the same toolchain the
+reference's traces feed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import threading
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfilerOptions:
+    """≙ tf.profiler.experimental.ProfilerOptions (profiler_v2.py:46).
+
+    XLA/JAX's profiler always records host + device + python trace
+    levels; the fields are accepted for API parity and the meaningful
+    one (``python_tracer_level``) toggles jax's python tracer.
+    """
+    host_tracer_level: int = 2
+    python_tracer_level: int = 1
+    device_tracer_level: int = 1
+    delay_ms: int = 0
+
+
+_state = threading.local()
+
+
+def start(logdir: str, options: ProfilerOptions | None = None) -> None:
+    """Start collecting a trace on this host (device + host + python)."""
+    options = options or ProfilerOptions()
+    create_perfetto = False
+    jax.profiler.start_trace(
+        logdir,
+        create_perfetto_link=create_perfetto,
+        create_perfetto_trace=create_perfetto)
+    _state.active_logdir = logdir
+
+
+def stop() -> None:
+    """Stop tracing and write the XPlane output."""
+    jax.profiler.stop_trace()
+    _state.active_logdir = None
+
+
+@contextlib.contextmanager
+def profile(logdir: str, options: ProfilerOptions | None = None):
+    start(logdir, options)
+    try:
+        yield
+    finally:
+        stop()
+
+
+class Trace(jax.profiler.TraceAnnotation):
+    """Scoped trace annotation visible in the trace viewer.
+
+    ≙ tf.profiler.experimental.Trace (trace.py:28). Usage:
+
+        with Trace("train_step", step_num=i):
+            state, metrics = step(state, batch)
+    """
+
+    def __init__(self, name: str, **kwargs):
+        if kwargs:
+            name = name + " " + " ".join(
+                f"{k}={v}" for k, v in sorted(kwargs.items()))
+        super().__init__(name)
+
+
+def annotate_function(fn=None, *, name: str | None = None):
+    """Decorator: annotate every call of ``fn`` in the profile."""
+    if fn is None:
+        return functools.partial(annotate_function, name=name)
+    label = name or getattr(fn, "__name__", "fn")
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with Trace(label):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+def start_server(port: int):
+    """Start the on-demand profiling server on this worker (every host of
+    a pod job calls this; a client then requests traces remotely).
+    ≙ tf.profiler.experimental.server.start (profiler_v2.py:169)."""
+    return jax.profiler.start_server(port)
+
+
+def stop_server():
+    jax.profiler.stop_server()
+
+
+def trace(service_addr: str, logdir: str, duration_ms: int = 2000,
+          host_tracer_level: int = 2, num_tracing_attempts: int = 3):
+    """Client side of remote profiling: grab ``duration_ms`` of trace from
+    the worker at ``service_addr`` into ``logdir``.
+    ≙ tf.profiler.experimental.client.trace (profiler_client.py)."""
+    # jax ships the collection entry point under jax.profiler (backed by
+    # the same tsl profiler service the reference uses).
+    from jax.profiler import ProfileOptions  # noqa: F401  (API presence)
+    import jax._src.profiler as _jp
+    if hasattr(_jp, "trace_remote"):
+        return _jp.trace_remote(service_addr, logdir, duration_ms)
+    try:
+        from tensorflow.python.profiler import profiler_client
+        return profiler_client.trace(service_addr, logdir, duration_ms,
+                                     num_tracing_attempts=num_tracing_attempts)
+    except Exception as e:  # pragma: no cover - env without TF
+        raise NotImplementedError(
+            "remote trace collection needs the profiler client") from e
+
+
+@contextlib.contextmanager
+def step_marker(step: int):
+    """Mark a training step boundary (StepMarker shows step time in the
+    trace viewer's overview page)."""
+    with jax.profiler.StepTraceAnnotation("train", step_num=step):
+        yield

@@ -1,0 +1,53 @@
+"""Multi-slice hybrid mesh (dcn outer axis): hierarchical collectives.
+
+≙ the reference's HierarchicalCopyAllReduce / hybrid NCCL reduction
+(cross_device_ops.py:997, v1/all_reduce.py:710) — here one hybrid mesh
+makes every GSPMD collective hierarchical automatically (BASELINE.md
+config #5: cross-slice Transformer).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.topology import (
+    make_hybrid_mesh, make_mesh)
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, make_sharded_train_step, synthetic_tokens)
+
+
+def test_hybrid_mesh_axes(devices):
+    mesh = make_hybrid_mesh({"dcn": 2}, {"dp": 2, "tp": 2})
+    assert dict(mesh.shape) == {"dcn": 2, "dp": 2, "tp": 2}
+    # dcn must be the outermost (slowest-varying) axis.
+    assert mesh.axis_names[0] == "dcn"
+
+
+def test_transformer_on_hybrid_mesh_matches_flat(devices):
+    cfg = TransformerConfig.tiny()
+    batch = {"tokens": synthetic_tokens(8, cfg.max_seq_len,
+                                        cfg.vocab_size)}
+    losses = {}
+    for name, mesh in [
+        ("hybrid", make_hybrid_mesh({"dcn": 2}, {"dp": 2, "tp": 2})),
+        ("flat", make_mesh({"dp": 4, "tp": 2})),
+    ]:
+        state, step = make_sharded_train_step(cfg, mesh, global_batch=8)
+        ls = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            ls.append(float(m["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["hybrid"], losses["flat"],
+                               rtol=2e-4)
+
+
+def test_hybrid_mesh_data_sharding(devices):
+    """Batch shards over dcn×dp jointly (16-way data parallel on 2x(2,2))."""
+    cfg = TransformerConfig.tiny()
+    mesh = make_hybrid_mesh({"dcn": 2}, {"dp": 4})
+    state, step = make_sharded_train_step(cfg, mesh, global_batch=8)
+    batch = {"tokens": synthetic_tokens(8, cfg.max_seq_len,
+                                        cfg.vocab_size)}
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))

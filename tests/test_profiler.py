@@ -1,0 +1,40 @@
+"""Profiler/tracing subsystem: trace collection produces XPlane output."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.utils import profiler
+
+
+def test_trace_produces_xplane(tmp_path):
+    logdir = str(tmp_path / "profile")
+    with profiler.profile(logdir):
+        with profiler.Trace("annotated_matmul", step=1):
+            x = jnp.ones((64, 64))
+            jax.block_until_ready(x @ x)
+    produced = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                         recursive=True)
+    assert produced, f"no xplane output under {logdir}"
+
+
+def test_step_marker_and_decorator(tmp_path):
+    logdir = str(tmp_path / "profile2")
+
+    @profiler.annotate_function
+    def work():
+        return jax.block_until_ready(jnp.ones((32, 32)) * 2)
+
+    with profiler.profile(logdir):
+        for i in range(2):
+            with profiler.step_marker(i):
+                work()
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_options_accepted():
+    opts = profiler.ProfilerOptions(host_tracer_level=3)
+    assert opts.host_tracer_level == 3
