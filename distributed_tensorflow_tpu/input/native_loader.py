@@ -1,0 +1,149 @@
+"""Python wrapper for the native C++ data-pipeline core.
+
+≙ the reference's C++ tf.data engine feeding its distributed input layer
+(SURVEY.md §2.7 native rows; input auto-sharding ≙ input_ops.py:28 DATA
+policy). The hot path — file IO, shuffle, batch assembly, prefetch — runs
+in native threads (distributed_tensorflow_tpu/native/pipeline.cc); Python
+sees zero-copy numpy views and hands them to ``jax.device_put``.
+
+On-disk format: fixed-size binary records (one structured-dtype numpy
+record each); ``write_records`` produces it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdtx_pipeline.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "pipeline.cc")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_so():
+    subprocess.run(
+        ["g++", "-O3", "-fPIC", "-shared", "-pthread", "-std=c++17",
+         "-o", _SO_PATH, _SRC_PATH],
+        check=True, capture_output=True)
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO_PATH)
+                or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)):
+            _build_so()
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.dtx_pipeline_create.restype = ctypes.c_void_p
+        lib.dtx_pipeline_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.dtx_pipeline_next.restype = ctypes.c_void_p
+        lib.dtx_pipeline_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.dtx_pipeline_return.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.dtx_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        lib.dtx_pipeline_num_records.restype = ctypes.c_int64
+        lib.dtx_pipeline_num_records.argtypes = [ctypes.c_void_p]
+        lib.dtx_pipeline_batches_per_epoch.restype = ctypes.c_int64
+        lib.dtx_pipeline_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def write_records(path: str, array: np.ndarray) -> None:
+    """Write a (N, ...) array as N fixed-size records."""
+    with open(path, "wb") as f:
+        f.write(np.ascontiguousarray(array).tobytes())
+
+
+class NativeRecordDataset:
+    """Iterator of (batch_array, epoch) with native prefetch.
+
+    record_dtype/record_shape describe ONE record; batches come back as
+    (batch, *record_shape) arrays. ``num_shards``/``shard_index`` select
+    this host's partition (≙ DATA auto-sharding).
+    """
+
+    def __init__(self, paths, record_dtype, record_shape, batch_size: int,
+                 *, shuffle: bool = True, seed: int = 0,
+                 num_threads: int = 4, queue_depth: int = 8,
+                 num_shards: int = 1, shard_index: int = 0,
+                 drop_remainder: bool = True):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        self._paths = [os.fspath(p) for p in paths]
+        self.record_dtype = np.dtype(record_dtype)
+        self.record_shape = tuple(record_shape)
+        self.record_bytes = (self.record_dtype.itemsize
+                             * int(np.prod(self.record_shape or (1,))))
+        self.batch_size = batch_size
+        lib = _load()
+        arr = (ctypes.c_char_p * len(self._paths))(
+            *[p.encode() for p in self._paths])
+        self._h = lib.dtx_pipeline_create(
+            arr, len(self._paths), self.record_bytes, batch_size,
+            int(shuffle), seed, num_threads, queue_depth, num_shards,
+            shard_index, int(drop_remainder))
+        if not self._h:
+            raise FileNotFoundError(
+                f"native pipeline failed to open {self._paths} "
+                f"(empty shard or missing file)")
+        self._lib = lib
+
+    @property
+    def num_records(self) -> int:
+        return self._lib.dtx_pipeline_num_records(self._h)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._lib.dtx_pipeline_batches_per_epoch(self._h)
+
+    def next_batch(self):
+        """Blocking: returns (array, epoch). The array is a COPY (the
+        native buffer is recycled immediately)."""
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        epoch = ctypes.c_int64()
+        bh = self._lib.dtx_pipeline_next(
+            self._h, ctypes.byref(data), ctypes.byref(n),
+            ctypes.byref(epoch))
+        if not bh:
+            raise StopIteration
+        try:
+            nbytes = int(n.value) * self.record_bytes
+            flat = np.ctypeslib.as_array(data, shape=(nbytes,))
+            out = flat.view(self.record_dtype).reshape(
+                (int(n.value),) + self.record_shape).copy()
+        finally:
+            self._lib.dtx_pipeline_return(self._h, bh)
+        return out, int(epoch.value)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_batch()
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.dtx_pipeline_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

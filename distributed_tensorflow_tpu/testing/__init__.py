@@ -1,0 +1,10 @@
+"""Test infrastructure: strategy conformance, virtual devices, combos.
+
+≙ the reference's distribute test toolkit (SURVEY.md §4):
+strategy_test_lib.py (behavior contract), strategy_combinations.py
+(canned strategies), test_util.set_logical_devices_to_at_least (virtual
+devices — here the 8-device CPU mesh from tests/conftest.py).
+"""
+
+from distributed_tensorflow_tpu.testing.strategy_conformance import (  # noqa: F401
+    StrategyConformance)

@@ -1,0 +1,142 @@
+"""Reusable Strategy-API conformance suite.
+
+≙ strategy_test_lib.py (reference: tensorflow/python/distribute/
+strategy_test_lib.py, 825 LoC — SURVEY.md §4 "effectively the Strategy
+API contract"). Any Strategy implementation — including out-of-tree
+ones — can validate itself:
+
+    class TestMyStrategy(StrategyConformance):
+        def make_strategy(self):
+            return MyStrategy(...)
+
+Each check is a ``check_*`` method; the ``test_conformance`` entry point
+runs them all and reports every failure (not just the first).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.parallel.collectives import ReduceOp
+from distributed_tensorflow_tpu.parallel.strategy import (
+    get_replica_context, get_strategy, has_strategy)
+from distributed_tensorflow_tpu.parallel.values import PerReplica
+
+
+class StrategyConformance:
+    """Subclass and implement ``make_strategy``; pytest collects
+    ``test_conformance``."""
+
+    def make_strategy(self):
+        raise NotImplementedError
+
+    # -- individual contract checks --------------------------------------
+
+    def check_num_replicas_positive(self, s):
+        assert s.num_replicas_in_sync >= 1
+
+    def check_scope_registers_strategy(self, s):
+        assert not has_strategy()
+        with s.scope():
+            assert has_strategy()
+            assert get_strategy() is s
+        assert not has_strategy()
+
+    def check_variable_creation_in_scope(self, s):
+        with s.scope():
+            v = s.create_variable(jnp.ones((2, 2)), name="w")
+        assert v.shape == (2, 2)
+        np.testing.assert_allclose(np.asarray(v.read_value()),
+                                   np.ones((2, 2)))
+        assert s.extended.variable_created_in_scope(v)
+
+    def check_run_executes_per_replica(self, s):
+        n = s.num_replicas_in_sync
+
+        def fn():
+            ctx = get_replica_context()
+            return ctx.replica_id_in_sync_group
+
+        out = s.run(fn)
+        ids = sorted(int(x) for x in (out.values if isinstance(
+            out, PerReplica) else [out]))
+        assert ids == list(range(n)), ids
+
+    def check_all_reduce_sums_across_replicas(self, s):
+        n = s.num_replicas_in_sync
+
+        def fn():
+            ctx = get_replica_context()
+            return ctx.all_reduce(ReduceOp.SUM, jnp.asarray(1.0))
+
+        out = s.run(fn)
+        vals = out.values if isinstance(out, PerReplica) else [out]
+        for v in vals:
+            assert float(jnp.squeeze(jnp.asarray(v))) == float(n), vals
+
+    def check_reduce_mean(self, s):
+        def fn():
+            ctx = get_replica_context()
+            return jnp.asarray(float(1 + ctx.replica_id_in_sync_group)) \
+                if not isinstance(ctx.replica_id_in_sync_group, jax.Array) \
+                else (ctx.replica_id_in_sync_group + 1.0)
+
+        out = s.run(fn)
+        red = s.reduce(ReduceOp.MEAN, out, axis=None)
+        n = s.num_replicas_in_sync
+        expected = (n + 1) / 2
+        np.testing.assert_allclose(float(jnp.asarray(red)), expected,
+                                   rtol=1e-6)
+
+    def check_variable_update_visible_after_run(self, s):
+        with s.scope():
+            v = s.create_variable(jnp.zeros(()), name="counter")
+
+        def fn():
+            v.assign_add(1.0)
+
+        s.run(fn)
+        # on-write mirrored variables aggregate identical updates
+        np.testing.assert_allclose(float(jnp.asarray(v.read_value())), 1.0)
+
+    def check_experimental_distribute_values(self, s):
+        n = s.num_replicas_in_sync
+        vals = s.experimental_distribute_values_from_function(
+            lambda ctx: float(ctx.replica_id_in_sync_group))
+        assert isinstance(vals, PerReplica)
+        assert [float(x) for x in vals.values] == [float(i)
+                                                   for i in range(n)]
+
+    def check_gather(self, s):
+        def fn():
+            ctx = get_replica_context()
+            rid = ctx.replica_id_in_sync_group
+            base = (jnp.asarray(rid, jnp.float32)
+                    if not isinstance(rid, jax.Array)
+                    else rid.astype(jnp.float32))
+            return jnp.reshape(base, (1,))
+
+        out = s.run(fn)
+        gathered = s.gather(out, axis=0)
+        assert gathered.shape[0] == s.num_replicas_in_sync
+
+    # -- entry point ------------------------------------------------------
+
+    CHECKS = [name for name in sorted(dir()) if name.startswith("check_")]
+
+    def test_conformance(self, devices):
+        failures = []
+        for name in [m for m in dir(self) if m.startswith("check_")]:
+            s = self.make_strategy()
+            try:
+                getattr(self, name)(s)
+            except NotImplementedError:
+                pass      # optional surface for this strategy kind
+            except AssertionError as e:
+                failures.append(f"{name}: {e}")
+            except Exception as e:  # noqa: BLE001 - report, keep going
+                failures.append(f"{name}: {type(e).__name__}: {e}")
+        assert not failures, ("strategy contract violations:\n  "
+                              + "\n  ".join(failures))

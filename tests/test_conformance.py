@@ -1,0 +1,32 @@
+"""Run the strategy conformance suite against every built-in strategy
+(≙ reference pattern: strategy_test_lib × strategy_combinations)."""
+
+from distributed_tensorflow_tpu.cluster.topology import make_mesh
+from distributed_tensorflow_tpu.parallel.mirrored import MirroredStrategy
+from distributed_tensorflow_tpu.parallel.multi_worker import (
+    MultiWorkerMirroredStrategy)
+from distributed_tensorflow_tpu.parallel.one_device import OneDeviceStrategy
+from distributed_tensorflow_tpu.testing import StrategyConformance
+
+
+class TestMirroredConformance(StrategyConformance):
+    def make_strategy(self):
+        return MirroredStrategy()
+
+
+class TestStrategyOn2x4MeshConformance(StrategyConformance):
+    """Base Strategy over a dp×tp mesh: replicas = dp only."""
+
+    def make_strategy(self):
+        from distributed_tensorflow_tpu.parallel.strategy import Strategy
+        return Strategy(mesh=make_mesh({"dp": 4, "tp": 2}))
+
+
+class TestOneDeviceConformance(StrategyConformance):
+    def make_strategy(self):
+        return OneDeviceStrategy()
+
+
+class TestMultiWorkerConformance(StrategyConformance):
+    def make_strategy(self):
+        return MultiWorkerMirroredStrategy()
