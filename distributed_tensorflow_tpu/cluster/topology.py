@@ -194,6 +194,17 @@ def data_axes(mesh: Mesh) -> tuple:
     return tuple(a for a in DATA_AXES if a in mesh.shape)
 
 
+def attention_shard_spec(mesh: Mesh):
+    """PartitionSpec for (batch, heads, seq, head_dim) attention operands:
+    batch over the data axes, heads over tp, seq/head_dim unsharded.
+    Single source of truth for every attention entry point (dense
+    shard_map path and the sp ring/ulysses path)."""
+    from jax.sharding import PartitionSpec as P
+    batch_axes = data_axes(mesh)
+    head_axis = TENSOR_AXIS if TENSOR_AXIS in mesh.shape else None
+    return P(batch_axes if batch_axes else None, head_axis, None, None)
+
+
 def make_hybrid_mesh(dcn_axes: Mapping[str, int],
                      ici_axes: Mapping[str, int],
                      *, devices: Sequence | None = None) -> Mesh:

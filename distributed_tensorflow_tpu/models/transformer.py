@@ -67,6 +67,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     causal: bool = True            # False -> bidirectional encoder (BERT)
     remat: bool = True
+    remat_policy: str = "nothing"  # "nothing" | "dots" (save matmul outputs)
     scan_layers: bool = True
     attention_impl: str | None = None   # None = auto (pallas on TPU)
     learning_rate: float = 3e-4
@@ -163,12 +164,18 @@ class MultiHeadAttention(nn.Module):
             from distributed_tensorflow_tpu.parallel.sequence_parallel \
                 import make_ring_attention
             from distributed_tensorflow_tpu.cluster.topology import \
-                data_axes as mesh_data_axes
-            batch_axes = mesh_data_axes(mesh) or None
-            head_axis = "tp" if "tp" in mesh.shape else None
-            spec = P(batch_axes, head_axis, "sp", None)
+                attention_shard_spec
+            base = attention_shard_spec(mesh)
+            spec = P(base[0], base[1], "sp", None)
             o = make_ring_attention(mesh, causal=cfg.causal,
                                     impl=cfg.sp_impl, spec=spec)(q, k, v)
+        elif mesh is not None and mesh.size > 1:
+            # Pallas custom calls can't be partitioned by GSPMD: run the
+            # kernel per-shard via shard_map over batch/head axes.
+            from distributed_tensorflow_tpu.ops.attention import \
+                sharded_flash_attention
+            o = sharded_flash_attention(q, k, v, mesh, causal=cfg.causal,
+                                        implementation=cfg.attention_impl)
         else:
             o = flash_attention(q, k, v, causal=cfg.causal,
                                 implementation=cfg.attention_impl)
@@ -228,8 +235,18 @@ class TransformerLM(nn.Module):
 
         block = Block
         if cfg.remat:
+            policies = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable,
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy={cfg.remat_policy!r}; "
+                    f"expected one of {sorted(policies)}")
+            policy = policies[cfg.remat_policy]
             block = nn_partitioning.remat(
-                block, policy=jax.checkpoint_policies.nothing_saveable,
+                block, policy=policy,
                 prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
             x, _ = nn_partitioning.scan_with_axes(
@@ -358,7 +375,7 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     """
     from distributed_tensorflow_tpu.cluster.topology import \
         data_axes as mesh_data_axes
-    if "sp" in mesh.shape and mesh.shape["sp"] > 1 and cfg.mesh is None:
+    if cfg.mesh is None:
         cfg = dataclasses.replace(cfg, mesh=mesh)
     model = TransformerLM(cfg)
     tx = make_optimizer(cfg)

@@ -103,15 +103,25 @@ class Pipeline {
   int64_t num_records() const { return static_cast<int64_t>(index_.size()); }
   int64_t batches_per_epoch() const { return batches_per_epoch_; }
 
-  // Blocks until a batch is ready; returns its buffer (caller must
-  // Return() it). actual_records reports the (possibly short) batch size.
+  // Blocks until the batch with the next sequential batch_index is ready;
+  // returns its buffer (caller must Return() it). Delivering strictly in
+  // batch order makes the stream deterministic for any num_threads: every
+  // in-flight batch owns its buffer, so the next-expected batch can always
+  // complete even while later batches sit in ready_. actual_records
+  // reports the (possibly short) batch size.
   Batch* Next(int64_t* actual_records, int64_t* epoch) {
     std::unique_lock<std::mutex> lk(mu_);
-    cv_ready_.wait(lk, [this] { return stop_ || !ready_.empty(); });
-    if (stop_ && ready_.empty()) return nullptr;
+    cv_ready_.wait(lk, [this] {
+      return stop_ || (!ready_.empty() &&
+                       ready_.front()->batch_index == next_deliver_);
+    });
+    if (stop_ &&
+        (ready_.empty() || ready_.front()->batch_index != next_deliver_))
+      return nullptr;
     Batch* b = ready_.front();
     ready_.pop_front();
     lent_.push_back(b);
+    ++next_deliver_;
     *actual_records = last_sizes_[b];
     *epoch = b->epoch;
     return b;
@@ -171,7 +181,7 @@ class Pipeline {
         while (it != ready_.end() && (*it)->batch_index < my_batch) ++it;
         ready_.insert(it, buf);
       }
-      cv_ready_.notify_one();
+      cv_ready_.notify_all();
     }
     for (FILE* f : fps)
       if (f) std::fclose(f);
@@ -210,6 +220,7 @@ class Pipeline {
   std::vector<Batch*> lent_;
   std::map<Batch*, int64_t> last_sizes_;
   int64_t next_batch_ = 0;
+  int64_t next_deliver_ = 0;
   bool stop_ = false;
 
   std::vector<std::thread> workers_;

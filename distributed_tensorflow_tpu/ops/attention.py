@@ -35,15 +35,21 @@ def mha_reference(q, k, v, *, causal: bool = False, sm_scale: float | None = Non
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * sm_scale
+    valid = None
     if causal:
         qs, ks = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((qs, ks), dtype=bool), k=ks - qs)
-        logits = jnp.where(mask[None, None], logits, DEFAULT_MASK_VALUE)
+        valid = jnp.tril(jnp.ones((qs, ks), dtype=bool), k=ks - qs)[None, None]
     if segment_ids is not None:
         seg_mask = (segment_ids[:, None, :, None]
                     == segment_ids[:, None, None, :])
-        logits = jnp.where(seg_mask, logits, DEFAULT_MASK_VALUE)
+        valid = seg_mask if valid is None else valid & seg_mask
+    if valid is not None:
+        logits = jnp.where(valid, logits, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1)
+    if valid is not None:
+        # Fully-masked query rows (causal with q_len > k_len) output 0,
+        # not the uniform average softmax-of-equal-mask-values would give.
+        probs = probs * jnp.any(valid, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -134,9 +140,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref,          # inputs (blocked)
     @pl.when(kb == num_k_blocks - 1)
     def _finish():
         l = l_scr[:]
-        l = jnp.where(l == 0.0, 1.0, l)    # fully-masked rows -> output 0
+        empty = l == 0.0                   # fully-masked rows -> output 0
+        l = jnp.where(empty, 1.0, l)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:] + jnp.log(l)   # (block_q, 1)
+        # Empty rows store lse = +inf so the backward kernels recompute
+        # p = exp(masked_logit - inf) = 0 instead of exp(MASK - MASK) = 1.
+        lse_ref[0] = jnp.where(empty, jnp.inf,
+                               m_scr[:] + jnp.log(l))   # (block_q, 1)
 
 
 def _pad_seq(x, multiple):
@@ -400,3 +410,45 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     interpret = implementation == "interpret"
     return _flash_mha(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def sharded_flash_attention(q, k, v, mesh, *, causal: bool = False,
+                            sm_scale: float | None = None,
+                            block_q: int = 128, block_k: int = 128,
+                            implementation: str | None = None):
+    """``flash_attention`` shard_mapped over the mesh's batch/head axes.
+
+    The Pallas kernel lowers to a Mosaic custom call, which the GSPMD
+    partitioner cannot partition: invoked directly inside a partitioned
+    jit it forces an all-gather of q/k/v and runs fully replicated on
+    every device. Attention is embarrassingly parallel over (batch,
+    heads), so run the kernel per-shard under ``shard_map`` over the
+    (dcn, dp, fsdp) batch axes and the tp head axis — no collectives
+    inside the region.
+
+    Falls back to the plain call when the shard counts don't divide the
+    operand dims (then GSPMD's replicated execution is still correct).
+    """
+    import math
+
+    from jax.experimental.shard_map import shard_map
+
+    from distributed_tensorflow_tpu.cluster.topology import \
+        attention_shard_spec
+
+    spec = attention_shard_spec(mesh)
+    batch_axes, head_axis = spec[0], spec[1]
+    if isinstance(batch_axes, str):   # PartitionSpec flattens 1-tuples
+        batch_axes = (batch_axes,)
+    n_batch = (math.prod(mesh.shape[a] for a in batch_axes)
+               if batch_axes else 1)
+    n_head = mesh.shape[head_axis] if head_axis else 1
+    if n_batch * n_head == 1 or q.shape[0] % n_batch or q.shape[1] % n_head:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k,
+                               implementation=implementation)
+    fn = functools.partial(flash_attention, causal=causal, sm_scale=sm_scale,
+                           block_q=block_q, block_k=block_k,
+                           implementation=implementation)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -68,15 +68,21 @@ class MoELayer(nn.Module):
         gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # (T, k)
 
         # Capacity-bounded position of each token within its expert:
-        # rank tokens per (expert, k-slot) by arrival order.
+        # rank tokens per expert by (k-slot, arrival order) — all 1st
+        # choices fill an expert's slots before any 2nd choice does
+        # (Mesh-TF/Switch formulation), so pass k's positions are offset
+        # by the per-expert token counts from passes < k.
         combine = jnp.zeros((T, E, C), jnp.float32)
         aux_me = jnp.mean(probs, axis=0)                         # (E,)
         frac_tokens = jnp.zeros((E,), jnp.float32)
+        prior_count = jnp.zeros((E,), jnp.float32)
         for k in range(cfg.top_k):
             e_k = expert_idx[:, k]                               # (T,)
             onehot = jax.nn.one_hot(e_k, E, dtype=jnp.float32)   # (T, E)
-            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # (T, E)
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0
+                   + prior_count[None, :]) * onehot              # (T, E)
             pos_k = jnp.sum(pos, axis=-1)                        # (T,)
+            prior_count = prior_count + jnp.sum(onehot, axis=0)
             keep = pos_k < C
             gate = gate_vals[:, k] * keep
             pos_oh = jax.nn.one_hot(pos_k.astype(jnp.int32), C,

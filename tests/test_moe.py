@@ -79,6 +79,35 @@ def test_ep_sharded_matches_replicated(cfg, x, devices):
     assert tuple(placed["wi"].sharding.spec)[0] == "ep"
 
 
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_topk_matches_dense_reference(x, top_k):
+    """With capacity high enough that nothing drops, the dispatch/combine
+    einsum formulation must equal the dense per-token computation
+    sum_k gate_k * expert_{idx_k}(token). Top-2 specifically guards the
+    per-expert position offsets across k passes (ADVICE r1, high)."""
+    cfg = MoEConfig(num_experts=4, d_model=16, d_ff=32,
+                    capacity_factor=4.0, top_k=top_k)
+    params, apply, _ = _init_apply(cfg, x, MOE_AXIS_RULES)
+    out, _ = apply(params, x)
+
+    tokens = np.asarray(x).reshape(-1, cfg.d_model)
+    logits = tokens.astype(np.float32) @ np.asarray(params["router"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    gate_vals, expert_idx = jax.lax.top_k(jnp.asarray(probs), top_k)
+    gate_vals, expert_idx = np.asarray(gate_vals), np.asarray(expert_idx)
+
+    wi, wo = np.asarray(params["wi"]), np.asarray(params["wo"])
+    # Apply every expert to every token densely: (T, E, D).
+    h = np.asarray(jax.nn.gelu(jnp.einsum("td,edf->tef", tokens, wi)))
+    dense = np.einsum("tef,efd->ted", h, wo)
+    ref = np.zeros_like(tokens)
+    for k in range(top_k):
+        ref += gate_vals[:, k:k + 1] * dense[np.arange(len(tokens)),
+                                             expert_idx[:, k]]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, cfg.d_model),
+                               ref, atol=1e-4, rtol=1e-4)
+
+
 def test_moe_trains(cfg, x, devices):
     """Router + experts learn a simple regression; aux loss keeps balance."""
     params, apply, _ = _init_apply(cfg, x, MOE_AXIS_RULES)

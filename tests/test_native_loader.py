@@ -83,6 +83,24 @@ def test_multithreaded_batches_arrive_in_order(record_file):
     ds.close()
 
 
+def test_deterministic_order_under_thread_stress(record_file):
+    """num_threads > queue_depth consumers racing: delivery must still be
+    strictly batch-ordered run-to-run (Next waits for next_deliver_)."""
+    path, arr = record_file
+    for _ in range(3):
+        ds = NativeRecordDataset(path, np.float32, (DIM,), batch_size=4,
+                                 shuffle=True, seed=5, num_threads=8,
+                                 queue_depth=2)
+        epochs = [_collect_epoch(ds) for _ in range(3)]
+        ds.close()
+        ds2 = NativeRecordDataset(path, np.float32, (DIM,), batch_size=4,
+                                  shuffle=True, seed=5, num_threads=1,
+                                  queue_depth=2)
+        for e in epochs:
+            np.testing.assert_array_equal(e, _collect_epoch(ds2))
+        ds2.close()
+
+
 def test_drop_remainder_false(record_file):
     path, _ = record_file
     ds = NativeRecordDataset(path, np.float32, (DIM,), batch_size=10,
