@@ -42,7 +42,11 @@ def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     if on_tpu:
-        cfg = TransformerConfig.transformer_big(max_seq_len=1024)
+        # remat_policy="attn": keep attention outputs (O(B·S·D)/layer) so
+        # backward skips the flash-kernel recompute — best single-chip
+        # config from tools/perf_sweep.py (v5e).
+        cfg = TransformerConfig.transformer_big(max_seq_len=1024,
+                                                remat_policy="attn")
         batch, n_iters, reps = 16, 20, 5
     else:  # local smoke run
         cfg = TransformerConfig.tiny()

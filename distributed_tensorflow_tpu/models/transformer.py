@@ -70,6 +70,11 @@ class TransformerConfig:
     remat_policy: str = "nothing"  # "nothing" | "dots" (save matmul outputs)
     scan_layers: bool = True
     attention_impl: str | None = None   # None = auto (pallas on TPU)
+    # Pallas kernel tile sizes; the 512/1024 defaults are from the v5e
+    # block sweep (tools/perf_sweep.py) — grid overhead dominates below
+    # 512 and VMEM pressure wins above 1024 at head_dim 64.
+    attn_block_q: int = 512
+    attn_block_k: int = 1024
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
     # Sequence/context parallelism: when mesh (threaded in by
@@ -175,11 +180,19 @@ class MultiHeadAttention(nn.Module):
             from distributed_tensorflow_tpu.ops.attention import \
                 sharded_flash_attention
             o = sharded_flash_attention(q, k, v, mesh, causal=cfg.causal,
+                                        block_q=cfg.attn_block_q,
+                                        block_k=cfg.attn_block_k,
                                         implementation=cfg.attention_impl)
         else:
             o = flash_attention(q, k, v, causal=cfg.causal,
+                                block_q=cfg.attn_block_q,
+                                block_k=cfg.attn_block_k,
                                 implementation=cfg.attention_impl)
         o = o.transpose(0, 2, 1, 3)        # (B, S, H, hd)
+        # Named save point: the "attn" remat policy keeps this tensor so
+        # the backward pass never re-runs the flash kernel forward.
+        from jax.ad_checkpoint import checkpoint_name
+        o = checkpoint_name(o, "attn_out")
 
         out_kernel = param_with_axes(
             "out", nn.initializers.normal(D ** -0.5), (H, hd, D),
@@ -239,6 +252,10 @@ class TransformerLM(nn.Module):
                 "nothing": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies
                 .dots_with_no_batch_dims_saveable,
+                # Save only attention outputs: O(B·S·D) per layer, and the
+                # backward never recomputes the flash kernel forward.
+                "attn": jax.checkpoint_policies
+                .save_only_these_names("attn_out"),
             }
             if cfg.remat_policy not in policies:
                 raise ValueError(

@@ -394,7 +394,7 @@ _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     sm_scale: float | None = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 1024,
                     implementation: str | None = None):
     """Fused attention. ``(b, h, s, d)`` in, ``(b, h, s, d)`` out.
 
@@ -414,7 +414,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
 def sharded_flash_attention(q, k, v, mesh, *, causal: bool = False,
                             sm_scale: float | None = None,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: int = 512, block_k: int = 1024,
                             implementation: str | None = None):
     """``flash_attention`` shard_mapped over the mesh's batch/head axes.
 

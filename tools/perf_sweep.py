@@ -2,16 +2,14 @@
 
 Times the full train step under different (batch, remat policy, attention
 impl, pallas block sizes) settings using the same delta-loop methodology
-as bench.py. Prints one line per config; run on the real TPU chip.
+as bench.py. Prints one line per config; run on the real TPU chip:
 
-Usage: python tools/perf_sweep.py [config ...]
-  configs are comma-separated key=val, e.g.
-  python tools/perf_sweep.py batch=32 batch=32,remat=dots batch=64,attn=reference
+  PYTHONPATH=/root/repo:$PYTHONPATH python tools/perf_sweep.py \\
+      batch=16 batch=16,remat=dots batch=16,bq=256,bk=512
 """
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import sys
 import time
@@ -19,113 +17,89 @@ import time
 import jax
 import jax.numpy as jnp
 
+from bench import PEAK_TFLOPS
 from distributed_tensorflow_tpu.models.transformer import (
     TransformerConfig, TransformerLM, make_optimizer, make_train_step,
     synthetic_tokens)
-
-PEAK = 197.0e12
-
-REMAT_POLICIES = {
-    "nothing": jax.checkpoint_policies.nothing_saveable,
-    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-    "everything": None,  # remat disabled
-}
 
 
 def parse(spec: str) -> dict:
     out = {}
     for kv in spec.split(","):
-        if not kv:
-            continue
-        k, v = kv.split("=")
-        out[k] = v
+        if kv:
+            k, v = kv.split("=")
+            out[k] = v
     return out
 
 
 def run_one(spec: dict, n_iters=10, reps=3):
     batch = int(spec.get("batch", 16))
+    kw = dict(
+        max_seq_len=int(spec.get("seq", 1024)),
+        scan_layers=spec.get("scan", "1") == "1",
+        attn_block_q=int(spec.get("bq", 512)),
+        attn_block_k=int(spec.get("bk", 1024)),
+    )
+    if "attn" in spec:
+        kw["attention_impl"] = spec["attn"]
     remat = spec.get("remat", "nothing")
-    attn = spec.get("attn", None)  # None = auto (pallas on tpu)
-    bq = int(spec.get("bq", 128))
-    bk = int(spec.get("bk", 128))
-    seq = int(spec.get("seq", 1024))
-    scan = spec.get("scan", "1") == "1"
-
-    kw = dict(max_seq_len=seq, scan_layers=scan)
-    if attn:
-        kw["attention_impl"] = attn
-    if remat == "everything":
+    if remat == "off":
         kw["remat"] = False
     else:
         kw["remat_policy"] = remat
     cfg = TransformerConfig.transformer_big(**kw)
 
-    # Patch pallas block sizes through the flash_attention default args.
-    import distributed_tensorflow_tpu.ops.attention as attn_mod
-    orig = attn_mod.flash_attention
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    tokens = synthetic_tokens(batch, cfg.max_seq_len, cfg.vocab_size)
 
-    if bq != 128 or bk != 128:
-        def patched(q, k, v, **kwargs):
-            kwargs.setdefault("block_q", bq)
-            kwargs.setdefault("block_k", bk)
-            return orig(q, k, v, **kwargs)
-        attn_mod.flash_attention = patched
+    @jax.jit
+    def init_fn(rng):
+        params = model.init(rng, tokens)["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
 
-    try:
-        model = TransformerLM(cfg)
-        tx = make_optimizer(cfg)
-        rng = jax.random.PRNGKey(0)
-        tokens = synthetic_tokens(batch, cfg.max_seq_len, cfg.vocab_size)
+    state = jax.block_until_ready(init_fn(jax.random.PRNGKey(0)))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+        state["params"]))
 
-        @jax.jit
-        def init_fn(rng):
-            params = model.init(rng, tokens)["params"]
-            return {"params": params, "opt_state": tx.init(params),
-                    "step": jnp.zeros((), jnp.int32)}
+    step = make_train_step(cfg, model, tx)
 
-        state = jax.block_until_ready(init_fn(rng))
-        n_params = sum(x.size for x in jax.tree_util.tree_leaves(
-            state["params"]))
+    @functools.partial(jax.jit, static_argnums=2)
+    def loop(state, batch_tokens, n):
+        def body(_, s):
+            s2, _m = step(s, {"tokens": batch_tokens})
+            return s2
+        return jax.lax.fori_loop(0, n, body, state)
 
-        step = make_train_step(cfg, model, tx)
+    def timed(n):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = loop(state, tokens, n)
+            float(out["step"])          # scalar readback = true completion
+            best = min(best, time.perf_counter() - t0)
+        return best
 
-        @functools.partial(jax.jit, static_argnums=2)
-        def loop(state, batch_tokens, n):
-            def body(_, s):
-                s2, _m = step(s, {"tokens": batch_tokens})
-                return s2
-            return jax.lax.fori_loop(0, n, body, state)
+    jax.block_until_ready(loop(state, tokens, 1))
+    jax.block_until_ready(loop(state, tokens, 1 + n_iters))
+    dt = (timed(1 + n_iters) - timed(1)) / n_iters
 
-        def timed(n):
-            best = float("inf")
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                out = loop(state, tokens, n)
-                float(out["step"])
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        jax.block_until_ready(loop(state, tokens, 1))
-        jax.block_until_ready(loop(state, tokens, 1 + n_iters))
-        dt = (timed(1 + n_iters) - timed(1)) / n_iters
-
-        toks = batch * cfg.max_seq_len
-        attn_flops = cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2 \
-            * cfg.d_model * 0.5
-        flops = 6 * n_params * toks + attn_flops
-        mfu = flops / dt / PEAK
-        print(f"{spec}  step={dt*1e3:.1f}ms  tok/s={toks/dt:,.0f}  "
-              f"mfu={mfu:.4f}", flush=True)
-        return mfu
-    finally:
-        attn_mod.flash_attention = orig
+    toks = batch * cfg.max_seq_len
+    attn_flops = (cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2
+                  * cfg.d_model * 0.5)
+    flops = 6 * n_params * toks + attn_flops
+    peak = PEAK_TFLOPS.get(jax.default_backend(), 1.0) * 1e12
+    mfu = flops / dt / peak
+    print(f"{spec}  step={dt*1e3:.1f}ms  tok/s={toks/dt:,.0f}  "
+          f"mfu={mfu:.4f}", flush=True)
+    return mfu
 
 
 if __name__ == "__main__":
-    specs = sys.argv[1:] or ["batch=16"]
-    for s in specs:
+    for s in (sys.argv[1:] or ["batch=16"]):
         try:
             run_one(parse(s))
-        except Exception as e:  # keep sweeping past OOMs
+        except Exception as e:       # keep sweeping past OOMs
             print(f"{parse(s)}  FAILED: {type(e).__name__}: {e}",
                   flush=True)
