@@ -1,0 +1,23 @@
+"""Training-loop layer: Model.compile/fit/evaluate on a Strategy.
+
+≙ the reference's Keras engine layer (SURVEY.md §1 L7,
+tf_keras/src/engine/training.py)."""
+
+from distributed_tensorflow_tpu.training.model import Model
+from distributed_tensorflow_tpu.training import losses
+from distributed_tensorflow_tpu.training import metrics
+from distributed_tensorflow_tpu.training import callbacks
+from distributed_tensorflow_tpu.training.callbacks import (
+    BackupAndRestore,
+    Callback,
+    EarlyStopping,
+    History,
+    LearningRateScheduler,
+    ModelCheckpoint,
+)
+
+__all__ = [
+    "Model", "losses", "metrics", "callbacks", "Callback", "History",
+    "EarlyStopping", "ModelCheckpoint", "LearningRateScheduler",
+    "BackupAndRestore",
+]

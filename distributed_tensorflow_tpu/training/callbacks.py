@@ -1,0 +1,225 @@
+"""Training callbacks for Model.fit.
+
+≙ tf_keras/src/callbacks.py: Callback/CallbackList/History/EarlyStopping/
+ModelCheckpoint, and BackupAndRestore backed by an epoch-granular training
+state (≙ tf_keras/src/distribute/worker_training_state.py:34 back_up/
+restore — the reference checkpoints {weights, optimizer state, epoch} to a
+backup dir every epoch and deletes it when fit() completes).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+
+
+class Callback:
+    """Base callback (≙ tf_keras Callback). Overridable hooks only."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_train_batch_end(self, batch, logs=None):
+        pass
+
+    def on_test_begin(self, logs=None):
+        pass
+
+    def on_test_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            cb.set_model(model)
+            cb.set_params(params)
+
+    def _call(self, hook, *args):
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args)
+
+    def on_train_begin(self, logs=None):
+        self._call("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        self._call("on_train_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        self._call("on_train_batch_begin", batch, logs)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._call("on_train_batch_end", batch, logs)
+
+    def on_test_begin(self, logs=None):
+        self._call("on_test_begin", logs)
+
+    def on_test_end(self, logs=None):
+        self._call("on_test_end", logs)
+
+
+class History(Callback):
+    """Records epoch logs; Model.fit returns it (≙ tf_keras History)."""
+
+    def on_train_begin(self, logs=None):
+        if not hasattr(self, "history"):
+            self.history = {}
+            self.epoch = []
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch.append(epoch)
+        for k, v in (logs or {}).items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ProgbarLogger(Callback):
+    """One line per epoch (the TPU-friendly verbose=1)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        items = "  ".join(f"{k}={v:.4f}" for k, v in (logs or {}).items()
+                          if isinstance(v, (int, float, np.floating)))
+        epochs = self.params.get("epochs", "?")
+        print(f"epoch {epoch + 1}/{epochs}  {items}", flush=True)
+
+
+def _improved(current, best, mode: str, min_delta: float) -> bool:
+    if mode == "min":
+        return current < best - min_delta
+    return current > best + min_delta
+
+
+class EarlyStopping(Callback):
+    """≙ tf_keras EarlyStopping (monitor/patience/min_delta/mode +
+    restore_best_weights)."""
+
+    def __init__(self, monitor="val_loss", min_delta=0.0, patience=0,
+                 mode="auto", restore_best_weights=False, baseline=None):
+        super().__init__()
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        self.restore_best_weights = restore_best_weights
+        self.baseline = baseline
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = (self.baseline if self.baseline is not None
+                     else (np.inf if self.mode == "min" else -np.inf))
+        self.best_weights = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        current = (logs or {}).get(self.monitor)
+        if current is None:
+            return
+        if _improved(current, self.best, self.mode, self.min_delta):
+            self.best = current
+            self.wait = 0
+            if self.restore_best_weights:
+                self.best_weights = self.model.get_weights()
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+                if self.restore_best_weights and self.best_weights is not None:
+                    self.model.set_weights(self.best_weights)
+
+
+class ModelCheckpoint(Callback):
+    """≙ tf_keras ModelCheckpoint: save weights each epoch, optionally only
+    on monitored improvement. ``filepath`` may contain ``{epoch}``."""
+
+    def __init__(self, filepath, monitor="val_loss", save_best_only=False,
+                 mode="auto", save_weights_only=True):
+        super().__init__()
+        self.filepath = str(filepath)
+        self.monitor = monitor
+        self.save_best_only = save_best_only
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = np.inf if self.mode == "min" else -np.inf
+
+    def on_epoch_end(self, epoch, logs=None):
+        path = self.filepath.format(epoch=epoch + 1)
+        if self.save_best_only:
+            current = (logs or {}).get(self.monitor)
+            if current is None or not _improved(current, self.best,
+                                                self.mode, 0.0):
+                return
+            self.best = current
+        self.model.save_weights(path)
+
+
+class LearningRateScheduler(Callback):
+    """≙ tf_keras LearningRateScheduler. Requires the optimizer to expose
+    a mutable learning rate — compile with an optax
+    ``inject_hyperparams``-wrapped optimizer or pass ``learning_rate=`` to
+    Model.compile (which wraps for you)."""
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        lr = self.schedule(epoch, self.model.learning_rate)
+        self.model.learning_rate = lr
+
+
+class BackupAndRestore(Callback):
+    """Epoch-granular fault-tolerance for fit().
+
+    ≙ tf_keras BackupAndRestore + worker_training_state.py:34: at the end
+    of every epoch, back up {weights, optimizer state, completed epoch} to
+    ``backup_dir``; when fit() starts, restore if a backup exists and
+    resume from the next epoch; delete the backup when training completes
+    normally.
+    """
+
+    def __init__(self, backup_dir: str):
+        super().__init__()
+        self.backup_dir = str(backup_dir)
+
+    def on_train_begin(self, logs=None):
+        self.model._maybe_restore_backup(self.backup_dir)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.model._back_up(self.backup_dir, epoch)
+
+    def on_train_end(self, logs=None):
+        shutil.rmtree(self.backup_dir, ignore_errors=True)

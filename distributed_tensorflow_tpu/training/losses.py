@@ -1,0 +1,113 @@
+"""Loss functions for the training-loop layer.
+
+≙ tf_keras losses as used by ``Model.compile`` (reference:
+tf_keras/src/losses.py). Each loss maps (y_true, y_pred) -> per-example
+loss; the Model applies sample weights and takes the GLOBAL mean inside
+the SPMD program, so the reference's per-replica loss scaling by
+``num_replicas_in_sync`` (tensorflow/python/distribute/distribute_lib.py:1675,
+tf_keras compile_utils) is satisfied by construction — there is one global
+mean, not N per-replica means.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Loss:
+    """Base loss: ``call`` returns per-example losses (batch leading)."""
+
+    def __init__(self, name: str | None = None):
+        self.name = name or type(self).__name__
+
+    def call(self, y_true, y_pred):
+        raise NotImplementedError
+
+    def __call__(self, y_true, y_pred):
+        return self.call(y_true, y_pred)
+
+
+class SparseCategoricalCrossentropy(Loss):
+    def __init__(self, from_logits: bool = True, name=None):
+        super().__init__(name)
+        self.from_logits = from_logits
+
+    def call(self, y_true, y_pred):
+        logits = y_pred if self.from_logits else jnp.log(
+            jnp.clip(y_pred, 1e-9, 1.0))
+        labels = y_true.astype(jnp.int32)
+        per = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels)
+        # collapse any extra (e.g. sequence) dims to one loss per example
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class CategoricalCrossentropy(Loss):
+    def __init__(self, from_logits: bool = True, name=None):
+        super().__init__(name)
+        self.from_logits = from_logits
+
+    def call(self, y_true, y_pred):
+        logits = y_pred if self.from_logits else jnp.log(
+            jnp.clip(y_pred, 1e-9, 1.0))
+        per = optax.softmax_cross_entropy(logits.astype(jnp.float32),
+                                          y_true.astype(jnp.float32))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class BinaryCrossentropy(Loss):
+    def __init__(self, from_logits: bool = True, name=None):
+        super().__init__(name)
+        self.from_logits = from_logits
+
+    def call(self, y_true, y_pred):
+        y = y_true.astype(jnp.float32)
+        p = y_pred.astype(jnp.float32)
+        if self.from_logits:
+            per = optax.sigmoid_binary_cross_entropy(p, y)
+        else:
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            per = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class MeanSquaredError(Loss):
+    def call(self, y_true, y_pred):
+        per = jnp.square(y_pred.astype(jnp.float32)
+                         - y_true.astype(jnp.float32))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class MeanAbsoluteError(Loss):
+    def call(self, y_true, y_pred):
+        per = jnp.abs(y_pred.astype(jnp.float32)
+                      - y_true.astype(jnp.float32))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+_ALIASES = {
+    "sparse_categorical_crossentropy": SparseCategoricalCrossentropy,
+    "categorical_crossentropy": CategoricalCrossentropy,
+    "binary_crossentropy": BinaryCrossentropy,
+    "mse": MeanSquaredError,
+    "mean_squared_error": MeanSquaredError,
+    "mae": MeanAbsoluteError,
+    "mean_absolute_error": MeanAbsoluteError,
+}
+
+
+def get(identifier) -> Loss:
+    if isinstance(identifier, Loss):
+        return identifier
+    if callable(identifier):
+        loss = Loss(getattr(identifier, "__name__", "loss"))
+        loss.call = identifier
+        return loss
+    if isinstance(identifier, str):
+        key = identifier.lower()
+        if key in _ALIASES:
+            return _ALIASES[key]()
+    raise ValueError(f"Unknown loss: {identifier!r}; "
+                     f"known: {sorted(_ALIASES)}")

@@ -1,0 +1,128 @@
+"""Metrics with functional (pytree) state for SPMD training loops.
+
+≙ tf_keras metrics as aggregated across replicas by Model.fit (reference:
+tf_keras/src/metrics/, aggregation in compile_utils.MetricsContainer).
+TF metrics are stateful objects whose variables are SyncOnRead with SUM
+aggregation (tensorflow/python/distribute/values.py:1294): each replica
+accumulates locally and reads reduce across replicas. Here metric state
+is an explicit pytree *inside* the jitted SPMD program: updates are
+computed on globally-sharded batches, so totals are already global —
+``result`` is pure arithmetic, no cross-replica read needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric:
+    """Functional metric: init() -> state, update(state, y, p, w) -> state,
+    result(state) -> scalar. States are tiny replicated arrays."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def init(self):
+        return {"total": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def update(self, state, y_true, y_pred, sample_weight=None):
+        values = self._values(y_true, y_pred).astype(jnp.float32)
+        values = values.reshape(values.shape[0], -1).mean(axis=-1)
+        if sample_weight is None:
+            sample_weight = jnp.ones_like(values)
+        w = sample_weight.astype(jnp.float32)
+        return {"total": state["total"] + jnp.sum(values * w),
+                "count": state["count"] + jnp.sum(w)}
+
+    def result(self, state):
+        return state["total"] / jnp.maximum(state["count"], 1e-9)
+
+    def _values(self, y_true, y_pred):
+        raise NotImplementedError
+
+
+class Mean(Metric):
+    """Weighted running mean of directly-supplied values (used for loss)."""
+
+    def __init__(self, name: str = "mean"):
+        super().__init__(name)
+
+    def update_values(self, state, values, sample_weight=None):
+        values = jnp.asarray(values, jnp.float32).reshape(-1)
+        if sample_weight is None:
+            sample_weight = jnp.ones_like(values)
+        w = jnp.asarray(sample_weight, jnp.float32).reshape(-1)
+        return {"total": state["total"] + jnp.sum(values * w),
+                "count": state["count"] + jnp.sum(w)}
+
+    def _values(self, y_true, y_pred):  # Mean used standalone
+        return jnp.asarray(y_pred, jnp.float32)
+
+
+class SparseCategoricalAccuracy(Metric):
+    def __init__(self, name: str = "accuracy"):
+        super().__init__(name)
+
+    def _values(self, y_true, y_pred):
+        pred = jnp.argmax(y_pred, axis=-1)
+        return (pred == y_true.astype(pred.dtype)).astype(jnp.float32)
+
+
+class CategoricalAccuracy(Metric):
+    def __init__(self, name: str = "accuracy"):
+        super().__init__(name)
+
+    def _values(self, y_true, y_pred):
+        return (jnp.argmax(y_pred, axis=-1)
+                == jnp.argmax(y_true, axis=-1)).astype(jnp.float32)
+
+
+class BinaryAccuracy(Metric):
+    def __init__(self, name: str = "accuracy", threshold: float = 0.5,
+                 from_logits: bool = True):
+        super().__init__(name)
+        self.threshold = threshold
+        self.from_logits = from_logits
+
+    def _values(self, y_true, y_pred):
+        p = jax.nn.sigmoid(y_pred) if self.from_logits else y_pred
+        pred = (p > self.threshold).astype(jnp.float32)
+        return (pred == y_true.astype(jnp.float32)).astype(jnp.float32)
+
+
+class MeanMetricWrapper(Metric):
+    """Wrap a ``fn(y_true, y_pred) -> per-example values`` as a metric."""
+
+    def __init__(self, fn, name: str | None = None):
+        super().__init__(name or getattr(fn, "__name__", "metric"))
+        self._fn = fn
+
+    def _values(self, y_true, y_pred):
+        return self._fn(y_true, y_pred)
+
+
+def get(identifier, *, loss=None) -> Metric:
+    """Resolve a metric identifier; "accuracy" picks the flavor matching
+    the compiled loss (≙ tf_keras compile_utils.get_metric)."""
+    from distributed_tensorflow_tpu.training import losses as losses_lib
+    if isinstance(identifier, Metric):
+        return identifier
+    if callable(identifier) and not isinstance(identifier, str):
+        return MeanMetricWrapper(identifier)
+    key = str(identifier).lower()
+    if key in ("accuracy", "acc"):
+        if isinstance(loss, losses_lib.BinaryCrossentropy):
+            return BinaryAccuracy()
+        if isinstance(loss, losses_lib.CategoricalCrossentropy):
+            return CategoricalAccuracy()
+        return SparseCategoricalAccuracy()
+    table = {
+        "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+        "categorical_accuracy": CategoricalAccuracy,
+        "binary_accuracy": BinaryAccuracy,
+    }
+    if key in table:
+        return table[key]()
+    raise ValueError(f"Unknown metric: {identifier!r}")

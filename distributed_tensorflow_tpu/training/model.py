@@ -1,0 +1,480 @@
+"""Model.compile / fit / evaluate / predict on a Strategy.
+
+≙ the reference's Keras training-loop layer (tf_keras/src/engine/
+training.py: fit :1453, make_train_function :1338, train_step :1118) —
+the L7 layer SURVEY.md §1 maps above tf.distribute. The reference builds
+a tf.function per replica and aggregates via the ~15 strategy hooks
+(distribute_lib.py:2394); the TPU-native redesign compiles ONE global
+SPMD train step over the strategy's mesh (the model of SURVEY §3.4):
+
+- loss is a sample-weighted GLOBAL mean inside the program, so the
+  reference's per-replica loss scaling by num_replicas_in_sync
+  (distribute_lib.py:1675) holds by construction;
+- metric state is an explicit pytree updated inside the program on the
+  globally-sharded batch (≙ SyncOnRead SUM variables, values.py:1294);
+- partial final batches are zero-padded with a sample-weight mask, so
+  one static batch shape compiles once and evaluate() is exact
+  (≙ get_next_as_optional partial-batch handling, input_lib.py:574).
+
+Works under any Strategy (OneDevice, Mirrored, MultiWorkerMirrored, TPU):
+build/compile inside ``strategy.scope()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_tpu.input.dataset import Dataset
+from distributed_tensorflow_tpu.training import callbacks as callbacks_lib
+from distributed_tensorflow_tpu.training import losses as losses_lib
+from distributed_tensorflow_tpu.training import metrics as metrics_lib
+
+_OPTIMIZERS = {
+    "sgd": lambda lr: optax.sgd(lr),
+    "adam": lambda lr: optax.adam(lr),
+    "adamw": lambda lr: optax.adamw(lr),
+    "rmsprop": lambda lr: optax.rmsprop(lr),
+}
+
+
+def _default_strategy():
+    from distributed_tensorflow_tpu.parallel.one_device import (
+        OneDeviceStrategy)
+    return OneDeviceStrategy()
+
+
+def _unflatten_like(template, flat: dict, prefix: str = ""):
+    """Inverse of checkpoint._flatten for plain pytrees."""
+    from collections.abc import Mapping
+    if isinstance(template, Mapping):
+        return type(template)(
+            {k: _unflatten_like(template[k],
+                                flat, f"{prefix}/{k}" if prefix else str(k))
+             for k in template})
+    if isinstance(template, (list, tuple)):
+        vals = [_unflatten_like(v, flat, f"{prefix}/{i}" if prefix else str(i))
+                for i, v in enumerate(template)]
+        if hasattr(template, "_fields"):      # NamedTuple (optax states)
+            return type(template)(*vals)
+        return type(template)(vals)
+    return flat[prefix or "value"]
+
+
+class Model:
+    """A trainable model: a flax module + optimizer + loss + metrics.
+
+    Usage (≙ tf_keras Model under a strategy scope)::
+
+        strategy = dtx.MirroredStrategy()
+        with strategy.scope():
+            model = dtx.training.Model(MNISTCNN())
+            model.compile(optimizer="adam", learning_rate=1e-3,
+                          loss="sparse_categorical_crossentropy",
+                          metrics=["accuracy"])
+        model.fit(x_train, y_train, epochs=3, batch_size=256,
+                  validation_data=(x_test, y_test))
+    """
+
+    def __init__(self, module, *, seed: int = 0):
+        self.module = module
+        self.seed = seed
+        self.strategy = None
+        self.stop_training = False
+        self._state = None              # {"params", "opt_state", "step"}
+        self._built = False
+        self._compiled = False
+        self._train_fn = None
+        self._eval_fn = None
+        self._predict_fn = None
+        self._restored_initial_epoch = None
+
+    # -- build / compile ---------------------------------------------------
+    def build(self, sample_input):
+        """Initialize parameters on the strategy's mesh (replicated)."""
+        self._ensure_strategy()
+        sample = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(np.shape(a), np.asarray(a).dtype),
+            sample_input)
+        rng = jax.random.PRNGKey(self.seed)
+
+        def init_params():
+            return self.module.init(rng, sample)["params"]
+
+        params = self.strategy.init_state(init_params)
+        self._state = {"params": params, "step": jnp.zeros((), jnp.int32)}
+        if self._compiled:
+            self._state["opt_state"] = self.strategy.init_state(
+                lambda: self._tx.init(params))
+        self._built = True
+
+    def _ensure_strategy(self):
+        if self.strategy is None:
+            from distributed_tensorflow_tpu.parallel.strategy import (
+                has_strategy, get_strategy)
+            self.strategy = (get_strategy() if has_strategy()
+                             else _default_strategy())
+
+    def compile(self, optimizer="adam", loss=None, metrics=(),
+                learning_rate: float | None = None):
+        """≙ Model.compile. ``optimizer``: optax GradientTransformation or
+        one of {"sgd", "adam", "adamw", "rmsprop"}; string optimizers (and
+        any optimizer when ``learning_rate`` is given) are wrapped in
+        ``optax.inject_hyperparams`` so LearningRateScheduler works."""
+        self._ensure_strategy()
+        if isinstance(optimizer, str):
+            key = optimizer.lower()
+            if key not in _OPTIMIZERS:
+                raise ValueError(f"Unknown optimizer {optimizer!r}; "
+                                 f"known: {sorted(_OPTIMIZERS)}")
+            lr = learning_rate if learning_rate is not None else 1e-3
+            maker = {"sgd": optax.sgd, "adam": optax.adam,
+                     "adamw": optax.adamw, "rmsprop": optax.rmsprop}[key]
+            self._tx = optax.inject_hyperparams(maker)(learning_rate=lr)
+        else:
+            self._tx = optimizer
+        if loss is None:
+            raise ValueError("compile() requires a loss")
+        self._loss = losses_lib.get(loss)
+        self._metrics = [metrics_lib.get(m, loss=self._loss)
+                         for m in (metrics or ())]
+        names = [m.name for m in self._metrics]
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate metric names: {names}")
+        self._loss_metric = metrics_lib.Mean("loss")
+        self._compiled = True
+        if self._built:
+            self._state["opt_state"] = self.strategy.init_state(
+                lambda: self._tx.init(self._state["params"]))
+        # new compile invalidates compiled functions
+        self._train_fn = self._eval_fn = self._predict_fn = None
+
+    # -- learning rate (LearningRateScheduler support) ---------------------
+    @property
+    def learning_rate(self) -> float:
+        hp = getattr(self._state["opt_state"], "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            raise AttributeError(
+                "optimizer has no mutable learning_rate; compile with a "
+                "string optimizer or optax.inject_hyperparams")
+        return float(hp["learning_rate"])
+
+    @learning_rate.setter
+    def learning_rate(self, value: float):
+        opt = self._state["opt_state"]
+        hp = getattr(opt, "hyperparams", None)
+        if hp is None or "learning_rate" not in hp:
+            raise AttributeError(
+                "optimizer has no mutable learning_rate; compile with a "
+                "string optimizer or optax.inject_hyperparams")
+        hp["learning_rate"] = jnp.asarray(value, jnp.float32)
+
+    # -- compiled step functions ------------------------------------------
+    def _metric_init(self):
+        ms = {"loss": self._loss_metric.init()}
+        for m in self._metrics:
+            ms[m.name] = m.init()
+        return self.strategy.replicate(ms)
+
+    def _metric_results(self, mstate) -> dict:
+        out = {"loss": float(self._loss_metric.result(mstate["loss"]))}
+        for m in self._metrics:
+            out[m.name] = float(m.result(mstate[m.name]))
+        return out
+
+    def _make_train_function(self):
+        if self._train_fn is not None:
+            return self._train_fn
+        module, loss_obj = self.module, self._loss
+        metrics, loss_metric = self._metrics, self._loss_metric
+        tx = self._tx
+
+        def step(state, mstate, batch):
+            x, y, sw = batch
+
+            def compute_loss(params):
+                preds = module.apply({"params": params}, x)
+                per = loss_obj.call(y, preds).astype(jnp.float32)
+                w = sw.astype(jnp.float32)
+                loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
+                return loss, (preds, per)
+
+            (loss, (preds, per)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state["params"])
+            updates, opt_state = tx.update(grads, state["opt_state"],
+                                           state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {"params": params, "opt_state": opt_state,
+                         "step": state["step"] + 1}
+            m2 = dict(mstate)
+            m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
+            for m in metrics:
+                m2[m.name] = m.update(mstate[m.name], y, preds, sw)
+            return new_state, m2
+
+        self._train_fn = self.strategy.compile_step(step)
+        return self._train_fn
+
+    def _make_eval_function(self):
+        if self._eval_fn is not None:
+            return self._eval_fn
+        module, loss_obj = self.module, self._loss
+        metrics, loss_metric = self._metrics, self._loss_metric
+
+        def eval_step(params, mstate, batch):
+            x, y, sw = batch
+            preds = module.apply({"params": params}, x)
+            per = loss_obj.call(y, preds).astype(jnp.float32)
+            m2 = dict(mstate)
+            m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
+            for m in metrics:
+                m2[m.name] = m.update(mstate[m.name], y, preds, sw)
+            return m2
+
+        self._eval_fn = jax.jit(eval_step)
+        return self._eval_fn
+
+    def _make_predict_function(self):
+        if self._predict_fn is not None:
+            return self._predict_fn
+        module = self.module
+        self._predict_fn = jax.jit(
+            lambda params, x: module.apply({"params": params}, x))
+        return self._predict_fn
+
+    # -- data plumbing -----------------------------------------------------
+    def _batches(self, x, y=None, sample_weight=None, *, batch_size,
+                 shuffle=False, seed=0):
+        """Yield (x, y, sw) global batches with a static batch size: the
+        final partial batch is zero-padded and masked via sw."""
+        if isinstance(x, Dataset) or (y is None and not isinstance(
+                x, (np.ndarray, jnp.ndarray))):
+            # pre-batched dataset / iterable of (x, y[, sw]) tuples
+            ds = Dataset.from_iterable(x)
+            static = [None]
+
+            def gen():
+                for el in ds:
+                    if not isinstance(el, (tuple, list)) or len(el) < 2:
+                        raise ValueError(
+                            "dataset elements must be (x, y) or (x, y, sw)")
+                    bx, by = el[0], el[1]
+                    bw = el[2] if len(el) > 2 else None
+                    n = np.shape(jax.tree_util.tree_leaves(bx)[0])[0]
+                    if static[0] is None:
+                        static[0] = n
+                    yield self._pad(bx, by, bw, n, static[0])
+            return gen()
+
+        x = np.asarray(x)
+        y = np.asarray(y)
+        n = len(x)
+
+        def gen():
+            idx = np.arange(n)
+            if shuffle:
+                np.random.default_rng(seed).shuffle(idx)
+            for start in range(0, n, batch_size):
+                sel = idx[start:start + batch_size]
+                bw = (np.asarray(sample_weight)[sel]
+                      if sample_weight is not None else None)
+                yield self._pad(x[sel], y[sel], bw, len(sel), batch_size)
+        return gen()
+
+    @staticmethod
+    def _pad(bx, by, bw, n, full):
+        sw = np.ones(n, np.float32) if bw is None else \
+            np.asarray(bw, np.float32)
+        if n == full:
+            return bx, by, sw
+
+        def pad(a):
+            a = np.asarray(a)
+            width = [(0, full - n)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, width)
+        return (jax.tree_util.tree_map(pad, bx),
+                jax.tree_util.tree_map(pad, by), pad(sw))
+
+    def _place(self, batch):
+        return self.strategy.shard_batch(batch)
+
+    # -- fit / evaluate / predict -----------------------------------------
+    def fit(self, x, y=None, *, batch_size: int = 32, epochs: int = 1,
+            verbose: int = 1, callbacks: Sequence | None = None,
+            validation_data=None, shuffle: bool = True,
+            initial_epoch: int = 0, steps_per_epoch: int | None = None,
+            sample_weight=None):
+        """≙ Model.fit (tf_keras training.py:1453)."""
+        if not self._compiled:
+            raise RuntimeError("compile() the model before fit()")
+        if not self._built:
+            first = next(iter(self._batches(
+                x, y, batch_size=batch_size, shuffle=False)))
+            self.build(first[0])
+            self._state["opt_state"] = self.strategy.init_state(
+                lambda: self._tx.init(self._state["params"]))
+
+        self.stop_training = False
+        history = callbacks_lib.History()
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(callbacks_lib.ProgbarLogger())
+        cbs.append(history)
+        cb_list = callbacks_lib.CallbackList(
+            cbs, self, {"epochs": epochs, "batch_size": batch_size})
+
+        train_fn = self._make_train_function()
+        want_batch_logs = any(
+            type(cb).on_train_batch_end
+            is not callbacks_lib.Callback.on_train_batch_end
+            for cb in cb_list.callbacks)
+
+        cb_list.on_train_begin()
+        start_epoch = initial_epoch
+        if self._restored_initial_epoch is not None:
+            start_epoch = max(start_epoch, self._restored_initial_epoch)
+            self._restored_initial_epoch = None
+
+        for epoch in range(start_epoch, epochs):
+            cb_list.on_epoch_begin(epoch)
+            mstate = self._metric_init()
+            steps = 0
+            for batch in self._batches(x, y, sample_weight,
+                                       batch_size=batch_size,
+                                       shuffle=shuffle,
+                                       seed=self.seed + epoch):
+                cb_list.on_train_batch_begin(steps)
+                self._state, mstate = train_fn(
+                    self._state, mstate, self._place(batch))
+                if want_batch_logs:
+                    cb_list.on_train_batch_end(
+                        steps, self._metric_results(mstate))
+                else:
+                    cb_list.on_train_batch_end(steps, None)
+                steps += 1
+                if steps_per_epoch and steps >= steps_per_epoch:
+                    break
+            logs = self._metric_results(mstate)
+            if validation_data is not None:
+                val = self.evaluate(*validation_data,
+                                    batch_size=batch_size, verbose=0)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            cb_list.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cb_list.on_train_end()
+        self.history = history
+        return history
+
+    def evaluate(self, x, y=None, *, batch_size: int = 32,
+                 verbose: int = 0, steps: int | None = None,
+                 sample_weight=None) -> dict:
+        """≙ Model.evaluate; returns {"loss": ..., metric: ...}. Exact on
+        partial final batches (mask-padded)."""
+        if not self._compiled or not self._built:
+            raise RuntimeError("build+compile the model before evaluate()")
+        eval_fn = self._make_eval_function()
+        mstate = self._metric_init()
+        count = 0
+        for batch in self._batches(x, y, sample_weight,
+                                   batch_size=batch_size):
+            mstate = eval_fn(self._state["params"], mstate,
+                             self._place(batch))
+            count += 1
+            if steps and count >= steps:
+                break
+        results = self._metric_results(mstate)
+        if verbose:
+            print("  ".join(f"{k}={v:.4f}" for k, v in results.items()),
+                  flush=True)
+        return results
+
+    def predict(self, x, *, batch_size: int = 32) -> Any:
+        if not self._built:
+            raise RuntimeError("build the model before predict()")
+        predict_fn = self._make_predict_function()
+        outs, total = [], 0
+        x = np.asarray(x)
+        for start in range(0, len(x), batch_size):
+            bx = x[start:start + batch_size]
+            n = len(bx)
+            if n < batch_size:
+                width = [(0, batch_size - n)] + [(0, 0)] * (bx.ndim - 1)
+                bx = np.pad(bx, width)
+            preds = predict_fn(self._state["params"],
+                               self._place(bx))
+            outs.append(np.asarray(preds)[:n])
+            total += n
+        return np.concatenate(outs, axis=0)
+
+    def __call__(self, x):
+        return self._make_predict_function()(self._state["params"], x)
+
+    # -- weights -----------------------------------------------------------
+    @property
+    def params(self):
+        return self._state["params"]
+
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self._state["params"])
+
+    def set_weights(self, weights):
+        shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, self._state["params"])
+        self._state["params"] = jax.tree_util.tree_map(
+            lambda w, s: jax.device_put(jnp.asarray(w), s),
+            weights, shardings)
+
+    def save_weights(self, path: str):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint)
+        Checkpoint(params=self._state["params"]).write(path)
+
+    def load_weights(self, path: str):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint)
+        restored = Checkpoint(
+            params=self._state["params"]).restore(path)
+        tree = _unflatten_like(self._state["params"], restored, "params")
+        self.set_weights(tree)
+
+    # -- backup/restore (≙ worker_training_state.py:34) -------------------
+    def _back_up(self, backup_dir: str, epoch: int):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint)
+        Checkpoint(
+            params=self._state["params"],
+            opt_state=self._state["opt_state"],
+            epoch=np.asarray(epoch, np.int64),
+        ).write(os.path.join(backup_dir, "backup"))
+
+    def _maybe_restore_backup(self, backup_dir: str):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint)
+        path = os.path.join(backup_dir, "backup")
+        if not os.path.exists(os.path.join(path, "checkpoint.index.json")):
+            return
+        ckpt = Checkpoint(params=self._state["params"],
+                          opt_state=self._state["opt_state"],
+                          epoch=np.zeros((), np.int64))
+        restored = ckpt.restore(path)
+        params = _unflatten_like(self._state["params"], restored, "params")
+        opt = _unflatten_like(self._state["opt_state"], restored,
+                              "opt_state")
+        shardings = jax.tree_util.tree_map(
+            lambda a: a.sharding, self._state["params"])
+        self._state["params"] = jax.tree_util.tree_map(
+            lambda w, s: jax.device_put(jnp.asarray(w), s), params,
+            shardings)
+        self._state["opt_state"] = jax.tree_util.tree_map(
+            lambda w, a: jax.device_put(
+                jnp.asarray(w, getattr(a, "dtype", None)),
+                getattr(a, "sharding", None)) if hasattr(a, "sharding")
+            else w,
+            opt, self._state["opt_state"])
+        self._restored_initial_epoch = int(restored["epoch"]) + 1
