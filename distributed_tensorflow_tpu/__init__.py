@@ -91,5 +91,9 @@ from distributed_tensorflow_tpu.input.dataset import (
 
 from distributed_tensorflow_tpu import models
 from distributed_tensorflow_tpu import ops
+from distributed_tensorflow_tpu import training
+from distributed_tensorflow_tpu.cluster.coordination import (
+    coordination_service,
+)
 
 __version__ = "0.1.0"

@@ -95,10 +95,23 @@ class Checkpoint:
         tmp = f"{path}.tmp.{proc}"
         os.makedirs(tmp, exist_ok=True)
 
+        # Read each leaf ONCE (ON_READ variables reduce on read — a device
+        # computation that must not run twice), then start every
+        # device->host transfer before blocking on any
+        # (≙ async_checkpoint_helper.py's copy-then-write split).
+        vals: dict[str, Any] = {}
+        for name, leaf in flat.items():
+            val = (leaf.read_value() if isinstance(leaf, DistributedVariable)
+                   else leaf)
+            vals[name] = val
+            if isinstance(val, jax.Array):
+                for s in val.addressable_shards:
+                    s.data.copy_to_host_async()
+
         index: dict[str, Any] = {"leaves": {}, "format": 1}
         host_arrays: dict[str, np.ndarray] = {}
         for name, leaf in flat.items():
-            arr, meta, offset = self._extract(name, leaf)
+            arr, meta, offset = self._extract(name, leaf, vals[name])
             index["leaves"][name] = meta
             if arr is not None:
                 key = self._fname(name)
@@ -109,10 +122,7 @@ class Checkpoint:
 
         def finish():
             np.savez(os.path.join(tmp, f"shard_{proc}.npz"), **host_arrays)
-            if proc == 0:
-                with open(os.path.join(tmp, _INDEX_FILE), "w") as f:
-                    json.dump(index, f)
-            self._commit(tmp, path)
+            self._commit(tmp, path, index)
 
         def finish_async():
             try:
@@ -130,13 +140,56 @@ class Checkpoint:
             finish()                     # sync path: raise right here
         return path
 
-    def _commit(self, tmp: str, path: str):
-        """Atomic-ish rename; multi-process safe because shard files have
-        distinct names and process 0 lays down the index last."""
+    def _commit(self, tmp: str, path: str, index: dict):
+        """Multi-host commit protocol (≙ checkpoint_management's
+        chief-writes-last contract, hardened):
+
+        1. every process renames its shard files into ``path``;
+        2. cross-process barrier — no host proceeds until ALL shards are
+           in place (TSL coordination service; no-op single-process);
+        3. process 0 writes the index to a temp name and atomically
+           renames it LAST — the index's existence marks the checkpoint
+           complete (``_list_checkpoints`` keys on it), so a torn
+           checkpoint is never observable;
+        4. exit barrier so no process returns (and e.g. starts a restore
+           or another save into the same path) before the index exists.
+        """
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
         os.makedirs(path, exist_ok=True)
         for f in os.listdir(tmp):
             os.replace(os.path.join(tmp, f), os.path.join(path, f))
         os.rmdir(tmp)
+        # Token = basename + abspath hash: two saves into different
+        # directories that share a basename (e.g. every Model backup dir
+        # is ".../backup") must NOT meet at the same barrier.
+        import hashlib
+        token = (os.path.basename(path) + "."
+                 + hashlib.sha1(os.path.abspath(path).encode())
+                 .hexdigest()[:12])
+        if agent.is_distributed:
+            try:
+                agent.barrier(f"ckpt_shards/{token}", timeout_s=600.0)
+            except Exception as e:
+                # Peer death mid-save (preemption best-effort path): a
+                # possibly-incomplete checkpoint beats none. Warn loudly.
+                import sys
+                print(f"[dtx.checkpoint] WARNING: shard barrier failed "
+                      f"({e}); committing possibly-incomplete checkpoint "
+                      f"{path}", file=sys.stderr)
+        if agent.is_chief:
+            tmp_index = os.path.join(path, _INDEX_FILE + ".tmp")
+            with open(tmp_index, "w") as f:
+                json.dump(index, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp_index, os.path.join(path, _INDEX_FILE))
+        if agent.is_distributed:
+            try:
+                agent.barrier(f"ckpt_index/{token}", timeout_s=600.0)
+            except Exception:
+                pass            # exit barrier is best-effort by nature
 
     def _join_pending(self):
         if self._async_thread is not None and self._async_thread.is_alive():
@@ -153,10 +206,12 @@ class Checkpoint:
     def _fname(name: str) -> str:
         return re.sub(r"[^A-Za-z0-9_.-]", "__", name)
 
-    def _extract(self, name, leaf):
-        """Returns (host_array_or_None, index_meta) for this process."""
+    def _extract(self, name, leaf, val=None):
+        """Returns (host_array_or_None, index_meta) for this process.
+        ``val`` is the pre-read leaf value (read exactly once by write)."""
         if isinstance(leaf, DistributedVariable):
-            val = leaf.read_value()
+            if val is None:
+                val = leaf.read_value()
             meta = {"kind": "variable", "shape": list(np.shape(val)),
                     "dtype": str(np.asarray(val).dtype) if np.ndim(val) == 0
                     else str(val.dtype)}
@@ -274,12 +329,52 @@ class CheckpointManager:
                              if keep_checkpoint_every_n_hours else None)
         self._name = checkpoint_name
         self._kept_pinned: list[str] = []
-        self._last_pin_time = 0.0
+        # Pin clock starts NOW (≙ the reference's last_preserved_timestamp,
+        # checkpoint_management.py:519): the first sweep must NOT pin —
+        # a 0.0 epoch origin made `now - last_pin >= keep_every_s` true
+        # immediately, permanently pinning the first rotated checkpoint.
+        self._last_pin_time = time.time()
         os.makedirs(directory, exist_ok=True)
+        self._load_meta()
 
     @property
     def _prefix(self) -> str:
         return os.path.join(self.directory, self._name)
+
+    # Pin state persists across manager restarts (≙ the reference keeping
+    # last_preserved_timestamp in the CheckpointState proto).
+    @property
+    def _meta_path(self) -> str:
+        return os.path.join(self.directory, f"{self._name}.manager.json")
+
+    def _load_meta(self):
+        if not os.path.exists(self._meta_path):
+            return
+        try:
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            self._last_pin_time = float(meta.get("last_pin_time",
+                                                 self._last_pin_time))
+            # Pins are persisted as basenames so a manager restarted from
+            # a different cwd (or via a different path to the same dir)
+            # keeps them out of rotation.
+            self._kept_pinned = [
+                os.path.join(self.directory, os.path.basename(p))
+                for p in meta.get("pinned", [])
+                if os.path.isdir(os.path.join(self.directory,
+                                              os.path.basename(p)))]
+        except (ValueError, OSError):
+            pass
+
+    def _save_meta(self):
+        if jax.process_index() != 0:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"last_pin_time": self._last_pin_time,
+                       "pinned": [os.path.basename(p)
+                                  for p in self._kept_pinned]}, f)
+        os.replace(tmp, self._meta_path)
 
     def _list_checkpoints(self) -> list[tuple[int, str]]:
         pat = re.compile(re.escape(self._name) + r"-(\d+)$")
@@ -315,14 +410,19 @@ class CheckpointManager:
         cks = [(n, p) for n, p in self._list_checkpoints()
                if p not in self._kept_pinned]
         now = time.time()
+        changed = False
         while len(cks) > self.max_to_keep:
             num, path = cks.pop(0)
             if self.keep_every_s is not None and \
                     now - self._last_pin_time >= self.keep_every_s:
                 self._kept_pinned.append(path)
                 self._last_pin_time = now
+                changed = True
                 continue
-            shutil.rmtree(path, ignore_errors=True)
+            if jax.process_index() == 0:
+                shutil.rmtree(path, ignore_errors=True)
+        if changed:
+            self._save_meta()
 
     def restore_or_initialize(self) -> str | None:
         """≙ CheckpointManager.restore_or_initialize: restore latest if one

@@ -81,7 +81,23 @@ class PreemptionCheckpointHandler:
         self._step = 0
         self._run_count_restored = 0
         self._exited = False
+        self._save_at: int | None = None
+        self._sync_thread: threading.Thread | None = None
+        self._signal_poller: threading.Thread | None = None
         self._poller: threading.Thread | None = None
+        # Job-scoped keys: shared by all processes of this job (same
+        # checkpoint dir — hashed abspath, so two jobs whose directories
+        # share a basename never cross-signal), distinct across jobs.
+        import hashlib
+        absdir = os.path.abspath(checkpoint_manager.directory)
+        job = (os.path.basename(absdir) + "."
+               + hashlib.sha1(absdir.encode()).hexdigest()[:12])
+        self._SIGNAL_KEY = f"dtx_preemption/{job}/signal"
+        self._STEPS_PREFIX = f"dtx_preemption/{job}/steps"
+        self._GATHER_BARRIER = f"dtx_preemption/{job}/gather"
+        self._CONFIRM_PREFIX = f"dtx_preemption/{job}/confirm"
+        self._confirm_round = 0
+        self._sync_error: BaseException | None = None
 
         # restore first (≙ failure_handling.py:647 restore-on-init)
         latest = self._manager.restore_or_initialize()
@@ -92,6 +108,10 @@ class PreemptionCheckpointHandler:
         if self._config.termination_watcher_fn is not None:
             self._poller = threading.Thread(target=self._poll, daemon=True)
             self._poller.start()
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        if coordination_service().is_distributed:
+            self._start_signal_poller()
 
     # -- signal plumbing ---------------------------------------------------
     def _install_signal_handler(self):
@@ -131,6 +151,16 @@ class PreemptionCheckpointHandler:
         """Manually mark a preemption notice (tests/fault injection)."""
         self._received.set()
 
+    def finalize(self):
+        """Call after the training loop: if a preemption was signalled but
+        the agreed save step was never reached (the loop ran out first —
+        e.g. the signal landed on the last step), checkpoint NOW so the
+        progress isn't lost. No-op otherwise."""
+        if self._exited or not self._received.is_set():
+            return
+        self._save_at = self._step          # save at wherever we stopped
+        self._check_preemption_and_maybe_checkpoint()
+
     def run(self, distributed_train_fn: Callable, *args, **kwargs):
         """Run one step, then checkpoint-and-exit if preemption was
         signalled (≙ failure_handling.py:805/:1082)."""
@@ -139,28 +169,154 @@ class PreemptionCheckpointHandler:
         self._check_preemption_and_maybe_checkpoint()
         return result
 
-    def _agree_on_preemption(self) -> bool:
-        """All processes must agree before saving (≙ the KV-store
-        "step to save at" protocol, failure_handling.py:1222). Any process
-        that saw the signal wins."""
-        local = self._received.is_set()
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            agreed = multihost_utils.process_allgather(
-                np.asarray([local], dtype=np.bool_))
-            return bool(np.any(agreed))
-        return local
+    def _start_signal_poller(self):
+        """Multi-process only: a daemon thread that notices a PEER's
+        preemption signal via the coordination KV store (≙ the reference's
+        _watch_step_to_save_key thread, failure_handling.py:1222) without
+        any per-step RPC on the training path."""
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+
+        def poll():
+            while not self._received.is_set() and not self._exited:
+                if agent.key_value_try_get(self._SIGNAL_KEY) is not None:
+                    self._received.set()
+                    return
+                time.sleep(0.1)
+
+        self._signal_poller = threading.Thread(target=poll, daemon=True)
+        self._signal_poller.start()
+
+    def _agree_on_preemption(self) -> int | None:
+        """Cross-process agreement on the step to save at (≙ the
+        reference's gather-run-counts-then-run-to-max protocol,
+        failure_handling.py:1222):
+
+        1. the signalled process sets a job-wide SIGNAL key; peers notice
+           via their poller threads (no per-step RPC);
+        2. every process publishes its current step and joins a barrier
+           **on a background thread** — the main loop keeps stepping, so
+           in-flight SPMD collectives keep completing and the agreement
+           can never deadlock against the data plane;
+        3. save_at = max(published steps) + margin; every process runs to
+           exactly that step and checkpoints there.
+
+        Returns the agreed step, or None while agreement is pending.
+        Single-process degenerates to "save at the current step, now".
+        """
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        if not self._received.is_set():
+            return self._save_at
+        if not agent.is_distributed:
+            if self._save_at is None:
+                self._save_at = self._step
+            return self._save_at
+        if self._sync_thread is None:
+            try:
+                agent.key_value_set(self._SIGNAL_KEY, "1",
+                                    allow_overwrite=False)
+            except Exception:
+                pass                       # a peer signalled first — fine
+
+            def sync():
+                try:
+                    agent.key_value_set(
+                        f"{self._STEPS_PREFIX}/p{agent.process_id}",
+                        str(self._step))
+                    agent.barrier(self._GATHER_BARRIER, timeout_s=600)
+                    steps = [int(v) for _, v in
+                             agent.key_value_dir_get(
+                                 self._STEPS_PREFIX + "/")]
+                    # margin covers steps taken while the barrier settled
+                    self._save_at = max(steps) + 2
+                except BaseException as e:
+                    # A peer died mid-agreement (the very case preemption
+                    # handling exists for): degrade to a best-effort local
+                    # save at the next step instead of swallowing the
+                    # signal forever.
+                    self._sync_error = e
+                    self._save_at = self._step + 1
+
+            self._sync_thread = threading.Thread(target=sync, daemon=True)
+            self._sync_thread.start()
+        return self._save_at
+
+    def _confirm_stop_step(self, save_at: int) -> bool:
+        """Phase 2 of the agreement: every process publishes the step it
+        actually stopped at and all confirm equality. A process that ran
+        past ``save_at`` before noticing (RPC latency beat the +2 margin)
+        raises the target to the max, everyone catches up, and the round
+        repeats — so the committed checkpoint's shards all come from the
+        SAME step. Runs on the main thread; a blocked process has already
+        enqueued all its steps, so peers' in-flight collectives complete.
+
+        Returns True when this process should save now.
+        """
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        if not agent.is_distributed or self._sync_error is not None:
+            return True
+        del save_at
+        while True:
+            r = self._confirm_round
+            try:
+                agent.key_value_set(
+                    f"{self._CONFIRM_PREFIX}{r}/p{agent.process_id}",
+                    str(self._step))
+                agent.barrier(f"{self._CONFIRM_PREFIX}{r}/barrier",
+                              timeout_s=600)
+                steps = [int(v) for _, v in agent.key_value_dir_get(
+                    f"{self._CONFIRM_PREFIX}{r}/")]
+                final = max(steps)
+            except Exception as e:
+                self._sync_error = e
+                return True                # degraded best-effort save
+            self._confirm_round += 1       # every process, every round
+            if min(steps) == final:
+                return True                # all stopped at the same step
+            if self._step < final:
+                # laggard: run to the raised target, then confirm again
+                self._save_at = final
+                return False
+            # already at the target: confirm again without stepping
+            # (blocking here is safe — all our steps are enqueued, so
+            # peers' in-flight collectives still complete)
 
     def _check_preemption_and_maybe_checkpoint(self):
-        if self._exited or not self._agree_on_preemption():
+        if self._exited:
+            return
+        save_at = self._agree_on_preemption()
+        if save_at is None or self._step < save_at:
+            return
+        if not self._confirm_stop_step(save_at):
             return
         deadline = time.time() + (self._config.grace_period or 0.0)
         if self._config.save_fn is not None:
             self._config.save_fn()
+            # NOTE: no key retirement here — a custom save_fn has no
+            # commit barrier, so a peer's sync thread may still be
+            # reading the agreement keys.
         else:
-            self._manager.save(checkpoint_number=self._step +
-                               self._run_count_restored)
+            self._manager.save(checkpoint_number=self._save_at +
+                               self._run_count_restored
+                               if self._save_at is not None
+                               else self._step + self._run_count_restored)
             self._manager.checkpoint.sync()
+            # Every process has saved (save's commit protocol ends with a
+            # cross-process barrier), so the agreement keys can be
+            # retired — a later handler on this job must start clean.
+            from distributed_tensorflow_tpu.cluster.coordination import (
+                coordination_service)
+            agent = coordination_service()
+            try:
+                agent.key_value_delete(self._SIGNAL_KEY)
+                agent.key_value_delete(self._STEPS_PREFIX)
+            except Exception:
+                pass
         # grace-period countdown (≙ failure_handling.py:1204): wait out
         # the full window in small slices so tests can interrupt.
         while True:

@@ -76,6 +76,14 @@ def initialize(resolver: ClusterResolver | None = None,
 
         did_init = False
         if num_processes > 1 and coordinator_address:
+            # CPU backend stands in for DCN in tests/CI: use gloo so
+            # cross-process collectives actually execute (the TPU path
+            # needs nothing — collectives ride ICI inside XLA programs).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,

@@ -190,6 +190,13 @@ class DistributedVariable:
                 f"assign shape {value.shape} != variable shape {self._value.shape}")
         if self._mesh is not None:
             value = jax.device_put(value, NamedSharding(self._mesh, self._spec))
+        elif isinstance(getattr(self._value, "sharding", None),
+                        NamedSharding):
+            # Variable built from an already-sharded array: a write must
+            # preserve the layout (multi-host restore re-places global
+            # host data onto the original sharding — ≙ values.py saveable
+            # restore re-placement, :1159).
+            value = jax.device_put(value, self._value.sharding)
         self._value = value
         return self
 

@@ -8,3 +8,4 @@ devices — here the 8-device CPU mesh from tests/conftest.py).
 
 from distributed_tensorflow_tpu.testing.strategy_conformance import (  # noqa: F401
     StrategyConformance)
+from distributed_tensorflow_tpu.testing import multi_process_runner  # noqa: F401

@@ -1,0 +1,329 @@
+"""Multi-process test infrastructure: run test fns in real processes.
+
+TPU-native equivalent of the reference's MultiProcessRunner
+(reference: tensorflow/python/distribute/multi_process_runner.py:107 —
+fork-per-task with TF_CONFIG injection, stdout capture, process kill,
+return-value collection) and multi_worker_test_base.py:123 (in-process
+cluster creation). Differences by design:
+
+- Tasks are ``multiprocessing`` *spawn* processes (a fresh interpreter:
+  no inherited JAX backend state — the analogue of the reference's
+  _ProcFunc re-exec), not forks of a TF runtime.
+- The cluster's "server" is the TSL coordination service started by
+  ``jax.distributed.initialize`` inside task (worker, 0); there is no
+  grpc worker server to start (SURVEY.md §2.7 mapping).
+- CPU backend with gloo cross-process collectives stands in for DCN.
+
+Usage::
+
+    def worker_fn():
+        runtime = bootstrap.initialize()           # reads TF_CONFIG
+        ...
+        return jax.process_index()
+
+    result = multi_process_runner.run(worker_fn, num_workers=2)
+    assert result.return_values == [0, 1]
+
+Test fns must be module-level (picklable by reference) since spawn
+re-imports the defining module in the child.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import multiprocessing
+import os
+import pickle
+import socket
+import sys
+import time
+import traceback
+from typing import Any, Callable, Mapping, Sequence
+
+_MP = multiprocessing.get_context("spawn")
+
+# Signals a child used sys.exit / os._exit deliberately (fault tests).
+_DELIBERATE_EXIT_CODES = frozenset({0})
+
+
+def pick_unused_port() -> int:
+    """Reserve an ephemeral localhost port and release it for the task."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def create_cluster_spec(num_workers: int = 1, num_ps: int = 0,
+                        has_chief: bool = False) -> dict[str, list[str]]:
+    """≙ multi_worker_test_base.create_cluster_spec: localhost addresses
+    with freshly picked ports."""
+    spec: dict[str, list[str]] = {}
+    if has_chief:
+        spec["chief"] = [f"127.0.0.1:{pick_unused_port()}"]
+    if num_workers:
+        spec["worker"] = [f"127.0.0.1:{pick_unused_port()}"
+                          for _ in range(num_workers)]
+    if num_ps:
+        spec["ps"] = [f"127.0.0.1:{pick_unused_port()}"
+                      for _ in range(num_ps)]
+    return spec
+
+
+@dataclasses.dataclass
+class TaskResult:
+    task_type: str
+    task_id: int
+    exitcode: int | None
+    value: Any = None
+    error: str | None = None
+    stdout: str = ""
+
+
+@dataclasses.dataclass
+class MultiProcessRunnerResult:
+    """≙ the reference's MultiProcessRunnerResult (return_value, stdout)."""
+    tasks: dict[tuple[str, int], TaskResult]
+
+    @property
+    def return_values(self) -> list[Any]:
+        return [t.value for t in self._ordered() if t.error is None
+                and t.exitcode == 0]
+
+    @property
+    def stdout(self) -> list[str]:
+        return [t.stdout for t in self._ordered()]
+
+    def _ordered(self) -> list[TaskResult]:
+        return [self.tasks[k] for k in sorted(self.tasks)]
+
+
+class UnexpectedSubprocessExitError(RuntimeError):
+    """A task died without reporting a result (crash / external kill)."""
+
+    def __init__(self, msg: str, result: MultiProcessRunnerResult):
+        super().__init__(msg)
+        self.mpr_result = result
+
+
+class SubprocessError(RuntimeError):
+    """A task raised; carries the child traceback."""
+
+    def __init__(self, msg: str, result: MultiProcessRunnerResult):
+        super().__init__(msg)
+        self.mpr_result = result
+
+
+def _child_main(env: dict, payload: bytes, task_type: str, task_id: int,
+                conn, stdout_path: str):
+    """Spawn-process entry. Sets env BEFORE unpickling the user fn (which
+    imports its defining module, and typically jax)."""
+    os.environ.update(env)
+    # Capture this task's stdout/stderr to a file the parent reads back
+    # (≙ multi_process_runner's per-task log capture).
+    sys.stdout.flush(); sys.stderr.flush()
+    out_f = open(stdout_path, "w", buffering=1)
+    os.dup2(out_f.fileno(), 1)
+    os.dup2(out_f.fileno(), 2)
+    try:
+        import jax
+        jax.config.update("jax_platforms",
+                          env.get("JAX_PLATFORMS", "cpu"))
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        fn, args, kwargs = pickle.loads(payload)
+        value = fn(*args, **kwargs)
+        try:
+            conn.send(("ok", value))
+        except Exception:
+            conn.send(("ok", repr(value)))   # unpicklable return value
+        exitcode = 0
+    except BaseException:
+        conn.send(("error", traceback.format_exc()))
+        exitcode = 1
+    with contextlib.suppress(Exception):
+        conn.close()
+    out_f.flush()
+    # Skip interpreter teardown: a dead peer can leave the coordination
+    # client's shutdown path hanging, and atexit hooks must not wedge the
+    # harness (≙ multi_process_runner's _ProcFunc sys.exit discipline).
+    os._exit(exitcode)
+
+
+class MultiProcessRunner:
+    """Run ``fn`` once per cluster task in separate spawn processes.
+
+    ≙ multi_process_runner.MultiProcessRunner(:107): TF_CONFIG is
+    injected per task; the worker-0 address doubles as the coordination
+    service (jax.distributed coordinator). ``terminate`` SIGKILLs a task
+    for fault-tolerance tests (:646 ``terminate``), and ``join`` collects
+    return values / re-raises child failures.
+    """
+
+    def __init__(self, fn: Callable, cluster_spec: Mapping[str, Sequence[str]],
+                 *, args: tuple = (), kwargs: dict | None = None,
+                 env: Mapping[str, str] | None = None,
+                 devices_per_process: int = 1,
+                 init_jax_distributed: bool = False,
+                 timeout: float = 300.0):
+        self._fn = fn
+        self._spec = {k: list(v) for k, v in cluster_spec.items()}
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._extra_env = dict(env or {})
+        self._devices = devices_per_process
+        self._init_jax = init_jax_distributed
+        self._timeout = timeout
+        self._procs: dict[tuple[str, int], Any] = {}
+        self._conns: dict[tuple[str, int], Any] = {}
+        self._stdout: dict[tuple[str, int], str] = {}
+        self._results: dict[tuple[str, int], TaskResult] = {}
+        self._tmpdir = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        import tempfile
+        self._tmpdir = tempfile.mkdtemp(prefix="mpr_")
+        payload = pickle.dumps((self._fn, self._args, self._kwargs))
+        ntasks = sum(len(v) for v in self._spec.values())
+        task_index = 0
+        for task_type in sorted(self._spec):
+            for task_id, _ in enumerate(self._spec[task_type]):
+                env = dict(os.environ)
+                env.update({
+                    "TF_CONFIG": json.dumps({
+                        "cluster": self._spec,
+                        "task": {"type": task_type, "index": task_id},
+                    }),
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": (
+                        env.get("XLA_FLAGS", "").replace(
+                            "--xla_force_host_platform_device_count=8", "")
+                        + f" --xla_force_host_platform_device_count="
+                          f"{self._devices}"),
+                    "DTX_MPR_NUM_TASKS": str(ntasks),
+                    "DTX_MPR_TASK_INDEX": str(task_index),
+                })
+                env.update(self._extra_env)
+                parent_conn, child_conn = _MP.Pipe()
+                stdout_path = os.path.join(
+                    self._tmpdir, f"{task_type}_{task_id}.out")
+                p = _MP.Process(
+                    target=_child_main,
+                    args=(env, payload, task_type, task_id, child_conn,
+                          stdout_path),
+                    daemon=True)
+                p.start()
+                child_conn.close()
+                key = (task_type, task_id)
+                self._procs[key] = p
+                self._conns[key] = parent_conn
+                self._stdout[key] = stdout_path
+                task_index += 1
+        return self
+
+    def terminate(self, task_type: str, task_id: int):
+        """SIGKILL one task (≙ multi_process_runner.terminate :646)."""
+        p = self._procs[(task_type, task_id)]
+        p.kill()
+        p.join(10)
+
+    def terminate_all(self):
+        for p in self._procs.values():
+            if p.is_alive():
+                p.kill()
+        for p in self._procs.values():
+            p.join(5)
+
+    def join(self, timeout: float | None = None,
+             raise_on_error: bool = True) -> MultiProcessRunnerResult:
+        deadline = time.monotonic() + (timeout or self._timeout)
+        pending = dict(self._procs)
+        while pending and time.monotonic() < deadline:
+            for key, p in list(pending.items()):
+                p.join(0.05)
+                if p.exitcode is not None:
+                    self._collect(key)
+                    del pending[key]
+        if pending:
+            for key in pending:
+                self._collect(key, timed_out=True)
+            self.terminate_all()
+            result = MultiProcessRunnerResult(dict(self._results))
+            raise UnexpectedSubprocessExitError(
+                f"tasks {sorted(pending)} did not exit within "
+                f"{timeout or self._timeout}s; stdout:\n"
+                + self._format_logs(pending), result)
+
+        result = MultiProcessRunnerResult(dict(self._results))
+        if raise_on_error:
+            errors = {k: t for k, t in self._results.items()
+                      if t.error is not None}
+            if errors:
+                k = sorted(errors)[0]
+                raise SubprocessError(
+                    f"task {k} raised:\n{errors[k].error}", result)
+            # exit 1 is only "expected" when _child_main actually
+            # delivered the error; a task that died before reporting
+            # (spawn bootstrap failure, broken pipe) must raise.
+            crashed = {k: t for k, t in self._results.items()
+                       if t.exitcode != 0 and t.error is None}
+            if crashed:
+                raise UnexpectedSubprocessExitError(
+                    f"tasks {sorted(crashed)} exited abnormally "
+                    f"({ {k: t.exitcode for k, t in crashed.items()} }); "
+                    f"stdout:\n" + self._format_logs(crashed), result)
+        return result
+
+    def _collect(self, key, timed_out: bool = False):
+        if key in self._results:
+            return
+        p = self._procs[key]
+        conn = self._conns[key]
+        value, error = None, None
+        if conn.poll(0 if not timed_out else 0.1):
+            try:
+                status, data = conn.recv()
+                if status == "ok":
+                    value = data
+                else:
+                    error = data
+            except (EOFError, OSError):
+                pass
+        stdout = ""
+        path = self._stdout.get(key)
+        if path and os.path.exists(path):
+            with open(path, errors="replace") as f:
+                stdout = f.read()
+        self._results[key] = TaskResult(
+            task_type=key[0], task_id=key[1], exitcode=p.exitcode,
+            value=value, error=error, stdout=stdout)
+
+    def _format_logs(self, keys) -> str:
+        parts = []
+        for key in sorted(keys):
+            self._collect(key)
+            t = self._results[key]
+            parts.append(f"--- {key} (exit {t.exitcode}) ---\n"
+                         f"{t.stdout[-2000:]}")
+        return "\n".join(parts)
+
+
+def run(fn: Callable, *, num_workers: int = 2, num_ps: int = 0,
+        has_chief: bool = False, args: tuple = (), kwargs: dict | None = None,
+        env: Mapping[str, str] | None = None, devices_per_process: int = 1,
+        timeout: float = 300.0) -> MultiProcessRunnerResult:
+    """One-call form (≙ multi_process_runner.run :1332): build a localhost
+    cluster spec, start every task, join, return results."""
+    spec = create_cluster_spec(num_workers=num_workers, num_ps=num_ps,
+                               has_chief=has_chief)
+    runner = MultiProcessRunner(
+        fn, spec, args=args, kwargs=kwargs, env=env,
+        devices_per_process=devices_per_process, timeout=timeout)
+    runner.start()
+    try:
+        return runner.join(timeout)
+    finally:
+        runner.terminate_all()

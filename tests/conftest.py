@@ -19,6 +19,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multiprocess: spawns real OS processes (multi_process_runner)")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
