@@ -11,11 +11,13 @@ failure_handling.py (SURVEY.md §2.5, §3.5):
   save at", checkpoints there, and exits (or counts down a grace period).
 
 The cross-process agreement protocol in the reference rides the
-coordination-service KV store plus a collective (_watch_step_to_save_key,
-failure_handling.py:1222). Here the same two primitives are
-``jax.experimental.multihost_utils`` broadcast (coordination-service backed)
-— on a single process it degenerates to a local flag, which is what the
-tests exercise; the multi-host path reuses the identical code.
+coordination-service KV store plus a step-count gather
+(_watch_step_to_save_key, failure_handling.py:1222). Here it rides the
+same KV store through cluster/coordination.py: signal key -> background
+gather of step counts -> run-to-max -> confirm rounds (see
+``_agree_on_preemption``/``_confirm_stop_step``). Single-process
+degenerates to a local flag; the multi-host path is exercised by
+tests/test_multi_process.py.
 """
 
 from __future__ import annotations
@@ -28,7 +30,6 @@ import time
 from typing import Callable
 
 import jax
-import numpy as np
 
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
@@ -152,12 +153,28 @@ class PreemptionCheckpointHandler:
         self._received.set()
 
     def finalize(self):
-        """Call after the training loop: if a preemption was signalled but
-        the agreed save step was never reached (the loop ran out first —
-        e.g. the signal landed on the last step), checkpoint NOW so the
-        progress isn't lost. No-op otherwise."""
-        if self._exited or not self._received.is_set():
+        """Call after the training loop (on every process): if a
+        preemption was signalled but the agreed save step was never
+        reached (the loop ran out first — e.g. the signal landed on the
+        last step), checkpoint NOW so the progress isn't lost. No-op
+        otherwise."""
+        if self._exited:
             return
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        # a peer may have signalled after our last in-loop poll
+        if (not self._received.is_set() and agent.is_distributed
+                and agent.key_value_try_get(self._SIGNAL_KEY) is not None):
+            self._received.set()
+        if not self._received.is_set():
+            return
+        # publish our signal/steps + start the sync thread if the signal
+        # arrived after the last step's check, then wait it out so its
+        # `_save_at = max + 2` cannot overwrite the override below
+        self._agree_on_preemption()
+        if self._sync_thread is not None and self._sync_thread.is_alive():
+            self._sync_thread.join(timeout=600)
         self._save_at = self._step          # save at wherever we stopped
         self._check_preemption_and_maybe_checkpoint()
 
@@ -276,11 +293,14 @@ class PreemptionCheckpointHandler:
                 self._sync_error = e
                 return True                # degraded best-effort save
             self._confirm_round += 1       # every process, every round
+            # EVERY process adopts the confirmed step — the save path
+            # derives the checkpoint number (and thus the commit-barrier
+            # token) from _save_at, which must be identical on all hosts.
+            self._save_at = final
             if min(steps) == final:
                 return True                # all stopped at the same step
             if self._step < final:
                 # laggard: run to the raised target, then confirm again
-                self._save_at = final
                 return False
             # already at the target: confirm again without stepping
             # (blocking here is safe — all our steps are enqueued, so

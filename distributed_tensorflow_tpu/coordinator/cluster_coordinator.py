@@ -126,7 +126,7 @@ class Closure:
         self.kwargs = kwargs or {}
         self.output = RemoteValue()
 
-    def execute_on(self, worker: "Worker"):
+    def _resolved(self, worker: "Worker"):
         def resolve(v):
             return v.values[worker.worker_index] \
                 if isinstance(v, PerWorkerValues) else v
@@ -137,8 +137,20 @@ class Closure:
         kwargs = jax.tree_util.tree_map(
             resolve, self.kwargs,
             is_leaf=lambda v: isinstance(v, PerWorkerValues))
+        return args, kwargs
+
+    def execute_on(self, worker: "Worker"):
+        args, kwargs = self._resolved(worker)
         with worker.device_scope():
             result = self.fn(*args, **kwargs)
+        self.output._set_value(result)
+
+    def execute_remote(self, worker: "Worker"):
+        """Ship to the worker's remote process (≙ the grpc dispatch in
+        cluster_coordinator.py:1027); WorkerPreemptionError propagates to
+        the caller for transparent re-queue."""
+        args, kwargs = self._resolved(worker)
+        result = worker.lane.execute(self.fn, args, kwargs)
         self.output._set_value(result)
 
     def mark_cancelled(self):
@@ -240,12 +252,16 @@ class _CoordinatedClosureQueue:
 
 class Worker:
     """One dispatch lane (≙ cluster_coordinator.py:1027): a thread pulling
-    closures and executing them against this worker's device."""
+    closures and executing them against this worker's device — or, with a
+    ``lane``, shipping them to a remote worker PROCESS over the
+    coordination-service transport (coordinator/remote_dispatch.py)."""
 
-    def __init__(self, worker_index: int, cluster: "Cluster", device=None):
+    def __init__(self, worker_index: int, cluster: "Cluster", device=None,
+                 lane=None):
         self.worker_index = worker_index
         self.cluster = cluster
         self.device = device
+        self.lane = lane
         self.failures = 0
         self._stop = threading.Event()
         self.thread = threading.Thread(
@@ -265,6 +281,12 @@ class Worker:
         # ≙ Worker._process_queue (:1173)
         queue = self.cluster.closure_queue
         while not self._stop.is_set():
+            if self.lane is not None and not self.lane.alive():
+                # dead remote worker: don't pull work this lane can't run
+                # (≙ wait_on_failure backoff, :879); resumes if the
+                # worker process is restarted and heartbeats again.
+                self._stop.wait(0.5)
+                continue
             closure = queue.get(timeout=0.2)
             if closure is None:
                 continue
@@ -273,7 +295,10 @@ class Worker:
     def _process_closure(self, closure: Closure, queue):
         try:
             with self.cluster.coordinator_metrics.closure_execution.time():
-                closure.execute_on(self)
+                if self.lane is not None:
+                    closure.execute_remote(self)
+                else:
+                    closure.execute_on(self)
             queue.mark_finished(closure)
         except WorkerPreemptionError:
             # ≙ WorkerPreemptionHandler.wait_on_failure (:879): transparent
@@ -292,11 +317,23 @@ class Worker:
 
 
 class Cluster:
-    """Owns workers + the closure queue (≙ cluster_coordinator.py:1247)."""
+    """Owns workers + the closure queue (≙ cluster_coordinator.py:1247).
 
-    def __init__(self, num_workers: int, devices=None):
+    ``remote_worker_ids``: process ids of remote worker tasks (each
+    running ``remote_dispatch.run_worker_loop``); lanes then dispatch
+    across processes instead of local devices."""
+
+    def __init__(self, num_workers: int, devices=None,
+                 remote_worker_ids: Sequence[int] | None = None):
         self.closure_queue = _CoordinatedClosureQueue()
         self.coordinator_metrics = metric_utils.CoordinatorMetrics()
+        if remote_worker_ids is not None:
+            from distributed_tensorflow_tpu.coordinator.remote_dispatch \
+                import RemoteLane
+            self.workers = [
+                Worker(i, self, lane=RemoteLane(pid))
+                for i, pid in enumerate(remote_worker_ids)]
+            return
         if devices is None:
             local = jax.local_devices()
             devices = [local[i % len(local)] for i in range(num_workers)]
@@ -331,7 +368,8 @@ class ClusterCoordinator:
     """
 
     def __init__(self, strategy=None, num_workers: int | None = None,
-                 devices=None, watchdog_timeout: float = 300.0):
+                 devices=None, watchdog_timeout: float = 300.0,
+                 remote_worker_ids: Sequence[int] | None = None):
         self.strategy = strategy
         if num_workers is None:
             resolver = getattr(strategy, "cluster_resolver", None)
@@ -339,7 +377,10 @@ class ClusterCoordinator:
                 num_workers = resolver.cluster_spec().num_tasks("worker") or 1
             else:
                 num_workers = len(jax.local_devices())
-        self.cluster = Cluster(num_workers, devices)
+        if remote_worker_ids is not None:
+            num_workers = len(remote_worker_ids)
+        self.cluster = Cluster(num_workers, devices,
+                               remote_worker_ids=remote_worker_ids)
         self._per_worker_resources: list = []
         self._watchdog = WatchDog(timeout=watchdog_timeout)
 
@@ -378,5 +419,13 @@ class ClusterCoordinator:
         return vals
 
     def shutdown(self):
+        lanes = [w.lane for w in self.cluster.workers if w.lane is not None]
+        if lanes:
+            from distributed_tensorflow_tpu.coordinator.remote_dispatch \
+                import shutdown_workers
+            # only wait on acks from workers that are still alive — a
+            # killed worker would otherwise stall shutdown to the timeout
+            shutdown_workers(
+                worker_ids=[l.worker_id for l in lanes if l.alive()])
         self.cluster.stop()
         self._watchdog.stop()

@@ -83,6 +83,28 @@ class ParameterServerStrategy(Strategy):
             synchronization=synchronization, aggregation=aggregation,
             dtype=dtype)
 
+    def make_coordinator(self, **kwargs):
+        """Build the ClusterCoordinator for this strategy
+        (≙ tf.distribute.coordinator.ClusterCoordinator(strategy)).
+
+        In a multi-process runtime the coordinator dispatches closures to
+        the cluster's worker PROCESSES over the coordination service
+        (coordinator/remote_dispatch.py — ≙ the grpc dispatch in
+        cluster_coordinator.py:1027); single-process falls back to local
+        device lanes. Worker tasks must run
+        ``remote_dispatch.run_worker_loop()``.
+        """
+        from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
+            import ClusterCoordinator
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        if agent.is_distributed and "remote_worker_ids" not in kwargs:
+            kwargs["remote_worker_ids"] = [
+                p for p in range(agent.num_processes)
+                if p != agent.process_id]
+        return ClusterCoordinator(strategy=self, **kwargs)
+
 
 # Alias for the V2 name used in reference scripts.
 ParameterServerStrategyV2 = ParameterServerStrategy

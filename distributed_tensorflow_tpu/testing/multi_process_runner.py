@@ -44,9 +44,6 @@ from typing import Any, Callable, Mapping, Sequence
 
 _MP = multiprocessing.get_context("spawn")
 
-# Signals a child used sys.exit / os._exit deliberately (fault tests).
-_DELIBERATE_EXIT_CODES = frozenset({0})
-
 
 def pick_unused_port() -> int:
     """Reserve an ephemeral localhost port and release it for the task."""

@@ -188,9 +188,75 @@ def _killed_worker_detection(tmpdir):
         outcome = "passed"
     except CoordinationError:
         outcome = "peer-death-detected"
+    # Exit ordering: process 0 hosts the coordination service, so it must
+    # exit LAST — service teardown hard-aborts any peer with a live
+    # client (its PollForError thread calls LOG(FATAL)). Non-hosts report
+    # and leave immediately; the host waits for their reports + grace.
+    try:
+        agent.key_value_set(f"detected/{runtime.process_id}", outcome)
+        if runtime.process_id == 0:
+            deadline = time.monotonic() + 20
+            while (agent.key_value_try_get("detected/1") is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            time.sleep(1.0)       # let the peer finish reporting and exit
+    except Exception:
+        pass
     # NOTE: no clean shutdown — the coordination service may already
     # consider the job unhealthy; survivors just exit.
     return runtime.process_id, outcome
+
+
+def _remote_square(x):
+    return x * x
+
+
+def _remote_slow_identity(x):
+    time.sleep(0.4)
+    return x
+
+
+def _remote_dispatch_worker(tmpdir, slow):
+    """proc 0 = coordinator; procs 1..N-1 = remote worker services."""
+    from distributed_tensorflow_tpu.cluster import bootstrap
+    from distributed_tensorflow_tpu.coordinator import remote_dispatch
+    from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
+        ClusterCoordinator)
+    runtime = bootstrap.initialize()
+    if runtime.process_id != 0:
+        if slow and runtime.process_id == 2:
+            # mark readiness so the parent knows when to kill us
+            with open(os.path.join(tmpdir, "victim_ready"), "w") as f:
+                f.write("1")
+        remote_dispatch.run_worker_loop()
+        return ("worker-done", runtime.process_id)
+
+    coord = ClusterCoordinator(
+        remote_worker_ids=list(range(1, runtime.num_processes)))
+    fn = _remote_slow_identity if slow else _remote_square
+    if slow:
+        # give the victim worker time to pick up a closure, then have the
+        # parent kill it mid-flight
+        while not os.path.exists(os.path.join(tmpdir, "victim_ready")):
+            time.sleep(0.1)
+    results = [coord.schedule(fn, args=(i,)) for i in range(10)]
+    if slow:
+        with open(os.path.join(tmpdir, "kill_now"), "w") as f:
+            f.write("1")
+    coord.join(timeout=120)
+    values = sorted(coord.fetch(results))
+    coord.shutdown()
+    expect = sorted(i * i for i in range(10)) if not slow \
+        else list(range(10))
+    return ("coordinator", values == expect, values)
+
+
+def _remote_failover_worker(tmpdir):
+    return _remote_dispatch_worker(tmpdir, slow=True)
+
+
+def _remote_basic_worker(tmpdir):
+    return _remote_dispatch_worker(tmpdir, slow=False)
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +307,41 @@ def test_preemption_agreement_across_processes(tmp_path):
     files = os.listdir(tmp_path / cks[0])
     assert "checkpoint.index.json" in files
     assert "shard_0.npz" in files and "shard_1.npz" in files
+
+
+def test_remote_coordinator_dispatch(tmp_path):
+    """Closures scheduled on the coordinator run in remote worker
+    PROCESSES (≙ cluster_coordinator.py:1027 grpc dispatch)."""
+    result = mpr.run(_remote_basic_worker, num_workers=3,
+                     args=(str(tmp_path),), timeout=240)
+    coord = [v for v in result.return_values if v[0] == "coordinator"][0]
+    assert coord[1], f"wrong results: {coord[2]}"
+    workers = [v for v in result.return_values if v[0] == "worker-done"]
+    assert len(workers) == 2     # both worker loops exited via shutdown
+
+
+def test_remote_dispatch_failover_on_worker_kill(tmp_path):
+    """A killed worker's in-flight closure is transparently re-run on a
+    surviving worker (≙ WorkerPreemptionHandler.wait_on_failure :879 —
+    the organic producer of WorkerPreemptionError)."""
+    spec = mpr.create_cluster_spec(num_workers=3)
+    runner = mpr.MultiProcessRunner(
+        _remote_failover_worker, spec, args=(str(tmp_path),), timeout=240)
+    runner.start()
+    deadline = time.monotonic() + 120
+    while not (tmp_path / "kill_now").exists():
+        assert time.monotonic() < deadline, "coordinator never signalled"
+        time.sleep(0.1)
+    time.sleep(0.2)               # let worker 2 take a closure in flight
+    runner.terminate("worker", 2)
+    result = runner.join(timeout=180, raise_on_error=False)
+    coord = [t for t in result.tasks.values()
+             if t.error is None and t.exitcode == 0
+             and t.value and t.value[0] == "coordinator"]
+    assert coord, {k: (t.exitcode, t.error and t.error[-500:])
+                   for k, t in result.tasks.items()}
+    assert coord[0].value[1], f"wrong results: {coord[0].value[2]}"
+    assert result.tasks[("worker", 2)].exitcode != 0   # really killed
 
 
 def test_killed_process_detected(tmp_path):
