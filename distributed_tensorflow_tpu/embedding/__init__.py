@@ -1,0 +1,20 @@
+"""TPU embedding API: sharded tables, per-table optimizers, combiners.
+
+≙ the reference's TPU embedding stack (SURVEY.md §2.6):
+tensorflow/python/tpu/tpu_embedding_v2.py:76 ``TPUEmbedding``,
+tpu_embedding_v3.py:498 ``TPUEmbeddingV2`` (SparseCore),
+tpu_embedding_v2_utils.py (TableConfig/FeatureConfig/optimizers).
+"""
+
+from distributed_tensorflow_tpu.embedding.embedding import (  # noqa: F401
+    Adagrad,
+    Adam,
+    FTRL,
+    FeatureConfig,
+    SGD,
+    TableConfig,
+    TPUEmbedding,
+    apply_gradients,
+    create_state,
+    lookup,
+)

@@ -72,6 +72,19 @@ WIDE_DEEP_RULES = (
 )
 
 
+def _interact(cfg: WideDeepConfig, embs: Sequence, dense):
+    """Feature interaction shared by both towers: DLRM pairwise dots
+    ("dot") or plain concatenation ("concat")."""
+    if cfg.interaction == "dot":
+        stacked = jnp.stack(list(embs), axis=1)        # (B, T, E)
+        inter = jnp.einsum("bte,bse->bts", stacked, stacked)
+        iu = jnp.triu_indices(len(embs), k=1)
+        feats = [inter[:, iu[0], iu[1]], dense]
+    else:
+        feats = list(embs) + [dense]
+    return jnp.concatenate(feats, axis=-1).astype(cfg.dtype)
+
+
 class WideDeep(nn.Module):
     cfg: WideDeepConfig
 
@@ -95,15 +108,8 @@ class WideDeep(nn.Module):
                 axes=("table_rows",))
             wide_logits.append(wide[categorical[:, i]])
 
-        if cfg.interaction == "dot":
-            # DLRM: pairwise dots between embedding vectors + dense proj
-            stacked = jnp.stack(embs, axis=1)          # (B, T, E)
-            inter = jnp.einsum("bte,bse->bts", stacked, stacked)
-            iu = jnp.triu_indices(len(embs), k=1)
-            feats = [inter[:, iu[0], iu[1]], dense]
-        else:
-            feats = embs + [dense]
-        x = jnp.concatenate(feats, axis=-1).astype(cfg.dtype)
+        # DLRM pairwise dots or Wide&Deep concat — shared helper
+        x = _interact(cfg, embs, dense)
 
         for j, width in enumerate(cfg.mlp_dims):
             w = param_with_axes(
@@ -203,6 +209,125 @@ def make_sharded_train_step(cfg: WideDeepConfig, mesh: Mesh,
 
     def wrapped(state, batch):
         with mesh, nn_partitioning.axis_rules(rules):
+            return step_jit(state, batch)
+
+    return state, wrapped
+
+
+class WideDeepDense(nn.Module):
+    """The dense tower only: consumes PRE-LOOKED-UP embedding activations
+    (the TPUEmbedding API path — ≙ how reference DLRM models consume
+    dequeued activations from tpu_embedding_v2.py while the tables train
+    decoupled)."""
+    cfg: WideDeepConfig
+
+    @nn.compact
+    def __call__(self, emb_acts: Sequence, dense):
+        cfg = self.cfg
+        x = _interact(cfg, emb_acts, dense)
+        for j, width in enumerate(cfg.mlp_dims):
+            x = nn.relu(nn.Dense(width, name=f"mlp_{j}")(x))
+        return nn.Dense(1, name="out")(x)[:, 0].astype(jnp.float32)
+
+
+def make_embedding_train_step(cfg: WideDeepConfig, mesh: Mesh,
+                              global_batch: int, seed: int = 0):
+    """DLRM/W&D through the TPU embedding API (embedding/embedding.py):
+
+    - one TableConfig per categorical column (+ a dim-1 "wide" table per
+      column, combiner=sum — the wide half of Wide&Deep);
+    - tables row-sharded over "tp" via the embedding layer's own state
+      (≙ tpu_embedding_v3.py:498 SparseCore sharding), NOT flax params;
+    - table gradients applied by the per-table Adagrad — decoupled from
+      the dense tower's optax optimizer (≙ tpu_embedding_v2.py:754
+      apply_gradients).
+
+    Returns (state, step_fn) with state = {"dense": ..., "emb": ...}.
+    """
+    from distributed_tensorflow_tpu import embedding as emb_lib
+
+    deep_tables = [emb_lib.TableConfig(v, cfg.embed_dim, name=f"table_{i}",
+                                       optimizer=emb_lib.Adagrad(
+                                           cfg.learning_rate))
+                   for i, v in enumerate(cfg.vocab_sizes)]
+    wide_tables = [emb_lib.TableConfig(v, 1, name=f"wide_{i}",
+                                       combiner="sum",
+                                       optimizer=emb_lib.Adagrad(
+                                           cfg.learning_rate))
+                   for i, v in enumerate(cfg.vocab_sizes)]
+    feature_config = {
+        "deep": tuple(emb_lib.FeatureConfig(t, name=f"deep_{i}")
+                      for i, t in enumerate(deep_tables)),
+        "wide": tuple(emb_lib.FeatureConfig(t, name=f"wide_{i}")
+                      for i, t in enumerate(wide_tables)),
+    }
+
+    rng = jax.random.PRNGKey(seed)
+    rng, emb_rng, dense_rng = jax.random.split(rng, 3)
+    emb_state = emb_lib.create_state(feature_config, mesh=mesh,
+                                     shard_axis="tp", rng=emb_rng)
+
+    model = WideDeepDense(cfg)
+    n_tables = len(cfg.vocab_sizes)
+    sample_acts = [jnp.zeros((global_batch, cfg.embed_dim))
+                   for _ in range(n_tables)]
+    sample_dense = jnp.zeros((global_batch, cfg.num_dense_features))
+    dense_params = model.init(dense_rng, sample_acts, sample_dense)["params"]
+    tx = make_optimizer(cfg)
+
+    from distributed_tensorflow_tpu.cluster.topology import \
+        data_axes as mesh_data_axes
+    data_axes = mesh_data_axes(mesh) or None
+    replicated = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(data_axes))
+    table_sh = (NamedSharding(mesh, P("tp", None))
+                if "tp" in mesh.shape else replicated)
+    emb_shardings = jax.tree_util.tree_map(
+        lambda x: table_sh if getattr(x, "ndim", 0) == 2 else replicated,
+        emb_state)
+    dense_state = {"params": dense_params, "opt_state": tx.init(dense_params)}
+    dense_shardings = jax.tree_util.tree_map(lambda _: replicated,
+                                             dense_state)
+    state = {"dense": jax.device_put(dense_state, replicated),
+             "emb": jax.tree_util.tree_map(jax.device_put, emb_state,
+                                           emb_shardings)}
+    state_shardings = {"dense": dense_shardings, "emb": emb_shardings}
+    batch_shardings = {"dense": batch_sh, "categorical": batch_sh,
+                       "label": batch_sh}
+
+    def loss_fn(dense_params, tables, batch):
+        feats = {
+            "deep": tuple(batch["categorical"][:, i]
+                          for i in range(n_tables)),
+            "wide": tuple(batch["categorical"][:, i]
+                          for i in range(n_tables)),
+        }
+        acts = emb_lib.lookup(tables, feature_config, feats)
+        logits = model.apply({"params": dense_params},
+                             list(acts["deep"]), batch["dense"])
+        logits = logits + sum(w[:, 0] for w in acts["wide"])
+        return optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"].astype(jnp.float32)).mean()
+
+    def train_step(state, batch):
+        loss, (dgrads, tgrads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(state["dense"]["params"],
+                                     state["emb"]["tables"], batch)
+        updates, opt_state = tx.update(dgrads, state["dense"]["opt_state"],
+                                       state["dense"]["params"])
+        dense_params = optax.apply_updates(state["dense"]["params"], updates)
+        emb = emb_lib.apply_gradients(state["emb"], tgrads, feature_config)
+        return ({"dense": {"params": dense_params, "opt_state": opt_state},
+                 "emb": emb}, {"loss": loss})
+
+    with mesh:
+        step_jit = jax.jit(train_step,
+                           in_shardings=(state_shardings, batch_shardings),
+                           out_shardings=(state_shardings, replicated),
+                           donate_argnums=(0,))
+
+    def wrapped(state, batch):
+        with mesh:
             return step_jit(state, batch)
 
     return state, wrapped

@@ -1,0 +1,152 @@
+"""TPU embedding API tests (≙ the reference's tpu_embedding_v2 tests:
+correctness of combiners, per-table optimizers, shared tables, sequence
+features, dedup, and distributed == single-device equivalence)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import embedding as emb
+from distributed_tensorflow_tpu.cluster.topology import make_mesh
+
+
+def _simple_config(vocab=16, dim=4, **kw):
+    table = emb.TableConfig(vocab, dim, name="t0", **kw)
+    return table, emb.FeatureConfig(table, name="f0")
+
+
+def test_lookup_univalent():
+    table, fc = _simple_config()
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(1))
+    ids = jnp.array([3, 0, 15])
+    out = emb.lookup(state["tables"], fc, ids)
+    np.testing.assert_allclose(out, state["tables"]["t0"][ids])
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+def test_combiners_with_padding_and_weights(combiner):
+    table, fc = _simple_config(combiner=combiner)
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(2))
+    t = np.asarray(state["tables"]["t0"])
+    ids = jnp.array([[1, 2, -1], [5, -1, -1]])       # -1 = padding
+    w = jnp.array([[1.0, 2.0, 9.9], [0.5, 9.9, 9.9]])
+    out = np.asarray(emb.lookup(state["tables"], fc, ids, weights=w))
+    for b, (row_ids, row_w) in enumerate(zip(ids, w)):
+        valid = [(int(i), float(x)) for i, x in zip(row_ids, row_w)
+                 if i >= 0]
+        acc = sum(x * t[i] for i, x in valid)
+        if combiner == "mean":
+            acc = acc / sum(x for _, x in valid)
+        elif combiner == "sqrtn":
+            acc = acc / np.sqrt(sum(x * x for _, x in valid))
+        np.testing.assert_allclose(out[b], acc, rtol=1e-5)
+
+
+def test_sequence_feature_returns_per_position():
+    table = emb.TableConfig(8, 3, name="seq_t")
+    fc = emb.FeatureConfig(table, max_sequence_length=4)
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(3))
+    ids = jnp.array([[1, 2, -1, -1]])
+    out = np.asarray(emb.lookup(state["tables"], fc, ids))
+    assert out.shape == (1, 4, 3)
+    t = np.asarray(state["tables"]["seq_t"])
+    np.testing.assert_allclose(out[0, 0], t[1])
+    np.testing.assert_allclose(out[0, 2], 0.0)       # padded -> zeroed
+
+
+def test_shared_table_dedup_identity_not_equality():
+    shared = emb.TableConfig(10, 2, name="shared")
+    other = emb.TableConfig(10, 2, name="other")     # same shape, distinct
+    fcs = (emb.FeatureConfig(shared), emb.FeatureConfig(shared),
+           emb.FeatureConfig(other))
+    state = emb.create_state(fcs, rng=jax.random.PRNGKey(4))
+    assert set(state["tables"]) == {"shared", "other"}
+    outs = emb.lookup(state["tables"], fcs, (jnp.array([1]),
+                                             jnp.array([1]),
+                                             jnp.array([1])))
+    np.testing.assert_allclose(outs[0], outs[1])     # same table
+
+
+def test_dedup_matches_plain_gather():
+    table, fc = _simple_config()
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(5))
+    ids = jnp.array([3, 3, 3, 7, 0, 7])
+    a = emb.lookup(state["tables"], fc, ids)
+    b = emb.lookup(state["tables"], fc, ids, dedup=True)
+    np.testing.assert_allclose(a, b)
+
+
+@pytest.mark.parametrize("opt,slots", [
+    (emb.SGD(0.1), ()),
+    (emb.Adagrad(0.1), ("accumulator",)),
+    (emb.Adam(0.1), ("momenta", "velocities")),
+    (emb.FTRL(0.1), ("accumulators", "linears")),
+])
+def test_per_table_optimizers_update(opt, slots):
+    table = emb.TableConfig(6, 2, name="t", optimizer=opt)
+    fc = emb.FeatureConfig(table)
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(6))
+    assert set(state["slots"]["t"]) == set(slots)
+    g = jnp.ones_like(state["tables"]["t"])
+    new = emb.apply_gradients(state, {"t": g}, fc)
+    assert int(new["step"]) == 1
+    assert not np.allclose(new["tables"]["t"], state["tables"]["t"])
+    # slot state evolves across steps for slot-carrying optimizers
+    if slots:
+        new2 = emb.apply_gradients(new, {"t": g}, fc)
+        for s in slots:
+            assert not np.allclose(new2["slots"]["t"][s],
+                                   new["slots"]["t"][s])
+
+
+def test_adagrad_matches_manual_math():
+    opt = emb.Adagrad(0.5, initial_accumulator_value=0.1)
+    table = emb.TableConfig(3, 2, name="t", optimizer=opt)
+    fc = emb.FeatureConfig(table)
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(7))
+    t0 = np.asarray(state["tables"]["t"])
+    g = np.full_like(t0, 2.0)
+    new = emb.apply_gradients(state, {"t": jnp.asarray(g)}, fc)
+    acc = 0.1 + g * g
+    expect = t0 - 0.5 * g / np.sqrt(acc + 1e-12)
+    np.testing.assert_allclose(new["tables"]["t"], expect, rtol=1e-5)
+
+
+def test_stateful_wrapper_api(devices):
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    table = emb.TableConfig(10, 4, name="t0")
+    fc = emb.FeatureConfig(table)
+    layer = emb.TPUEmbedding(fc, optimizer=emb.Adagrad(0.1), mesh=mesh)
+    assert "t0" in layer.embedding_tables
+    # padded to the tp shard count
+    assert layer.embedding_tables["t0"].shape == (10, 4)
+    acts = layer(jnp.array([1, 2, 3]))
+    assert acts.shape == (3, 4)
+    before = np.asarray(layer.embedding_tables["t0"])
+    layer.apply_gradients({"t0": jnp.ones((10, 4))})
+    assert not np.allclose(layer.embedding_tables["t0"], before)
+
+
+def test_wide_deep_embedding_step_distributed_equals_single(devices):
+    """The DLRM-through-embedding-API path: dp×tp mesh == 1-device mesh
+    step for step (≙ keras_correctness_test_base distributed-equivalence
+    discipline applied to the embedding stack)."""
+    from distributed_tensorflow_tpu.models import wide_deep as wd
+    cfg = wd.WideDeepConfig.tiny()
+    batch = wd.synthetic_clicks(cfg, 32, seed=3)
+
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    mesh8 = make_mesh({"dp": 4, "tp": 2})
+    s1, step1 = wd.make_embedding_train_step(cfg, mesh1, 32, seed=0)
+    s8, step8 = wd.make_embedding_train_step(cfg, mesh8, 32, seed=0)
+
+    losses1, losses8 = [], []
+    for _ in range(3):
+        s1, m1 = step1(s1, batch)
+        s8, m8 = step8(s8, batch)
+        losses1.append(float(m1["loss"]))
+        losses8.append(float(m8["loss"]))
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-4)
+    # loss decreases: tables are actually learning through the API
+    assert losses1[-1] < losses1[0]
