@@ -289,8 +289,17 @@ def lookup(tables: Mapping[str, jax.Array], feature_config, features,
         else:
             rows = table[safe]
         if ids.ndim == 1:
+            if w is not None:
+                raise ValueError(
+                    f"feature {fc.name!r}: weights are only valid for "
+                    f"combiner-reduced (2-D) features, not dense 1-D ids "
+                    f"(≙ the reference's enqueue validation)")
             outs.append(rows)
         elif fc.max_sequence_length > 0:
+            if w is not None:
+                raise ValueError(
+                    f"feature {fc.name!r}: weights are not supported for "
+                    f"sequence features (max_sequence_length > 0)")
             mask = (ids >= 0).astype(rows.dtype)[..., None]
             outs.append(rows * mask)
         else:

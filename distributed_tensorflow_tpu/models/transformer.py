@@ -42,7 +42,8 @@ param_with_axes = nn_partitioning.param_with_axes
 with_sharding_constraint = nn_partitioning.with_sharding_constraint
 
 # Logical axis name -> mesh axes. "sp" shards the sequence axis of
-# activations when the mesh has it (ring attention path).
+# activations when the mesh has it (ring attention path); "expert" axes
+# shard MoE expert weights/activations over "ep" (parallel/moe.py).
 LOGICAL_AXIS_RULES = (
     ("batch", ("dcn", "dp", "fsdp")),
     ("seq", "sp"),
@@ -53,6 +54,9 @@ LOGICAL_AXIS_RULES = (
     ("vocab", "tp"),
     ("layers", None),
     ("norm", None),
+    ("expert", "ep"),
+    ("expert_mlp", "tp"),
+    ("expert_embed", None),
 )
 
 
@@ -82,6 +86,14 @@ class TransformerConfig:
     # ring attention over it (parallel/sequence_parallel.py).
     mesh: Any = None
     sp_impl: str = "ring"             # "ring" | "ulysses"
+    # Mixture-of-Experts: moe_experts > 0 replaces every block's MLP with
+    # a Switch-style MoE layer (parallel/moe.py), expert-sharded over the
+    # mesh's "ep" axis; the load-balancing aux loss flows to the train
+    # step through the flax "losses" collection.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -220,15 +232,49 @@ class MLP(nn.Module):
         return with_sharding_constraint(out, ("batch", "seq", "embed"))
 
 
+def remat_policy_for(cfg: TransformerConfig):
+    """The jax.checkpoint policy named by ``cfg.remat_policy`` (shared by
+    the scan-layers path and the pipeline stage body)."""
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # Save only attention outputs: O(B·S·D) per layer, and the
+        # backward never recomputes the flash kernel forward.
+        "attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(f"remat_policy={cfg.remat_policy!r}; "
+                         f"expected one of {sorted(policies)}")
+    return policies[cfg.remat_policy]
+
+
 class Block(nn.Module):
-    """One transformer block with a scan-compatible (carry, _) signature."""
+    """One transformer block with a scan-compatible (carry, _) signature.
+
+    With ``cfg.moe_experts > 0`` the dense MLP is replaced by a
+    Switch-style MoE layer (parallel/moe.py) whose aux loss is sown into
+    the "losses" collection — summed over layers by the train step."""
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, _=None):
         cfg = self.cfg
         x = x + MultiHeadAttention(cfg, name="attn")(RMSNorm(cfg.dtype)(x))
-        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.dtype)(x))
+        h = RMSNorm(cfg.dtype)(x)
+        if cfg.moe_experts > 0:
+            from distributed_tensorflow_tpu.parallel.moe import (
+                MoEConfig, MoELayer)
+            moe_cfg = MoEConfig(
+                num_experts=cfg.moe_experts, d_model=cfg.d_model,
+                d_ff=cfg.d_ff, capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k, aux_loss_weight=cfg.moe_aux_weight,
+                dtype=cfg.dtype)
+            out, aux = MoELayer(moe_cfg, name="moe")(h)
+            self.sow("losses", "moe_aux", aux,
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
+            x = x + out
+        else:
+            x = x + MLP(cfg, name="mlp")(h)
         return x, None
 
 
@@ -248,27 +294,17 @@ class TransformerLM(nn.Module):
 
         block = Block
         if cfg.remat:
-            policies = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies
-                .dots_with_no_batch_dims_saveable,
-                # Save only attention outputs: O(B·S·D) per layer, and the
-                # backward never recomputes the flash kernel forward.
-                "attn": jax.checkpoint_policies
-                .save_only_these_names("attn_out"),
-            }
-            if cfg.remat_policy not in policies:
-                raise ValueError(
-                    f"remat_policy={cfg.remat_policy!r}; "
-                    f"expected one of {sorted(policies)}")
-            policy = policies[cfg.remat_policy]
+            policy = remat_policy_for(cfg)
             block = nn_partitioning.remat(
                 block, policy=policy,
                 prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
+            variable_axes = {"params": 0}
+            if cfg.moe_experts > 0:
+                variable_axes["losses"] = 0     # per-layer aux stack
             x, _ = nn_partitioning.scan_with_axes(
                 block,
-                variable_axes={"params": 0},
+                variable_axes=variable_axes,
                 split_rngs={"params": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
@@ -300,9 +336,17 @@ def make_optimizer(cfg: TransformerConfig):
 
 
 def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
-    """Functional (state, batch) -> (state, metrics) SPMD step."""
+    """Functional (state, batch) -> (state, metrics) SPMD step. With MoE
+    the per-layer load-balancing aux losses (flax "losses" collection)
+    are summed into the objective (≙ Switch Transformer training)."""
 
     def loss_fn(params, tokens):
+        if cfg.moe_experts > 0:
+            logits, out_vars = model.apply({"params": params}, tokens,
+                                           mutable=["losses"])
+            aux = sum(jnp.sum(leaf) for leaf in
+                      jax.tree_util.tree_leaves(out_vars.get("losses", {})))
+            return next_token_loss(logits, tokens) + aux
         logits = model.apply({"params": params}, tokens)
         return next_token_loss(logits, tokens)
 
@@ -427,6 +471,135 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
             return step_jit(state, batch)
 
     return state, wrapped_step
+
+
+def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
+                              global_batch: int, num_microbatches: int,
+                              seed: int = 0):
+    """GPipe pipeline parallelism for the flagship transformer over a
+    dp×pp mesh (parallel/pipeline.py; the reference has NO pipeline
+    parallelism — SURVEY.md §2.8 row PP).
+
+    - The scan-over-layers parameter stack (L, ...) regroups to
+      (pp, L/pp, ...) with the stage axis sharded over "pp": each device
+      holds exactly its stage's layers.
+    - Microbatches flow through stages via ppermute inside a lax.scan
+      (pipeline_apply); autodiff through it yields the reverse-schedule
+      backward pipeline, with gradient accumulation over microbatches
+      falling out of the loss mean.
+    - Embedding + final norm + logits run as plain GSPMD ops outside the
+      shard_map (batch sharded over dp, replicated over pp).
+
+    Returns (state, step_fn) like make_sharded_train_step.
+    """
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        make_pipelined_fn)
+
+    if not cfg.scan_layers:
+        raise ValueError("pipeline path requires scan_layers=True")
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "MoE under pipeline parallelism is not supported yet: the "
+            "aux-loss 'losses' collection cannot escape the shard_map "
+            "stage body — use make_sharded_train_step on a dp×ep mesh")
+    n_stages = mesh.shape.get("pp", 1)
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pp={n_stages}")
+    if global_batch % num_microbatches:
+        raise ValueError(f"global_batch={global_batch} not divisible by "
+                         f"num_microbatches={num_microbatches}")
+    mb = global_batch // num_microbatches
+    per_stage = cfg.n_layers // n_stages
+    # inside the shard_map region blocks run per-shard: no nested
+    # sharding machinery, direct attention kernel
+    cfg_local = dataclasses.replace(cfg, mesh=None)
+    block = Block(cfg_local)
+
+    model = TransformerLM(dataclasses.replace(cfg, mesh=None))
+    rng = jax.random.PRNGKey(seed)
+    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
+    params = model.init(rng, tokens_shape)["params"]
+    params = params.unfreeze() if hasattr(params, "unfreeze") else dict(params)
+
+    # regroup the layer stack: (L, ...) -> (pp, L/pp, ...)
+    params["layers"] = jax.tree_util.tree_map(
+        lambda p: p.reshape(n_stages, per_stage, *p.shape[1:]),
+        params["layers"])
+
+    replicated = NamedSharding(mesh, P())
+    stage_sharded = NamedSharding(mesh, P("pp"))
+    param_shardings = {
+        k: (jax.tree_util.tree_map(lambda _: stage_sharded, v)
+            if k == "layers"
+            else jax.tree_util.tree_map(lambda _: replicated, v))
+        for k, v in params.items()}
+    params = jax.tree_util.tree_map(jax.device_put, params,
+                                    param_shardings)
+
+    tx = make_optimizer(cfg)
+    opt_state = tx.init(params)
+    opt_shardings = _shard_like(
+        jax.eval_shape(lambda: opt_state),
+        jax.tree_util.tree_structure(params), param_shardings, replicated)
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    state_shardings = {"params": param_shardings,
+                       "opt_state": opt_shardings, "step": replicated}
+
+    def stage_fn(stage_params, x):
+        """Apply this stage's layer group: local scan over L/pp blocks."""
+        def body(carry, layer_params):
+            y, _ = block.apply({"params": layer_params}, carry)
+            return y, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body, policy=remat_policy_for(cfg))
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    pipelined = make_pipelined_fn(
+        mesh, stage_fn, param_spec=P("pp"),
+        data_spec=P(None, "dp") if "dp" in mesh.shape else P())
+
+    norm = RMSNorm(cfg.dtype)
+
+    def loss_fn(params, tokens):
+        embed = params["embed"].astype(cfg.dtype)
+        x = embed[tokens]                           # (B, S, D)
+        x = x.reshape(num_microbatches, mb, *x.shape[1:])
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, "dp" if "dp" in mesh.shape
+                                     else None)))
+        out = pipelined(params["layers"], x)
+        x = out.reshape(global_batch, *out.shape[2:])
+        x = norm.apply({"params": params["final_norm"]}, x)
+        logits = jnp.einsum("bsd,vd->bsv", x, embed).astype(jnp.float32)
+        return next_token_loss(logits, tokens)
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"],
+                                                  batch["tokens"])
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    data_axes = "dp" if "dp" in mesh.shape else None
+    batch_shardings = {"tokens": NamedSharding(mesh, P(data_axes))}
+    with mesh:
+        step_jit = jax.jit(train_step,
+                           in_shardings=(state_shardings, batch_shardings),
+                           out_shardings=(state_shardings, replicated),
+                           donate_argnums=(0,))
+
+    def wrapped(state, batch):
+        with mesh:
+            return step_jit(state, batch)
+
+    return state, wrapped
 
 
 def synthetic_tokens(global_batch: int, seq_len: int, vocab_size: int,
