@@ -86,6 +86,9 @@ class TransformerConfig:
     # ring attention over it (parallel/sequence_parallel.py).
     mesh: Any = None
     sp_impl: str = "ring"             # "ring" | "ulysses"
+    # per-step attention inside SP: "flash" | "unfused" | "interpret";
+    # None = auto (flash on TPU — sequence_parallel._resolve_attn_impl)
+    sp_attn_impl: str | None = None
     # Mixture-of-Experts: moe_experts > 0 replaces every block's MLP with
     # a Switch-style MoE layer (parallel/moe.py), expert-sharded over the
     # mesh's "ep" axis; the load-balancing aux loss flows to the train
@@ -185,7 +188,10 @@ class MultiHeadAttention(nn.Module):
             base = attention_shard_spec(mesh)
             spec = P(base[0], base[1], "sp", None)
             o = make_ring_attention(mesh, causal=cfg.causal,
-                                    impl=cfg.sp_impl, spec=spec)(q, k, v)
+                                    impl=cfg.sp_impl, spec=spec,
+                                    attn_impl=cfg.sp_attn_impl,
+                                    block_q=cfg.attn_block_q,
+                                    block_k=cfg.attn_block_k)(q, k, v)
         elif mesh is not None and mesh.size > 1:
             # Pallas custom calls can't be partitioned by GSPMD: run the
             # kernel per-shard via shard_map over batch/head axes.

@@ -6,14 +6,15 @@ raw ``collective_permute``/``all_to_all`` ops, reference
 tensorflow/python/tpu/ops/tpu_ops.py:111/:43). Long-context training is a
 capability gap the TPU-native framework fills as a first-class feature:
 
-- **Ring attention** (`ring_attention`): each device holds a sequence
-  chunk of Q/K/V; K/V blocks rotate around the "sp" ring via
-  ``jax.lax.ppermute`` over ICI while each device accumulates flash-style
-  online softmax over the blocks it sees. Memory stays O(S/n) per device;
-  comm overlaps compute under XLA latency hiding. Causal masking is
-  applied per block pair; compute is NOT skipped for future blocks (the
-  ring synchronizes every step, so wall-clock is set by the last rank
-  regardless — a load-balanced "striped" schedule is future work).
+- **Ring attention**: each device holds a sequence chunk of Q/K/V; K/V
+  blocks rotate around the "sp" ring via ``jax.lax.ppermute`` over ICI
+  while each device accumulates online softmax over the blocks it sees.
+  Memory stays O(S/n) per device. Two per-step compute paths:
+  `ring_flash_attention` (the TPU default) runs the Pallas flash kernel
+  per block and ``lax.cond``-skips fully-masked causal future blocks
+  outright; `ring_attention` is the unfused reference-math form kept for
+  CPU CI and numerics cross-checks. (Wall-clock is set by the last rank
+  either way — a load-balanced "zigzag" chunk layout is future work.)
 
 - **Ulysses** (`ulysses_attention`): all-to-all re-shard — heads gather
   the full sequence, attention runs locally per head subset, then
@@ -36,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops.attention import (
     DEFAULT_MASK_VALUE, flash_attention, mha_reference)
+from distributed_tensorflow_tpu.ops import attention as _attn
 
 
 def _local_attn_stats(q, k, v, *, sm_scale, mask=None):
@@ -125,6 +127,157 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     return (o_acc / l_safe).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Flash ring attention: the Pallas kernel as the per-step compute, with
+# causal work-skipping (fully-masked future blocks are lax.cond-skipped).
+# ---------------------------------------------------------------------------
+
+def _combine_stats(o_acc, lse_acc, o_b, lse_b):
+    """Merge one block's (normalized out, lse) into the accumulators —
+    the cross-block online-softmax recombination: given per-block
+    normalized outputs, o = Σ o_b·exp(lse_b − lse_tot)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_b)
+    alpha = jnp.where(jnp.isneginf(lse_acc), 0.0,
+                      jnp.exp(lse_acc - jnp.where(jnp.isneginf(lse_new),
+                                                  0.0, lse_new)))
+    beta = jnp.where(jnp.isneginf(lse_b), 0.0,
+                     jnp.exp(lse_b - jnp.where(jnp.isneginf(lse_new),
+                                               0.0, lse_new)))
+    o_new = o_acc * alpha[..., None] + o_b.astype(jnp.float32) \
+        * beta[..., None]
+    return o_new, lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                  block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret):
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    b, h, s, d = q.shape
+
+    def block(kv, block_causal):
+        kk, vv = kv
+        return _attn._flash_forward(q, kk, vv, sm_scale, block_causal,
+                                    block_q, block_k, interpret)
+
+    def skip(kv):
+        return (jnp.zeros((b, h, s, d), q.dtype),
+                jnp.full((b, h, s), -jnp.inf, jnp.float32))
+
+    k_cur, v_cur = k, v
+    o_acc = None
+    for step in range(n):
+        if step == 0:
+            # my own chunk: causal diagonal block
+            o_b, lse_b = block((k_cur, v_cur), causal)
+            o_acc = o_b.astype(jnp.float32)
+            lse_acc = lse_b
+        else:
+            src = (me - step) % n
+            if causal:
+                # past chunks (src < me) are FULL blocks; future chunks
+                # are fully masked — skip the kernel entirely (the
+                # causal work-skipping the ring schedule allows)
+                o_b, lse_b = jax.lax.cond(
+                    src < me, lambda kv: block(kv, False), skip,
+                    (k_cur, v_cur))
+            else:
+                o_b, lse_b = block((k_cur, v_cur), False)
+            o_acc, lse_acc = _combine_stats(o_acc, lse_acc, o_b, lse_b)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
+                    interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                    block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k,
+                    interpret, res, g):
+    """Ring backward: per-block flash backward against the GLOBAL lse
+    (p = exp(s − lse_global) is exact), with dk/dv accumulators that
+    rotate alongside their k/v chunks so each chunk's gradient arrives
+    home after a full circuit."""
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_bwd(ops, block_causal):
+        kk, vv = ops
+        return _attn._flash_backward(
+            (q, kk, vv, out, lse), g, sm_scale=sm_scale,
+            causal=block_causal, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+
+    def skip(ops):
+        return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+
+    k_cur, v_cur = k, v
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    for step in range(n):
+        if step == 0:
+            dqb, dkb, dvb = block_bwd((k_cur, v_cur), causal)
+        else:
+            src = (me - step) % n
+            if causal:
+                dqb, dkb, dvb = jax.lax.cond(
+                    src < me, lambda o: block_bwd(o, False), skip,
+                    (k_cur, v_cur))
+            else:
+                dqb, dkb, dvb = block_bwd((k_cur, v_cur), False)
+        dq = dq + dqb.astype(jnp.float32)
+        dk_acc = dk_acc + dkb.astype(jnp.float32)
+        dv_acc = dv_acc + dvb.astype(jnp.float32)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    # buffers now hold chunk (me+1)'s gradients: one final hop home
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = False,
+                         sm_scale: float | None = None,
+                         block_q: int = 512, block_k: int = 1024,
+                         interpret: bool = False):
+    """Ring attention with the Pallas flash kernel as per-step compute
+    (shard_map region fn, like :func:`ring_attention`).
+
+    vs the unfused ring: O(block) memory instead of per-step (s_q, s_k)
+    fp32 logits, MXU-fused inner loops, and causal future blocks are
+    skipped outright instead of computed-and-masked. Numerics match
+    ``ring_attention``/full attention (same online-softmax recombination).
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _ring_flash(q, k, v, axis_name, causal, sm_scale, block_q,
+                       block_k, interpret)
+
+
 def ulysses_attention(q, k, v, *, axis_name: str = "sp",
                       causal: bool = False,
                       sm_scale: float | None = None,
@@ -154,30 +307,73 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # (b, h/n, S, d)
     if attn_fn is None:
-        out = mha_reference(qh, kh, vh, causal=causal, sm_scale=sm_scale)
-    else:
-        out = attn_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+        # full-sequence local attention: this is exactly the hot path the
+        # flash kernel exists for (auto: pallas on TPU)
+        attn_fn = flash_attention
+    out = attn_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
     return to_seq(out)
+
+
+_ATTN_IMPLS = ("flash", "unfused", "interpret")
+
+
+def _resolve_attn_impl(attn_impl: str | None) -> str:
+    if attn_impl is not None:
+        if attn_impl not in _ATTN_IMPLS:
+            raise ValueError(f"attn_impl={attn_impl!r}; expected one of "
+                             f"{_ATTN_IMPLS} (or None = auto)")
+        return attn_impl
+    return "flash" if jax.default_backend() == "tpu" else "unfused"
 
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
                         causal: bool = False, impl: str = "ring",
-                        spec: P | None = None):
+                        spec: P | None = None,
+                        attn_impl: str | None = None,
+                        block_q: int = 512, block_k: int = 1024):
     """Wrap ring/ulysses attention in shard_map for (b, h, S, d) global
     arrays whose sequence axis is sharded over ``axis_name``.
 
     ``spec`` describes the full (b, h, S, d) sharding — pass the model's
     batch/head shardings too when calling inside a dp×tp×sp jit, so
     shard_map only ring-communicates over ``axis_name``.
-    """
-    fn = ring_attention if impl == "ring" else ulysses_attention
 
+    ``attn_impl`` selects the per-step compute: "flash" (Pallas kernel +
+    causal work-skipping), "unfused" (the reference-math ring), or
+    "interpret" (Pallas in interpreter mode — CPU CI). None = auto:
+    flash on TPU, unfused elsewhere.
+    """
+    attn_impl = _resolve_attn_impl(attn_impl)
     if spec is None:
         spec = P(None, None, axis_name, None)
+
+    if impl == "ring":
+        if attn_impl in ("flash", "interpret"):
+            def fn(q, k, v):
+                return ring_flash_attention(
+                    q, k, v, axis_name=axis_name, causal=causal,
+                    block_q=block_q, block_k=block_k,
+                    interpret=attn_impl == "interpret")
+        else:
+            def fn(q, k, v):
+                return ring_attention(q, k, v, axis_name=axis_name,
+                                      causal=causal)
+    else:
+        if attn_impl in ("flash", "interpret"):
+            attn_fn = functools.partial(
+                flash_attention, block_q=block_q, block_k=block_k,
+                implementation=("interpret" if attn_impl == "interpret"
+                                else "pallas"))
+        else:
+            attn_fn = mha_reference
+
+        def fn(q, k, v):
+            return ulysses_attention(q, k, v, axis_name=axis_name,
+                                     causal=causal, attn_fn=attn_fn)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_rep=False)
     def sharded(q, k, v):
-        return fn(q, k, v, axis_name=axis_name, causal=causal)
+        return fn(q, k, v)
 
     return sharded
