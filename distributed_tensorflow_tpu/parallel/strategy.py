@@ -361,8 +361,16 @@ class Strategy:
                              else VariableAggregation.MEAN),
                 dtype=dtype)
         else:
+            # ON_READ state carries a leading per-replica axis; each
+            # replica starts from the init value (≙ values.py:1294).
+            # ALWAYS broadcast — the init value is the per-replica value,
+            # never a pre-stacked (R, ...) array (callers needing custom
+            # per-replica init construct SyncOnReadVariable directly).
+            val = jnp.asarray(value, dtype=dtype)
+            val = jnp.broadcast_to(
+                val, (self.num_replicas_in_sync,) + val.shape)
             var = SyncOnReadVariable(
-                value, mesh=self.mesh, data_axes=self.data_axis_names,
+                val, mesh=self.mesh, data_axes=self.data_axis_names,
                 name=name, aggregation=aggregation, dtype=dtype)
         self._variables.append(var)
         return var
@@ -409,13 +417,33 @@ class Strategy:
         def is_dist(v):
             return isinstance(v, DistributedValues)
 
+        def is_data_sharded(v):
+            """A device array already sharded over this mesh's data axes
+            (a distributed-dataset batch): each replica gets its local
+            shard, matching the reference's per-replica dataset element
+            semantics (input_lib.py DistributedIterator)."""
+            sh = getattr(v, "sharding", None)
+            if not isinstance(v, jax.Array) or \
+                    not isinstance(sh, NamedSharding):
+                return False
+            if sh.mesh.devices.shape != self.mesh.devices.shape or \
+                    set(sh.mesh.axis_names) != set(self.mesh.axis_names):
+                return False
+            spec = sh.spec
+            if not spec or spec[0] is None:
+                return False
+            first = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            return any(a in axes for a in first)
+
         flat_args, args_treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=is_dist)
         split_mask = [is_dist(v) for v in flat_args]
+        sharded_mask = [not m and is_data_sharded(v)
+                        for v, m in zip(flat_args, split_mask)]
         stacked = [
             jnp.stack([jnp.asarray(x) for x in v.values]) if m else
-            jnp.asarray(v)
-            for v, m in zip(flat_args, split_mask)]
+            (v if sh else jnp.asarray(v))
+            for v, m, sh in zip(flat_args, split_mask, sharded_mask)]
 
         variables = self._variables
         var_vals = [_orig_value(v) for v in variables]
@@ -426,7 +454,7 @@ class Strategy:
         # NOTE: a lambda recreated each call defeats the cache — pass a
         # stable function object in training loops.
         cache_key = (
-            fn, args_treedef, tuple(split_mask),
+            fn, args_treedef, tuple(split_mask), tuple(sharded_mask),
             tuple((x.shape, str(x.dtype)) for x in stacked),
             tuple(id(v) for v in variables),
             tuple((tuple(v.shape), str(v.dtype)) for v in variables),
@@ -444,6 +472,9 @@ class Strategy:
             var_locals = [jnp.squeeze(val, axis=0) if r else val
                           for v, val, r in zip(variables, var_vals_in, on_read)]
             overlay = {id(v): val for v, val in zip(variables, var_locals)}
+            # PerReplica leaves: drop the stacked replica axis (size 1
+            # locally). Data-sharded leaves: the local shard IS the
+            # replica's sub-batch — pass through.
             local = [jnp.squeeze(v, axis=0) if m else v
                      for v, m in zip(leaves, split_mask)]
             (largs, lkwargs) = jax.tree_util.tree_unflatten(args_treedef, local)
@@ -483,7 +514,8 @@ class Strategy:
             return tuple(new_vals), out_stacked
 
         in_specs = (
-            [P(axes) if m else P() for m in split_mask])
+            [P(axes) if (m or sh) else P()
+             for m, sh in zip(split_mask, sharded_mask)])
         shard_fn = jax.jit(jax.shard_map(
             spmd_fn,
             mesh=self.mesh,

@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from distributed_tensorflow_tpu.parallel.collectives import ReduceOp
 from distributed_tensorflow_tpu.parallel.strategy import (
     get_replica_context, get_strategy, has_strategy)
-from distributed_tensorflow_tpu.parallel.values import PerReplica
+from distributed_tensorflow_tpu.parallel.values import (
+    PerReplica,
+    VariableAggregation,
+    VariableSynchronization,
+)
 
 
 class StrategyConformance:
@@ -122,9 +126,216 @@ class StrategyConformance:
         gathered = s.gather(out, axis=0)
         assert gathered.shape[0] == s.num_replicas_in_sync
 
-    # -- entry point ------------------------------------------------------
+    # -- nested scope (≙ strategy_test_lib nested-scope contract) ---------
 
-    CHECKS = [name for name in sorted(dir()) if name.startswith("check_")]
+    def check_nested_scope_restores_outer(self, s):
+        s2 = self.make_strategy()
+        with s.scope():
+            assert get_strategy() is s
+            with s2.scope():
+                assert get_strategy() is s2
+            assert get_strategy() is s
+        assert not has_strategy()
+
+    def check_scope_reentrant(self, s):
+        with s.scope():
+            with s.scope():
+                assert get_strategy() is s
+            assert get_strategy() is s
+
+    # -- VariableAggregation write matrix (≙ values.py OnWrite :1705) -----
+
+    def _rid(self):
+        ctx = get_replica_context()
+        rid = ctx.replica_id_in_sync_group
+        return (rid.astype(jnp.float32) if isinstance(rid, jax.Array)
+                else jnp.asarray(float(rid)))
+
+    def check_on_write_aggregation_mean(self, s):
+        n = s.num_replicas_in_sync
+        with s.scope():
+            v = s.create_variable(jnp.zeros(()), name="m",
+                                  aggregation=VariableAggregation.MEAN)
+        s.run(lambda: v.assign(self._rid()))
+        np.testing.assert_allclose(float(np.asarray(v.read_value())),
+                                   (n - 1) / 2, rtol=1e-6)
+
+    def check_on_write_aggregation_sum(self, s):
+        n = s.num_replicas_in_sync
+        with s.scope():
+            v = s.create_variable(jnp.zeros(()), name="sm",
+                                  aggregation=VariableAggregation.SUM)
+        s.run(lambda: v.assign(self._rid() + 1.0))
+        np.testing.assert_allclose(float(np.asarray(v.read_value())),
+                                   n * (n + 1) / 2, rtol=1e-6)
+
+    def check_on_write_aggregation_only_first_replica(self, s):
+        with s.scope():
+            v = s.create_variable(
+                jnp.zeros(()), name="f",
+                aggregation=VariableAggregation.ONLY_FIRST_REPLICA)
+        s.run(lambda: v.assign(self._rid() + 7.0))
+        # replica 0's write wins everywhere
+        np.testing.assert_allclose(float(np.asarray(v.read_value())), 7.0)
+
+    def check_sync_on_read_sum(self, s):
+        n = s.num_replicas_in_sync
+        with s.scope():
+            v = s.create_variable(
+                jnp.zeros(()), name="metric",
+                synchronization=VariableSynchronization.ON_READ,
+                aggregation=VariableAggregation.SUM)
+        s.run(lambda: v.assign_add(jnp.asarray(1.0)))
+        # each replica accumulated locally; global read sums
+        np.testing.assert_allclose(float(np.asarray(v.read_value())),
+                                   float(n))
+
+    def check_sync_on_read_accumulates_across_runs(self, s):
+        n = s.num_replicas_in_sync
+        with s.scope():
+            v = s.create_variable(
+                jnp.zeros(()), name="metric2",
+                synchronization=VariableSynchronization.ON_READ,
+                aggregation=VariableAggregation.SUM)
+
+        def fn():
+            v.assign_add(jnp.asarray(1.0))
+
+        s.run(fn)
+        s.run(fn)
+        np.testing.assert_allclose(float(np.asarray(v.read_value())),
+                                   2.0 * n)
+
+    # -- input iteration through the strategy (≙ strategy_test_lib
+    #    _test_input_fn_iterable / minimize_loss contracts) ----------------
+
+    def check_distribute_dataset_iteration(self, s):
+        from distributed_tensorflow_tpu.input.dataset import Dataset
+        n = s.num_replicas_in_sync
+        data = np.arange(4 * n * 2, dtype=np.float32).reshape(4 * n, 2)
+        ds = Dataset.from_tensor_slices(data).batch(n)
+        dist = s.experimental_distribute_dataset(ds)
+        seen = []
+        for batch in dist:
+            assert batch.shape == (n, 2), batch.shape
+            seen.append(np.asarray(batch))
+        np.testing.assert_allclose(np.concatenate(seen, axis=0), data)
+
+    def check_distributed_batch_feeds_run(self, s):
+        from distributed_tensorflow_tpu.input.dataset import Dataset
+        n = s.num_replicas_in_sync
+        data = np.ones((2 * n, 3), np.float32)
+        dist = s.experimental_distribute_dataset(
+            Dataset.from_tensor_slices(data).batch(n))
+        batch = next(iter(dist))
+
+        def fn(b):
+            ctx = get_replica_context()
+            return ctx.all_reduce(ReduceOp.SUM, jnp.sum(b))
+
+        out = s.run(fn, args=(batch,))
+        vals = out.values if isinstance(out, PerReplica) else [out]
+        np.testing.assert_allclose(float(np.asarray(vals[0])), 3.0 * n)
+
+    def check_distribute_values_feed_run(self, s):
+        n = s.num_replicas_in_sync
+        vals = s.experimental_distribute_values_from_function(
+            lambda ctx: np.asarray([float(ctx.replica_id_in_sync_group)],
+                                   np.float32))
+        out = s.run(lambda x: x * 2.0, args=(vals,))
+        got = sorted(float(np.asarray(v).ravel()[0]) for v in out.values)
+        assert got == [2.0 * i for i in range(n)], got
+
+    # -- merge_call / optimizer pattern (≙ mirrored_run.py:433 +
+    #    strategy_test_lib minimize-with-merge_call) -----------------------
+
+    def check_merge_call_reduces(self, s):
+        n = s.num_replicas_in_sync
+
+        def fn():
+            ctx = get_replica_context()
+            grad = self._rid() + 1.0
+
+            def merge(strategy, g):
+                return strategy.extended.reduce_to(ReduceOp.SUM, g)
+
+            return ctx.merge_call(merge, args=(grad,))
+
+        out = s.run(fn)
+        vals = out.values if isinstance(out, PerReplica) else [out]
+        for v in vals:
+            np.testing.assert_allclose(float(np.asarray(v)),
+                                       n * (n + 1) / 2, rtol=1e-6)
+
+    def check_merge_call_optimizer_apply(self, s):
+        """The classic optimizer shape: per-replica grads -> merge_call
+        reduces -> extended.update applies once to the variable."""
+        n = s.num_replicas_in_sync
+        with s.scope():
+            v = s.create_variable(jnp.asarray(10.0), name="w")
+
+        def fn():
+            ctx = get_replica_context()
+            grad = self._rid() + 1.0          # mean = (n+1)/2
+
+            def merge(strategy, g):
+                g = strategy.extended.reduce_to(ReduceOp.MEAN, g)
+                strategy.extended.update(
+                    v, lambda var, gg: var.assign_sub(gg), args=(g,))
+
+            ctx.merge_call(merge, args=(grad,))
+
+        s.run(fn)
+        np.testing.assert_allclose(float(np.asarray(v.read_value())),
+                                   10.0 - (n + 1) / 2, rtol=1e-6)
+
+    # -- replica collectives beyond all_reduce ----------------------------
+
+    def check_all_gather_in_replica_context(self, s):
+        n = s.num_replicas_in_sync
+
+        def fn():
+            ctx = get_replica_context()
+            return ctx.all_gather(jnp.reshape(self._rid(), (1,)), axis=0)
+
+        out = s.run(fn)
+        vals = out.values if isinstance(out, PerReplica) else [out]
+        np.testing.assert_allclose(np.sort(np.asarray(vals[0]).ravel()),
+                                   np.arange(n, dtype=np.float32))
+
+    def check_gather_preserves_replica_order(self, s):
+        n = s.num_replicas_in_sync
+        out = s.run(lambda: jnp.reshape(self._rid(), (1,)))
+        gathered = np.asarray(s.gather(out, axis=0)).ravel()
+        np.testing.assert_allclose(gathered,
+                                   np.arange(n, dtype=np.float32))
+
+    def check_reduce_with_axis(self, s):
+        out = s.run(lambda: jnp.asarray([1.0, 3.0]))
+        red = s.reduce(ReduceOp.MEAN, out, axis=0)
+        np.testing.assert_allclose(float(np.asarray(red)), 2.0, rtol=1e-6)
+
+    def check_run_twice_consistent(self, s):
+        """The compiled-run cache must not corrupt repeat executions."""
+        with s.scope():
+            v = s.create_variable(jnp.zeros(()), name="c2")
+
+        def fn():
+            v.assign_add(1.0)
+
+        s.run(fn)
+        s.run(fn)
+        np.testing.assert_allclose(float(np.asarray(v.read_value())), 2.0)
+
+    def check_value_context_fields(self, s):
+        n = s.num_replicas_in_sync
+        seen = []
+        s.experimental_distribute_values_from_function(
+            lambda ctx: seen.append((ctx.replica_id_in_sync_group,
+                                     ctx.num_replicas_in_sync)))
+        assert seen == [(i, n) for i in range(n)], seen
+
+    # -- entry point ------------------------------------------------------
 
     def test_conformance(self, devices):
         failures = []

@@ -2,8 +2,12 @@
 """Config #1: MNIST CNN under MirroredStrategy semantics (BASELINE.md).
 
 Single-host synchronous data parallelism — the TPU-native counterpart of
-the reference's `MirroredStrategy` Keras script. Uses the TF-parity
-Strategy API end to end: scope() -> distribute dataset -> run().
+the reference's `MirroredStrategy` Keras script, on the NATIVE path
+(SURVEY §3.4): distribute dataset -> replicate state -> one compiled
+SPMD step via `strategy.compile_step`. For the TF-parity
+scope()/run()/merge_call surface, see tests/test_strategy.py and the
+conformance suite (testing/strategy_conformance.py); for the Keras-style
+`Model.fit` layer, see distributed_tensorflow_tpu/training.
 """
 
 import argparse
@@ -35,27 +39,18 @@ def main():
 
     state, model, tx = create_train_state(jax.random.PRNGKey(0),
                                           learning_rate=args.lr)
-    train_step = make_train_step(model, tx)
+    # native path (SURVEY §3.4): replicated state + ONE compiled SPMD
+    # step; the distributed dataset lands batches sharded over the mesh
+    state = strategy.replicate(state)
+    step_fn = strategy.compile_step(make_train_step(model, tx))
 
     it = iter(dist_ds)
     for step in range(args.steps):
-        batch = next(it)
-        state, metrics = strategy.run_step(train_step, state, batch) \
-            if hasattr(strategy, "run_step") else train_step_distributed(
-                strategy, train_step, state, batch)
+        state, metrics = step_fn(state, next(it))
         if step % 20 == 0 or step == args.steps - 1:
             print(f"step {step}: loss={float(metrics['loss']):.4f} "
                   f"acc={float(metrics['accuracy']):.3f}")
     print("done")
-
-
-def train_step_distributed(strategy, train_step, state, batch):
-    """SPMD path: batch is already sharded over the mesh; params
-    replicated; one jit step (≙ Strategy.run on TPU, SURVEY §3.4)."""
-    import functools
-    if not hasattr(strategy, "_compiled_step"):
-        strategy._compiled_step = jax.jit(train_step)
-    return strategy._compiled_step(state, batch)
 
 
 if __name__ == "__main__":
