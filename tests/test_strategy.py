@@ -195,8 +195,10 @@ def test_run_cache_hit(devices):
 def test_on_read_variable_in_run(devices):
     s = dtx.MirroredStrategy()
     with s.scope():
+        # init value is the PER-REPLICA value; create_variable adds the
+        # leading replica axis itself
         acc = s.create_variable(
-            np.zeros((8, 1)), name="acc",
+            np.zeros(1), name="acc",
             synchronization=VariableSynchronization.ON_READ,
             aggregation=VariableAggregation.SUM)
     s.run(lambda: acc.assign_add(1.0))
