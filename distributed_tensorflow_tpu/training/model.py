@@ -81,12 +81,19 @@ class Model:
                   validation_data=(x_test, y_test))
     """
 
-    def __init__(self, module, *, seed: int = 0):
+    def __init__(self, module, *, eval_module=None, seed: int = 0):
+        """``eval_module``: variant used by evaluate/predict for modules
+        whose eval behavior is a constructor flag (e.g.
+        ``ResNet(cfg, train=False)`` for running BN averages)."""
         self.module = module
+        self.eval_module = eval_module or module
         self.seed = seed
         self.strategy = None
         self.stop_training = False
-        self._state = None              # {"params", "opt_state", "step"}
+        # {"params", "opt_state", "step", "model_state"} — model_state
+        # holds non-param flax collections (batch_stats etc.,
+        # ≙ Keras non-trainable weights updated by the forward pass)
+        self._state = None
         self._built = False
         self._compiled = False
         self._train_fn = None
@@ -103,11 +110,13 @@ class Model:
             sample_input)
         rng = jax.random.PRNGKey(self.seed)
 
-        def init_params():
-            return self.module.init(rng, sample)["params"]
+        def init_vars():
+            return dict(self.module.init(rng, sample))
 
-        params = self.strategy.init_state(init_params)
-        self._state = {"params": params, "step": jnp.zeros((), jnp.int32)}
+        variables = self.strategy.init_state(init_vars)
+        params = variables.pop("params")
+        self._state = {"params": params, "step": jnp.zeros((), jnp.int32),
+                       "model_state": variables}
         if self._compiled:
             self._state["opt_state"] = self.strategy.init_state(
                 lambda: self._tx.init(params))
@@ -194,23 +203,37 @@ class Model:
         metrics, loss_metric = self._metrics, self._loss_metric
         tx = self._tx
 
-        def step(state, mstate, batch):
+        def step(state, mstate, batch, full):
             x, y, sw = batch
+            model_state = state.get("model_state", {})
+            collections = list(model_state)
 
             def compute_loss(params):
-                preds = module.apply({"params": params}, x)
+                if collections:
+                    preds, mutated = module.apply(
+                        {"params": params, **model_state}, x,
+                        mutable=collections)
+                else:
+                    preds, mutated = module.apply({"params": params}, x), {}
                 per = loss_obj.call(y, preds).astype(jnp.float32)
                 w = sw.astype(jnp.float32)
                 loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
-                return loss, (preds, per)
+                return loss, (preds, per, mutated)
 
-            (loss, (preds, per)), grads = jax.value_and_grad(
+            (loss, (preds, per, mutated)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(state["params"])
             updates, opt_state = tx.update(grads, state["opt_state"],
                                            state["params"])
             params = optax.apply_updates(state["params"], updates)
+            # forward-pass state (BN batch statistics) computed over a
+            # zero-PADDED final batch would corrupt the running averages
+            # — keep the previous state for partial batches (`full`=0)
+            new_model_state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(full > 0, new, old),
+                model_state, dict(mutated)) if collections else {}
             new_state = {"params": params, "opt_state": opt_state,
-                         "step": state["step"] + 1}
+                         "step": state["step"] + 1,
+                         "model_state": new_model_state}
             m2 = dict(mstate)
             m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
             for m in metrics:
@@ -223,12 +246,12 @@ class Model:
     def _make_eval_function(self):
         if self._eval_fn is not None:
             return self._eval_fn
-        module, loss_obj = self.module, self._loss
+        module, loss_obj = self.eval_module, self._loss
         metrics, loss_metric = self._metrics, self._loss_metric
 
-        def eval_step(params, mstate, batch):
+        def eval_step(params, model_state, mstate, batch):
             x, y, sw = batch
-            preds = module.apply({"params": params}, x)
+            preds = module.apply({"params": params, **model_state}, x)
             per = loss_obj.call(y, preds).astype(jnp.float32)
             m2 = dict(mstate)
             m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
@@ -242,16 +265,19 @@ class Model:
     def _make_predict_function(self):
         if self._predict_fn is not None:
             return self._predict_fn
-        module = self.module
+        module = self.eval_module
         self._predict_fn = jax.jit(
-            lambda params, x: module.apply({"params": params}, x))
+            lambda params, model_state, x: module.apply(
+                {"params": params, **model_state}, x))
         return self._predict_fn
 
     # -- data plumbing -----------------------------------------------------
     def _batches(self, x, y=None, sample_weight=None, *, batch_size,
                  shuffle=False, seed=0):
-        """Yield (x, y, sw) global batches with a static batch size: the
-        final partial batch is zero-padded and masked via sw."""
+        """Yield ((x, y, sw), full) global batches with a static batch
+        size: the final partial batch is zero-padded and masked via sw,
+        with ``full`` = 0.0 flagging it (so forward-pass state updates
+        can be suppressed for padded rows)."""
         if isinstance(x, Dataset) or (y is None and not isinstance(
                 x, (np.ndarray, jnp.ndarray))):
             # pre-batched dataset / iterable of (x, y[, sw]) tuples
@@ -291,14 +317,15 @@ class Model:
         sw = np.ones(n, np.float32) if bw is None else \
             np.asarray(bw, np.float32)
         if n == full:
-            return bx, by, sw
+            return (bx, by, sw), np.float32(1.0)
 
         def pad(a):
             a = np.asarray(a)
             width = [(0, full - n)] + [(0, 0)] * (a.ndim - 1)
             return np.pad(a, width)
-        return (jax.tree_util.tree_map(pad, bx),
-                jax.tree_util.tree_map(pad, by), pad(sw))
+        return ((jax.tree_util.tree_map(pad, bx),
+                 jax.tree_util.tree_map(pad, by), pad(sw)),
+                np.float32(0.0))
 
     def _place(self, batch):
         return self.strategy.shard_batch(batch)
@@ -313,9 +340,9 @@ class Model:
         if not self._compiled:
             raise RuntimeError("compile() the model before fit()")
         if not self._built:
-            first = next(iter(self._batches(
+            (first_x, _, _), _ = next(iter(self._batches(
                 x, y, batch_size=batch_size, shuffle=False)))
-            self.build(first[0])
+            self.build(first_x)
             self._state["opt_state"] = self.strategy.init_state(
                 lambda: self._tx.init(self._state["params"]))
 
@@ -344,13 +371,13 @@ class Model:
             cb_list.on_epoch_begin(epoch)
             mstate = self._metric_init()
             steps = 0
-            for batch in self._batches(x, y, sample_weight,
-                                       batch_size=batch_size,
-                                       shuffle=shuffle,
-                                       seed=self.seed + epoch):
+            for batch, full in self._batches(x, y, sample_weight,
+                                             batch_size=batch_size,
+                                             shuffle=shuffle,
+                                             seed=self.seed + epoch):
                 cb_list.on_train_batch_begin(steps)
                 self._state, mstate = train_fn(
-                    self._state, mstate, self._place(batch))
+                    self._state, mstate, self._place(batch), full)
                 if want_batch_logs:
                     cb_list.on_train_batch_end(
                         steps, self._metric_results(mstate))
@@ -381,9 +408,10 @@ class Model:
         eval_fn = self._make_eval_function()
         mstate = self._metric_init()
         count = 0
-        for batch in self._batches(x, y, sample_weight,
-                                   batch_size=batch_size):
-            mstate = eval_fn(self._state["params"], mstate,
+        for batch, _full in self._batches(x, y, sample_weight,
+                                          batch_size=batch_size):
+            mstate = eval_fn(self._state["params"],
+                             self._state.get("model_state", {}), mstate,
                              self._place(batch))
             count += 1
             if steps and count >= steps:
@@ -407,13 +435,15 @@ class Model:
                 width = [(0, batch_size - n)] + [(0, 0)] * (bx.ndim - 1)
                 bx = np.pad(bx, width)
             preds = predict_fn(self._state["params"],
+                               self._state.get("model_state", {}),
                                self._place(bx))
             outs.append(np.asarray(preds)[:n])
             total += n
         return np.concatenate(outs, axis=0)
 
     def __call__(self, x):
-        return self._make_predict_function()(self._state["params"], x)
+        return self._make_predict_function()(
+            self._state["params"], self._state.get("model_state", {}), x)
 
     # -- weights -----------------------------------------------------------
     @property
@@ -431,26 +461,59 @@ class Model:
             weights, shardings)
 
     def save_weights(self, path: str):
+        """Params AND non-param model state (BN running stats — the
+        Keras non-trainable weights) when present."""
         from distributed_tensorflow_tpu.checkpoint.checkpoint import (
             Checkpoint)
-        Checkpoint(params=self._state["params"]).write(path)
+        extra = ({"model_state": self._state["model_state"]}
+                 if self._state.get("model_state") else {})
+        Checkpoint(params=self._state["params"], **extra).write(path)
 
     def load_weights(self, path: str):
         from distributed_tensorflow_tpu.checkpoint.checkpoint import (
             Checkpoint)
-        restored = Checkpoint(
-            params=self._state["params"]).restore(path)
+        extra = ({"model_state": self._state["model_state"]}
+                 if self._state.get("model_state") else {})
+        try:
+            restored = Checkpoint(params=self._state["params"],
+                                  **extra).restore(path)
+        except KeyError:
+            # weights file predates model_state support: params only
+            extra = {}
+            restored = Checkpoint(
+                params=self._state["params"]).restore(path)
         tree = _unflatten_like(self._state["params"], restored, "params")
         self.set_weights(tree)
+        if extra:
+            self._state["model_state"] = self._replaced_like(
+                self._state["model_state"],
+                _unflatten_like(self._state["model_state"], restored,
+                                "model_state"))
+
+    @staticmethod
+    def _replaced_like(current, restored):
+        """device_put restored host arrays with the current leaves'
+        shardings (mirrors the params restore path — restored state must
+        live on the mesh, not as process-local arrays)."""
+        return jax.tree_util.tree_map(
+            lambda cur, new: jax.device_put(
+                jnp.asarray(new, getattr(cur, "dtype", None)),
+                cur.sharding) if hasattr(cur, "sharding")
+            else jnp.asarray(new),
+            current, restored)
 
     # -- backup/restore (≙ worker_training_state.py:34) -------------------
     def _back_up(self, backup_dir: str, epoch: int):
         from distributed_tensorflow_tpu.checkpoint.checkpoint import (
             Checkpoint)
+        extra = {}
+        if self._state.get("model_state"):
+            extra["model_state"] = self._state["model_state"]
         Checkpoint(
             params=self._state["params"],
             opt_state=self._state["opt_state"],
             epoch=np.asarray(epoch, np.int64),
+            **extra,
         ).write(os.path.join(backup_dir, "backup"))
 
     def _maybe_restore_backup(self, backup_dir: str):
@@ -459,13 +522,29 @@ class Model:
         path = os.path.join(backup_dir, "backup")
         if not os.path.exists(os.path.join(path, "checkpoint.index.json")):
             return
-        ckpt = Checkpoint(params=self._state["params"],
-                          opt_state=self._state["opt_state"],
-                          epoch=np.zeros((), np.int64))
-        restored = ckpt.restore(path)
+        extra = {}
+        if self._state.get("model_state"):
+            extra["model_state"] = self._state["model_state"]
+        try:
+            restored = Checkpoint(params=self._state["params"],
+                                  opt_state=self._state["opt_state"],
+                                  epoch=np.zeros((), np.int64),
+                                  **extra).restore(path)
+        except KeyError:
+            # backup predates model_state support: restore what exists
+            extra = {}
+            restored = Checkpoint(params=self._state["params"],
+                                  opt_state=self._state["opt_state"],
+                                  epoch=np.zeros((), np.int64)
+                                  ).restore(path)
         params = _unflatten_like(self._state["params"], restored, "params")
         opt = _unflatten_like(self._state["opt_state"], restored,
                               "opt_state")
+        if extra:
+            self._state["model_state"] = self._replaced_like(
+                self._state["model_state"],
+                _unflatten_like(self._state["model_state"], restored,
+                                "model_state"))
         shardings = jax.tree_util.tree_map(
             lambda a: a.sharding, self._state["params"])
         self._state["params"] = jax.tree_util.tree_map(

@@ -204,3 +204,42 @@ def test_mnist_cnn_via_fit(devices):
     hist = model.fit(np.asarray(images), np.asarray(labels), epochs=4,
                      batch_size=64, verbose=0)
     assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_resnet_via_fit_under_tpu_strategy(devices):
+    """config #2 (ResNet, batch-norm state) through the façade under
+    TPUStrategy: non-param flax collections (batch_stats) must update
+    during fit and feed evaluate/predict via the eval module
+    (≙ Keras non-trainable weights + BackupAndRestore discipline)."""
+    import jax
+    from distributed_tensorflow_tpu.models.resnet import (
+        ResNet, ResNetConfig)
+    from distributed_tensorflow_tpu.parallel.tpu_strategy import TPUStrategy
+
+    cfg = ResNetConfig.tiny()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16, 16, 3)).astype(np.float32)
+    y = rng.integers(0, cfg.num_classes, size=128).astype(np.int32)
+
+    strategy = TPUStrategy()
+    with strategy.scope():
+        model = Model(ResNet(cfg, train=True),
+                      eval_module=ResNet(cfg, train=False))
+        model.compile(optimizer="sgd", learning_rate=0.1,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+    model.build(x[:32])
+    initial_stats = [np.asarray(s) for s in
+                     jax.tree_util.tree_leaves(model._state["model_state"])]
+    hist = model.fit(x, y, epochs=3, batch_size=32, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    # BN running stats actually moved from their init during training
+    stats = [np.asarray(s) for s in
+             jax.tree_util.tree_leaves(model._state["model_state"])]
+    assert stats and any(not np.allclose(a, b)
+                         for a, b in zip(initial_stats, stats))
+    # eval path consumes the running averages without error
+    res = model.evaluate(x[:64], y[:64], batch_size=32)
+    assert "loss" in res and np.isfinite(res["loss"])
+    preds = model.predict(x[:40], batch_size=32)
+    assert preds.shape == (40, cfg.num_classes)
