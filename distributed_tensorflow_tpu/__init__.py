@@ -80,6 +80,21 @@ from distributed_tensorflow_tpu.parallel.multi_worker import (
 from distributed_tensorflow_tpu.parallel.tpu_strategy import TPUStrategy
 from distributed_tensorflow_tpu.parallel.parameter_server import (
     ParameterServerStrategy,
+    ParameterServerStrategyV1,
+    ParameterServerStrategyV2,
+)
+from distributed_tensorflow_tpu.parallel.central_storage import (
+    CentralStorageStrategy,
+)
+from distributed_tensorflow_tpu.parallel.ps_values import (
+    AggregatingVariable,
+    CachingVariable,
+)
+from distributed_tensorflow_tpu.cluster.platform_resolvers import (
+    GCEClusterResolver,
+    KubernetesClusterResolver,
+    SageMakerClusterResolver,
+    SlurmClusterResolver,
 )
 
 from distributed_tensorflow_tpu.input.dataset import (
@@ -92,8 +107,12 @@ from distributed_tensorflow_tpu.input.dataset import (
 from distributed_tensorflow_tpu import models
 from distributed_tensorflow_tpu import ops
 from distributed_tensorflow_tpu import training
+from distributed_tensorflow_tpu import embedding
 from distributed_tensorflow_tpu.cluster.coordination import (
     coordination_service,
 )
+from distributed_tensorflow_tpu.utils import bfloat16
+from distributed_tensorflow_tpu.utils import summary
+from distributed_tensorflow_tpu.utils import tensor_tracer
 
 __version__ = "0.1.0"

@@ -1,0 +1,56 @@
+"""CentralStorageStrategy: variables on the host, compute on the mesh.
+
+≙ tensorflow/python/distribute/central_storage_strategy.py (~200 LoC,
+SURVEY.md §2.1/§2.8): one physical copy of every variable on the
+parameter device (host CPU), compute replicated across local
+accelerators, replica writes aggregated before applying.
+
+TPU-native form: variables are :class:`AggregatingVariable`s pinned to
+host memory; each compiled step pulls them in (H2D on dispatch — the PS
+read) and the write-back re-pins the single updated copy. The SPMD
+run/aggregation machinery is the shared Strategy core.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from distributed_tensorflow_tpu.cluster import topology as topo_lib
+from distributed_tensorflow_tpu.parallel.ps_values import (
+    AggregatingVariable,
+    _default_parameter_device,
+)
+from distributed_tensorflow_tpu.parallel.strategy import Strategy
+from distributed_tensorflow_tpu.parallel.values import (
+    VariableAggregation,
+    VariableSynchronization,
+)
+
+
+class CentralStorageStrategy(Strategy):
+    """Variables on one parameter device; replicas on the mesh."""
+
+    def __init__(self, mesh: Mesh | None = None, parameter_device=None):
+        super().__init__(mesh=mesh,
+                         data_axis_names=(topo_lib.DATA_AXIS,))
+        self._parameter_device = (parameter_device
+                                  or _default_parameter_device())
+
+    @property
+    def parameter_device(self):
+        return self._parameter_device
+
+    def create_variable(self, value, *, name=None, trainable=True,
+                        synchronization=VariableSynchronization.AUTO,
+                        aggregation=VariableAggregation.NONE, dtype=None):
+        if synchronization is VariableSynchronization.ON_READ:
+            return super().create_variable(
+                value, name=name, trainable=trainable,
+                synchronization=synchronization, aggregation=aggregation,
+                dtype=dtype)
+        var = AggregatingVariable(
+            value, device=self._parameter_device, name=name,
+            trainable=trainable, aggregation=aggregation, dtype=dtype)
+        self._variables.append(var)
+        return var

@@ -59,14 +59,29 @@ class CollectiveAllReduceStrategy(Strategy):
     def task_id(self) -> int | None:
         return getattr(self._cluster_resolver, "task_id", None)
 
-    def check_health(self) -> bool:
-        """≙ context.check_collective_ops_peer_health (context.py:1105).
-        Under the coordination service, liveness is continuously enforced;
-        an explicit check runs a tiny global barrier collective."""
+    def check_health(self, timeout_s: float = 30.0) -> bool:
+        """≙ context.check_collective_ops_peer_health (context.py:1105)
+        + the reference's fail-fast peer-health path
+        (collective_all_reduce_strategy.py:990). A coordination-service
+        barrier WITH a timeout: a hung or dead peer returns False within
+        ``timeout_s`` instead of blocking forever.
+
+        The barrier name comes from a CLUSTER-WIDE atomic counter (not a
+        local one): a missed or timed-out round must not desync the
+        names processes wait on in later rounds.
+        """
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        if not agent.is_distributed:
+            return True
         try:
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices("dtx_health_check")
+            # every participant bumps; the round id = value // world size
+            # is identical across processes once all have entered
+            n = agent.key_value_increment("dtx_health_check/seq", 1)
+            round_id = (n - 1) // agent.num_processes
+            agent.barrier(f"dtx_health_check/{round_id}",
+                          timeout_s=timeout_s)
             return True
         except Exception:
             return False

@@ -108,3 +108,61 @@ class ParameterServerStrategy(Strategy):
 
 # Alias for the V2 name used in reference scripts.
 ParameterServerStrategyV2 = ParameterServerStrategy
+
+
+class ParameterServerStrategyV1(Strategy):
+    """Graph-mode-era PS strategy (≙ parameter_server_strategy.py:
+    ``ParameterServerStrategyExtended``, SURVEY.md §2.1 row V1).
+
+    V1 places each variable WHOLE on one parameter server, round-robin —
+    vs V2's axis-0 sharding. TPU-native: variables are
+    :class:`AggregatingVariable`s pinned round-robin across parameter
+    devices (host CPU by default, mirroring vars-on-PS-host placement);
+    compute runs replicated on the mesh and write-back re-pins the
+    single copy, preserving the one-copy-per-variable memory profile.
+    """
+
+    def __init__(self, mesh: Mesh | None = None,
+                 parameter_devices: Sequence | None = None,
+                 cluster_resolver: ClusterResolver | None = None):
+        super().__init__(mesh=mesh,
+                         data_axis_names=(topo_lib.DATA_AXIS,))
+        self._cluster_resolver = cluster_resolver
+        if parameter_devices is None:
+            from distributed_tensorflow_tpu.parallel.ps_values import (
+                _default_parameter_device)
+            parameter_devices = [_default_parameter_device()]
+        self._parameter_devices = list(parameter_devices)
+        self._next_ps = 0
+
+    @property
+    def cluster_resolver(self) -> ClusterResolver | None:
+        return self._cluster_resolver
+
+    @property
+    def parameter_devices(self) -> list:
+        return list(self._parameter_devices)
+
+    def create_variable(self, value, *, name=None, trainable=True,
+                        synchronization=None, aggregation=None,
+                        dtype=None):
+        from distributed_tensorflow_tpu.parallel.ps_values import (
+            AggregatingVariable)
+        from distributed_tensorflow_tpu.parallel.values import (
+            VariableAggregation, VariableSynchronization)
+        if synchronization is VariableSynchronization.ON_READ:
+            # per-replica state is NOT parameter-server-placed
+            return super().create_variable(
+                value, name=name, trainable=trainable,
+                synchronization=synchronization,
+                aggregation=aggregation or VariableAggregation.SUM,
+                dtype=dtype)
+        device = self._parameter_devices[
+            self._next_ps % len(self._parameter_devices)]
+        self._next_ps += 1          # ≙ round-robin placement (:872)
+        var = AggregatingVariable(
+            value, device=device, name=name, trainable=trainable,
+            aggregation=aggregation or VariableAggregation.MEAN,
+            dtype=dtype)
+        self._variables.append(var)
+        return var

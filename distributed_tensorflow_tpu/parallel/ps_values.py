@@ -1,0 +1,114 @@
+"""PS-placed values: AggregatingVariable and per-worker caching.
+
+≙ tensorflow/python/distribute/ps_values.py (963 LoC — SURVEY.md §2.3):
+``AggregatingVariable`` (one physical copy on a parameter device, writes
+from replica context aggregated before applying) and ``CachingVariable``
+(a read-mostly per-worker cache of a PS variable).
+
+TPU-native mapping: the "parameter device" is a HOME DEVICE the variable
+is pinned to (host CPU for central storage, a designated chip for V1-style
+round-robin PS placement). Compute steps pull the value in (one transfer
+per step — the PS read), and write-back re-pins to the home device. The
+cross-replica write aggregation itself is enforced by Strategy.run's
+on-write machinery (strategy.py), exactly like MirroredVariable — the
+difference is placement, not math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.parallel.values import (
+    DistributedVariable,
+    VariableAggregation,
+    VariableSynchronization,
+)
+
+
+def _default_parameter_device():
+    """Host CPU: the reference's central-storage parameter device."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return jax.local_devices()[0]
+
+
+class AggregatingVariable(DistributedVariable):
+    """Single-copy variable on a parameter device with aggregated writes.
+
+    ≙ ps_values.AggregatingVariable: replica-context assigns aggregate
+    across replicas (MEAN by default) and apply once to the single copy.
+    """
+
+    def __init__(self, value, *, device=None, name=None, trainable=True,
+                 aggregation: VariableAggregation = VariableAggregation.MEAN,
+                 dtype=None):
+        self._home_device = device or _default_parameter_device()
+        value = jax.device_put(jnp.asarray(value, dtype=dtype),
+                               self._home_device)
+        super().__init__(
+            value, name=name, trainable=trainable,
+            synchronization=VariableSynchronization.ON_WRITE,
+            aggregation=(aggregation
+                         if aggregation is not VariableAggregation.NONE
+                         else VariableAggregation.MEAN),
+            dtype=dtype)
+
+    @property
+    def device(self):
+        return self._home_device
+
+    def _set_raw(self, value):
+        # Strategy.run write-back: the updated value must come HOME (the
+        # point of central storage — one copy on the parameter device).
+        self._value = jax.device_put(value, self._home_device)
+
+
+class CachingVariable:
+    """Read-mostly cache of a PS variable (≙ ps_values.CachingVariable).
+
+    ``read_value`` serves the cached copy; ``update_cache`` re-reads the
+    source. Writes pass through to the source variable and refresh the
+    cache.
+    """
+
+    def __init__(self, source: DistributedVariable):
+        self._source = source
+        self._cache = source.read_value()
+
+    @property
+    def name(self):
+        return self._source.name
+
+    @property
+    def shape(self):
+        return self._source.shape
+
+    @property
+    def dtype(self):
+        return self._source.dtype
+
+    def read_value(self):
+        return self._cache
+
+    @property
+    def value(self):
+        return self._cache
+
+    def update_cache(self):
+        self._cache = self._source.read_value()
+        return self._cache
+
+    def assign(self, value):
+        self._source.assign(value)
+        return self.update_cache()
+
+    def assign_add(self, delta):
+        self._source.assign_add(delta)
+        return self.update_cache()
+
+    def __array__(self, dtype=None):
+        import numpy as np
+        arr = np.asarray(self._cache)
+        return arr.astype(dtype) if dtype is not None else arr

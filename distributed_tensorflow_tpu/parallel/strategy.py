@@ -342,6 +342,10 @@ class Strategy:
     def scope(self):
         """≙ Strategy.scope (distribute_lib.py:1223): variables created
         inside are placed on the mesh with this strategy's policy."""
+        from distributed_tensorflow_tpu.utils.summary import (
+            api_gauge, strategy_gauge)
+        strategy_gauge.set(type(self).__name__)   # ≙ distribute_lib.py:190
+        api_gauge.set("scope")
         _strategy_stack().append(self)
         try:
             yield self
@@ -446,7 +450,18 @@ class Strategy:
             for v, m, sh in zip(flat_args, split_mask, sharded_mask)]
 
         variables = self._variables
-        var_vals = [_orig_value(v) for v in variables]
+
+        def mesh_value(v):
+            """Mesh-placed values pass through; values pinned elsewhere
+            (AggregatingVariable home devices — central storage) are read
+            to host first: the PS read, re-placed by jit per in_specs."""
+            val = _orig_value(v)
+            sh = getattr(val, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+                return val
+            return np.asarray(val)
+
+        var_vals = [mesh_value(v) for v in variables]
         var_specs = [v.spec for v in variables]
 
         # Cache the traced+compiled program per (fn, structure, shapes):

@@ -197,7 +197,10 @@ class DistributedVariable:
             # host data onto the original sharding — ≙ values.py saveable
             # restore re-placement, :1159).
             value = jax.device_put(value, self._value.sharding)
-        self._value = value
+        # placement tail goes through _set_raw so subclasses with a home
+        # device (AggregatingVariable) pin writes without shadowing the
+        # overlay-patched assign (strategy.py patches THIS method)
+        self._set_raw(value)
         return self
 
     def assign_add(self, delta) -> "DistributedVariable":

@@ -1,0 +1,135 @@
+"""On-device training loops + infeed-style data staging.
+
+≙ tensorflow/python/tpu/training_loop.py (``while_loop`` :31,
+``repeat`` :182 — keep N steps on-device so the host is out of the loop)
+and tpu_feed.py ``InfeedQueue`` (SURVEY.md §2.6). On a JAX TPU the
+"infeed queue" collapses to two native forms:
+
+- **scan-staged** (:func:`run_steps`): the next N batches are staged on
+  device as one stacked array and a ``lax.scan`` consumes them — the
+  whole N-step epoch is ONE XLA program, the direct analogue of
+  infeed-driven ``tpu.repeat``.
+- **host-streamed** (:class:`InfeedLoop`): batches stream through a
+  background device_put pipeline (double buffering) while the compiled
+  step runs — async dispatch overlaps H2D with compute, which is what
+  the infeed hardware queue achieved.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat(n: int, body_fn: Callable, inputs):
+    """Run ``body_fn`` n times on-device (≙ training_loop.repeat :182).
+
+    ``body_fn(state) -> state``; the loop is a single compiled
+    ``lax.fori_loop`` — the host dispatches once for all ``n`` steps.
+    """
+    return jax.lax.fori_loop(0, n, lambda _, s: body_fn(s), inputs)
+
+
+def while_loop(condition_fn: Callable, body_fn: Callable, inputs):
+    """≙ training_loop.while_loop (:31): on-device while with state.
+
+    ``condition_fn(state) -> bool``; ``body_fn(state) -> state``.
+    """
+    return jax.lax.while_loop(condition_fn, body_fn, inputs)
+
+
+def run_steps(step_fn: Callable, state, batches):
+    """Consume a leading-axis stack of batches in ONE compiled program.
+
+    ``step_fn(state, batch) -> (state, metrics)``; ``batches`` is a
+    pytree whose leaves have a leading axis of n_steps (staged on device
+    — the infeed queue's contents). Returns (state, stacked_metrics).
+    ≙ tpu.repeat + InfeedQueue: device-resident multi-step loop.
+    """
+    def body(s, batch):
+        s2, metrics = step_fn(s, batch)
+        return s2, metrics
+
+    return jax.lax.scan(body, state, batches)
+
+
+def stack_batches(batches: Iterable):
+    """Stage an iterable of same-shaped batches as one stacked pytree
+    (host-side helper for :func:`run_steps`)."""
+    batches = list(batches)
+    if not batches:
+        raise ValueError("no batches to stack")
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *batches)
+
+
+class InfeedLoop:
+    """Host-streamed stepping with background device staging.
+
+    ≙ tpu_feed.InfeedQueue + the session infeed thread: a daemon thread
+    device_puts upcoming batches (``buffer_size`` deep) while compiled
+    steps consume them — H2D overlaps compute without the host blocking
+    the step loop.
+
+        loop = InfeedLoop(iter(dataset), place_fn=strategy.shard_batch)
+        for _ in range(steps):
+            state, metrics = step_fn(state, loop.next())
+    """
+
+    def __init__(self, iterator: Iterator, place_fn: Callable | None = None,
+                 buffer_size: int = 2):
+        self._it = iterator
+        self._place = place_fn or (lambda b: jax.tree_util.tree_map(
+            jnp.asarray, b))
+        self._buf: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._size = buffer_size
+        self._done = False
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self):
+        try:
+            for batch in self._it:
+                staged = self._place(batch)
+                with self._cv:
+                    while len(self._buf) >= self._size and not self._done:
+                        self._cv.wait(0.1)
+                    if self._done:
+                        return
+                    self._buf.append(staged)
+                    self._cv.notify_all()
+        except BaseException as e:      # surfaced on next()
+            self._err = e
+        finally:
+            with self._cv:
+                self._done = True
+                self._cv.notify_all()
+
+    def next(self, timeout: float = 60.0):
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._buf or self._done or self._err, timeout)
+            if self._err is not None:
+                raise self._err
+            if not self._buf:
+                raise StopIteration
+            batch = self._buf.popleft()
+            self._cv.notify_all()
+            return batch
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._done = True
+            self._cv.notify_all()

@@ -1,0 +1,99 @@
+"""bfloat16 mixed-precision policy + scope.
+
+≙ tensorflow/python/tpu/bfloat16.py (:71 ``bfloat16_scope`` — a variable
+scope whose custom getter stores variables in fp32 and serves bf16 casts
+to compute; SURVEY.md §2.6). The TPU-native form is a thread-local
+POLICY (compute dtype / variable dtype) plus explicit cast helpers:
+storage stays fp32 (master weights), compute reads cast to bf16 — the
+exact split the models in this package implement via their ``dtype``
+configs, exposed here as the reference-shaped API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """≙ keras mixed_precision.Policy / the bfloat16_scope contract."""
+    name: str
+    compute_dtype: Any
+    variable_dtype: Any
+
+
+POLICIES = {
+    "float32": Policy("float32", jnp.float32, jnp.float32),
+    "mixed_bfloat16": Policy("mixed_bfloat16", jnp.bfloat16, jnp.float32),
+    "bfloat16": Policy("bfloat16", jnp.bfloat16, jnp.bfloat16),
+}
+
+_STATE = threading.local()
+
+
+def get_policy() -> Policy:
+    return getattr(_STATE, "policy", POLICIES["float32"])
+
+
+def set_global_policy(policy: "Policy | str"):
+    _STATE.policy = (POLICIES[policy] if isinstance(policy, str)
+                     else policy)
+
+
+@contextlib.contextmanager
+def policy_scope(policy: "Policy | str"):
+    prev = get_policy()
+    set_global_policy(policy)
+    try:
+        yield get_policy()
+    finally:
+        _STATE.policy = prev
+
+
+@contextlib.contextmanager
+def bfloat16_scope():
+    """≙ tpu.bfloat16_scope (bfloat16.py:71): compute in bf16, variables
+    stored fp32. Usage::
+
+        with bfloat16_scope():
+            y = model_fn(cast_to_compute(x), params)
+    """
+    with policy_scope("mixed_bfloat16") as p:
+        yield p
+
+
+def compute_dtype():
+    return get_policy().compute_dtype
+
+
+def variable_dtype():
+    return get_policy().variable_dtype
+
+
+def cast_to_compute(tree):
+    """Cast floating leaves to the active compute dtype (≙ the scope's
+    custom-getter cast on variable reads)."""
+    dt = compute_dtype()
+
+    def cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def cast_to_variable(tree):
+    """Cast floating leaves to the storage dtype (master copy)."""
+    dt = variable_dtype()
+
+    def cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+    return jax.tree_util.tree_map(cast, tree)

@@ -1,0 +1,196 @@
+"""Minimal TensorBoard summary writer — no TensorFlow dependency.
+
+≙ the reference's metrics/observability path (SURVEY.md §5.5:
+tf.summary scalar writing + monitoring gauges). Event files are written
+in the exact format TensorBoard reads: TFRecord-framed Event protos.
+Both the protobuf wire encoding (only the handful of fields scalar
+summaries need) and the masked-crc32c record framing are hand-rolled
+here — ~100 lines replacing the reference's summary-writer C++ stack
+for the scalar/text cases that matter for training loops.
+
+    writer = SummaryWriter(logdir)
+    writer.scalar("loss", 0.31, step=100)
+    writer.flush()
+
+Gauges (≙ tf.monitoring.*Gauge) are process-local observability cells;
+``strategy_gauge`` records which strategy class is active, matching the
+reference's distribution-strategy usage gauges (distribute_lib.py:190).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire encoding (just what Event/Summary need)
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _double(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def _float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def _int64(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _encode_scalar_event(tag: str, value: float, step: int,
+                         wall_time: float) -> bytes:
+    # Summary.Value { tag=1, simple_value=2 }
+    sval = _len_delim(1, tag.encode()) + _float(2, value)
+    # Summary { value=1 (repeated) }
+    summary = _len_delim(1, sval)
+    # Event { wall_time=1 (double), step=2 (int64), summary=5 }
+    return _double(1, wall_time) + _int64(2, step) + _len_delim(5, summary)
+
+
+def _encode_file_version(wall_time: float) -> bytes:
+    # Event { wall_time=1, file_version=3 (string) }
+    return _double(1, wall_time) + _len_delim(3, b"brain.Event:2")
+
+
+# ---------------------------------------------------------------------------
+# TFRecord framing with masked crc32c
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+
+
+def _make_crc_table():
+    poly = 0x82F63B78          # Castagnoli, reflected
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_make_crc_table()
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _tfrecord(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + payload + struct.pack("<I", _masked_crc(payload)))
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+class SummaryWriter:
+    """Append-only scalar summary writer (TensorBoard event file)."""
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{os.uname().nodename}.{os.getpid()}{filename_suffix}")
+        self.path = os.path.join(logdir, fname)
+        self._f = open(self.path, "ab")
+        self._lock = threading.Lock()
+        self._write(_encode_file_version(time.time()))
+
+    def _write(self, event: bytes):
+        with self._lock:
+            self._f.write(_tfrecord(event))
+
+    def scalar(self, tag: str, value: float, step: int,
+               wall_time: float | None = None):
+        self._write(_encode_scalar_event(
+            tag, float(value), int(step),
+            time.time() if wall_time is None else wall_time))
+
+    def scalars(self, values: dict, step: int):
+        for tag, v in values.items():
+            self.scalar(tag, v, step)
+
+    def flush(self):
+        with self._lock:
+            self._f.flush()
+
+    def close(self):
+        self.flush()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Monitoring gauges (≙ tf.monitoring — process-local observability)
+# ---------------------------------------------------------------------------
+
+class Gauge:
+    """Named cell set to the latest value (≙ monitoring.StringGauge)."""
+
+    _REGISTRY: dict = {}
+    _LOCK = threading.Lock()
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = None
+        with Gauge._LOCK:
+            Gauge._REGISTRY[name] = self
+
+    def set(self, value):
+        self._value = value
+
+    def value(self):
+        return self._value
+
+    @classmethod
+    def all_gauges(cls) -> dict:
+        with cls._LOCK:
+            return {k: g.value() for k, g in cls._REGISTRY.items()}
+
+
+# ≙ distribute_lib.py:190 distribution_strategy_gauge: records which
+# strategy the process is using (set by Strategy.scope).
+strategy_gauge = Gauge("/tensorflow/api/distribution_strategy",
+                       "active tf.distribute strategy class")
+api_gauge = Gauge("/tensorflow/api/distribution_strategy/api",
+                  "last distribution API used (scope/run/reduce)")

@@ -1,0 +1,162 @@
+"""Tensor Tracer: numerics inspection inside compiled programs.
+
+≙ tensorflow/python/tpu/tensor_tracer.py (2,314 LoC + flags + report —
+SURVEY.md §2.6): the reference instruments every op in a TPU graph and
+streams per-tensor statistics (norm / max / min / NaN counts) to a trace
+report for debugging silent numerical corruption on device.
+
+TPU-native design — two complementary instruments:
+
+- :func:`trace_point` — explicit markers inside ANY jitted/SPMD code.
+  Stats (norm, max, min, nan/inf counts) are computed ON DEVICE (a few
+  scalar reductions, negligible next to the surrounding matmuls) and
+  delivered to the host collector via ``jax.debug.callback`` — the
+  analogue of the reference's outfeed-streamed trace events.
+- :func:`trace_flax` — zero-annotation capture for flax models: runs
+  ``capture_intermediates`` and reduces every intermediate to the same
+  statistics, returning a :class:`TraceReport` (≙ tensor_tracer_report's
+  per-tensor table) that can locate e.g. the first NaN-producing module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TRACE_MODES = ("norm", "max-abs", "nan-count", "summary")
+
+
+def _stats(x) -> dict:
+    """The per-tensor statistic bundle (≙ trace_mode=summary)."""
+    x = jnp.asarray(x)
+    xf = x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.floating) \
+        else x.astype(jnp.float32)
+    return {
+        "norm": jnp.linalg.norm(xf.ravel()),
+        "max": jnp.max(xf) if x.size else jnp.float32(0),
+        "min": jnp.min(xf) if x.size else jnp.float32(0),
+        "mean": jnp.mean(xf) if x.size else jnp.float32(0),
+        "nan_count": jnp.sum(jnp.isnan(xf)),
+        "inf_count": jnp.sum(jnp.isinf(xf)),
+    }
+
+
+class _Collector(threading.local):
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+        self.active = False
+
+
+_COLLECTOR = _Collector()
+
+
+def trace_point(name: str, x, *, enabled: bool | None = None):
+    """Record numerics stats for ``x`` under ``name``; returns ``x``
+    unchanged (insert anywhere in jitted code, like the reference's
+    per-op instrumentation but opt-in). No-op unless inside a
+    :class:`TensorTracer` context (or ``enabled=True``)."""
+    if enabled is None:
+        enabled = _COLLECTOR.active
+    if not enabled:
+        return x
+    stats = _stats(x)
+
+    def record(**host_stats):
+        # instrumentation is baked at TRACE time; collection is gated at
+        # CALL time (a compiled fn may outlive the tracer context)
+        if _COLLECTOR.active:
+            _COLLECTOR.events.append(
+                (name,
+                 {k: np.asarray(v).item() for k, v in host_stats.items()}))
+
+    jax.debug.callback(record, **stats)
+    return x
+
+
+@dataclasses.dataclass
+class TraceReport:
+    """Per-tensor statistics table (≙ tensor_tracer_report.py)."""
+    entries: list  # [(name, {stat: float})]
+
+    def nan_entries(self) -> list:
+        return [(n, s) for n, s in self.entries
+                if s.get("nan_count", 0) > 0 or s.get("inf_count", 0) > 0]
+
+    def first_nan(self) -> "str | None":
+        bad = self.nan_entries()
+        return bad[0][0] if bad else None
+
+    def __str__(self):
+        lines = [f"{'tensor':50s} {'norm':>12s} {'max':>12s} "
+                 f"{'nan':>6s} {'inf':>6s}"]
+        for name, s in self.entries:
+            lines.append(
+                f"{name[:50]:50s} {s['norm']:12.4e} {s['max']:12.4e} "
+                f"{int(s['nan_count']):6d} {int(s['inf_count']):6d}")
+        return "\n".join(lines)
+
+
+class TensorTracer:
+    """Collects :func:`trace_point` events (≙ the tensor_tracer session).
+
+        tt = TensorTracer()
+        with tt:
+            jitted_step(state, batch)     # fns containing trace_point
+        print(tt.report())
+    """
+
+    def __enter__(self):
+        _COLLECTOR.events = []
+        _COLLECTOR.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _COLLECTOR.active = False
+        return False
+
+    def report(self) -> TraceReport:
+        # callbacks are async: drain outstanding work first
+        jax.effects_barrier()
+        return TraceReport(list(_COLLECTOR.events))
+
+
+def trace_flax(module, variables, *args, mutable=False,
+               **kwargs) -> tuple[Any, TraceReport]:
+    """Run a flax module capturing EVERY intermediate's numerics
+    (≙ full-graph tracing, trace_mode=summary). Returns
+    (outputs, TraceReport) with one entry per module call site.
+    """
+    out, state = module.apply(
+        variables, *args, capture_intermediates=True,
+        mutable=["intermediates"] if mutable is False
+        else list(mutable) + ["intermediates"], **kwargs)
+    inter = state["intermediates"]
+    entries = []
+
+    def walk(tree, prefix):
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                walk(tree[k], f"{prefix}/{k}" if prefix else k)
+        elif isinstance(tree, (tuple, list)):
+            for i, v in enumerate(tree):
+                walk(v, f"{prefix}[{i}]" if len(tree) > 1 else prefix)
+        elif hasattr(tree, "shape"):
+            entries.append(
+                (prefix, {k: float(np.asarray(v))
+                          for k, v in _stats(tree).items()}))
+
+    walk(jax.tree_util.tree_map(lambda x: x, inter,
+                                is_leaf=lambda x: hasattr(x, "shape")), "")
+    return out, TraceReport(entries)
+
+
+def find_first_nan(module, variables, *args, **kwargs) -> "str | None":
+    """Locate the first module call site producing NaN/Inf
+    (the reference's headline debugging use case)."""
+    _, report = trace_flax(module, variables, *args, **kwargs)
+    return report.first_nan()

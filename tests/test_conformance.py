@@ -30,3 +30,17 @@ class TestOneDeviceConformance(StrategyConformance):
 class TestMultiWorkerConformance(StrategyConformance):
     def make_strategy(self):
         return MultiWorkerMirroredStrategy()
+
+
+class TestCentralStorageConformance(StrategyConformance):
+    def make_strategy(self):
+        from distributed_tensorflow_tpu.parallel.central_storage import (
+            CentralStorageStrategy)
+        return CentralStorageStrategy()
+
+
+class TestParameterServerV1Conformance(StrategyConformance):
+    def make_strategy(self):
+        from distributed_tensorflow_tpu.parallel.parameter_server import (
+            ParameterServerStrategyV1)
+        return ParameterServerStrategyV1()

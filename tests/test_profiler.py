@@ -38,3 +38,22 @@ def test_step_marker_and_decorator(tmp_path):
 def test_options_accepted():
     opts = profiler.ProfilerOptions(host_tracer_level=3)
     assert opts.host_tracer_level == 3
+
+
+def test_local_trace_collection(tmp_path, devices):
+    """trace(target='local') runs an on-host session and writes a trace
+    (the remote form dispatches the same closure over remote_dispatch)."""
+    import os
+    from distributed_tensorflow_tpu.utils import profiler
+    profiler.trace("local", str(tmp_path), duration_ms=50)
+    found = []
+    for root, _dirs, files in os.walk(tmp_path):
+        found.extend(files)
+    assert found, "no trace files written"
+
+
+def test_trace_rejects_address_targets():
+    import pytest
+    from distributed_tensorflow_tpu.utils import profiler
+    with pytest.raises(TypeError, match="grpc ProfilerService"):
+        profiler.trace("host:6009", "/tmp/x")
