@@ -1,0 +1,87 @@
+"""Legacy distribute coordinator — the Estimator-era orchestration entry.
+
+≙ tensorflow/python/distribute/distribute_coordinator.py (872 LoC:
+``run_distribute_coordinator`` :627, ``DistributeCoordinatorMode``,
+``_WorkerContext`` — SURVEY.md §2.1 last row). The reference spawned
+std-server threads and ran ``worker_fn`` between-graph per task; the
+TPU-native runtime has no graph servers — INDEPENDENT_WORKER maps onto
+bootstrap.initialize (every process runs the same SPMD program) and
+STANDALONE_CLIENT onto a local run. Retained as the compatibility entry
+point for ported ``train_and_evaluate`` scripts; new code should use
+``Strategy`` + ``Model.fit`` directly.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+from distributed_tensorflow_tpu.cluster import bootstrap
+from distributed_tensorflow_tpu.cluster.resolver import (
+    ClusterSpec,
+    SimpleClusterResolver,
+    TFConfigClusterResolver,
+)
+
+
+class CoordinatorMode(enum.Enum):
+    """≙ DistributeCoordinatorMode."""
+    STANDALONE_CLIENT = "standalone_client"
+    INDEPENDENT_WORKER = "independent_worker"
+
+
+class WorkerContext:
+    """What ``worker_fn`` receives (≙ _WorkerContext): cluster facts plus
+    the strategy, already entered."""
+
+    def __init__(self, strategy, cluster_spec: ClusterSpec,
+                 task_type: str | None, task_id: int | None):
+        self.strategy = strategy
+        self.cluster_spec = cluster_spec
+        self.task_type = task_type
+        self.task_id = task_id
+
+    @property
+    def is_chief(self) -> bool:
+        from distributed_tensorflow_tpu.cluster.resolver import is_chief
+        if not self.cluster_spec or self.task_type is None:
+            return True
+        return is_chief(self.cluster_spec, self.task_type,
+                        self.task_id or 0)
+
+    @property
+    def distributed_mode(self) -> bool:
+        return bool(self.cluster_spec)
+
+
+def run_distribute_coordinator(
+        worker_fn: Callable, strategy,
+        mode: CoordinatorMode = CoordinatorMode.INDEPENDENT_WORKER,
+        cluster_spec: ClusterSpec | dict | None = None,
+        task_type: str | None = None, task_id: int | None = None):
+    """≙ run_distribute_coordinator (:627): resolve the cluster, connect
+    the runtime, and run ``worker_fn(context)`` under the strategy scope.
+
+    INDEPENDENT_WORKER: every task calls this with its own TF_CONFIG
+    (or explicit spec) — processes join via the coordination service and
+    execute the one SPMD program together. STANDALONE_CLIENT: run
+    locally against whatever devices are visible.
+    """
+    if isinstance(cluster_spec, dict):
+        cluster_spec = ClusterSpec(cluster_spec)
+    if cluster_spec is None:
+        resolver = TFConfigClusterResolver()
+        cluster_spec = resolver.cluster_spec()
+        task_type = task_type or resolver.task_type
+        task_id = task_id if task_id is not None else resolver.task_id
+    else:
+        resolver = SimpleClusterResolver(cluster_spec,
+                                         task_type=task_type or "",
+                                         task_id=task_id or 0)
+
+    if mode is CoordinatorMode.INDEPENDENT_WORKER and cluster_spec:
+        bootstrap.initialize(resolver=resolver)
+
+    ctx = WorkerContext(strategy, cluster_spec, task_type, task_id)
+    with strategy.scope():
+        return worker_fn(ctx)

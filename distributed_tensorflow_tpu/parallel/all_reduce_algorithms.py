@@ -1,0 +1,169 @@
+"""Explicit all-reduce algorithm library: ring, recursive halving-doubling,
+shuffle.
+
+≙ tensorflow/python/distribute/v1/all_reduce.py (1,282 LoC — SURVEY.md
+§2.2 "the algorithmic spec worth porting"): ``build_ring_all_reduce``
+(:250), ``build_recursive_hd_all_reduce`` (:422),
+``build_shuffle_all_reduce`` (:554). The reference builds these as
+per-device graph fragments with explicit send/recv edges; the TPU-native
+forms are shard_map-region functions over ``ppermute``/``all_to_all`` —
+the same chunk schedules, expressed as SPMD steps XLA compiles onto ICI.
+
+Default training paths should keep using ``psum`` (XLA picks the
+topology-optimal algorithm for the mesh); this library is the
+explicit-control option the reference ships — for experimentation,
+algorithm research, and validating XLA's choices against known
+schedules.
+
+All functions are per-shard region fns: call inside ``shard_map`` with
+the value REPLICATED per device (classic allreduce semantics, one
+contribution per device), e.g.::
+
+    out = shard_map(lambda x: ring_all_reduce(x, "dp"),
+                    mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                    check_rep=False)(stacked_contributions)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.psum(1, axis_name)
+
+
+def _chunk(chunks, idx):
+    """chunks[idx] with a traced index."""
+    return jax.lax.dynamic_index_in_dim(chunks, idx, axis=0,
+                                        keepdims=False)
+
+
+def _set_chunk(chunks, value, idx):
+    return jax.lax.dynamic_update_index_in_dim(chunks, value, idx, axis=0)
+
+
+def ring_all_reduce(x, axis_name: str = "dp"):
+    """Bandwidth-optimal ring allreduce (≙ build_ring_all_reduce :250).
+
+    Phase 1 — reduce-scatter: n-1 steps; at step s each device forwards
+    the partial sum it received and adds its OWN contribution for that
+    chunk. Phase 2 — all-gather: n-1 steps circulating the fully-reduced
+    chunks. Each device sends 2(n-1)/n of the payload total: the classic
+    ring bound.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return x
+    me = jax.lax.axis_index(axis_name)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)            # my contribution, chunked
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    # Reduce-scatter: device d starts the accumulation of chunk d; after
+    # n-1 hops (each adding the local contribution of the chunk in
+    # flight) device d holds the FULL sum of chunk (d+1) mod n.
+    buf = _chunk(chunks, me)
+    for s in range(n - 1):
+        buf = jax.lax.ppermute(buf, axis_name, fwd)
+        buf = buf + _chunk(chunks, (me - s - 1) % n)
+
+    # All-gather: circulate the reduced chunks.
+    out = _set_chunk(chunks, buf, (me + 1) % n)
+    for s in range(n - 1):
+        buf = jax.lax.ppermute(buf, axis_name, fwd)
+        out = _set_chunk(out, buf, (me - s) % n)
+    return out.reshape(-1)[:x.size].reshape(shape)
+
+
+def recursive_hd_all_reduce(x, axis_name: str = "dp"):
+    """Recursive halving-doubling (≙ build_recursive_hd_all_reduce :422):
+    latency-optimal for power-of-two world sizes — 2·log2(n) steps of
+    pairwise exchange at distance 1, 2, 4, ...
+
+    Phase 1: reduce-scatter by halving (exchange the half the PEER keeps,
+    add the received half). Phase 2: all-gather by doubling.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        raise ValueError(f"recursive halving-doubling needs a power-of-2 "
+                         f"world size, got {n}")
+    me = jax.lax.axis_index(axis_name)
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    work = jnp.pad(flat, (0, pad))
+
+    # Reduce-scatter by halving: log n rounds, peer = me ^ dist; the
+    # device whose `dist` bit is 0 keeps the low half, 1 the high half.
+    dists = []
+    dist = n // 2
+    while dist >= 1:
+        peer_perm = [(i, i ^ dist) for i in range(n)]
+        bit = (me // dist) % 2
+        halves = jnp.stack([work[:work.size // 2], work[work.size // 2:]])
+        to_keep = _chunk(halves, bit)
+        to_send = _chunk(halves, 1 - bit)
+        received = jax.lax.ppermute(to_send, axis_name, peer_perm)
+        work = to_keep + received
+        dists.append(dist)
+        dist //= 2
+
+    # All-gather: reverse the rounds, doubling the segment each time.
+    for dist in reversed(dists):
+        peer_perm = [(i, i ^ dist) for i in range(n)]
+        received = jax.lax.ppermute(work, axis_name, peer_perm)
+        bit = (me // dist) % 2
+        # my segment is the `bit` half of the doubled segment
+        work = jnp.where(bit == 0,
+                         jnp.concatenate([work, received]),
+                         jnp.concatenate([received, work]))
+    return work[:flat.size].reshape(shape)
+
+
+def shuffle_all_reduce(x, axis_name: str = "dp"):
+    """Shuffle allreduce (≙ build_shuffle_all_reduce :554): one
+    all-to-all scatters chunk c of every device to device c, each device
+    reduces its chunk fully, one all-gather returns the results. Two
+    steps of n-way traffic — the "shuffle gather" pattern.
+    """
+    n = _axis_size(axis_name)
+    if n == 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)
+    # all_to_all: device d receives chunk d from everyone -> (n, chunk)
+    gathered = jax.lax.all_to_all(chunks, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True)
+    reduced = gathered.reshape(n, -1).sum(axis=0)    # my chunk, full sum
+    # all-gather the reduced chunks back to everyone
+    full = jax.lax.all_gather(reduced, axis_name, axis=0, tiled=True)
+    return full[:x.size].reshape(shape)
+
+
+ALGORITHMS = {
+    "ring": ring_all_reduce,
+    "recursive_hd": recursive_hd_all_reduce,
+    "shuffle": shuffle_all_reduce,
+    "xla": lambda x, axis_name="dp": jax.lax.psum(x, axis_name),
+}
+
+
+def all_reduce(x, axis_name: str = "dp", algorithm: str = "xla"):
+    """Dispatch by algorithm name (≙ the reference's per-algorithm build
+    functions; "xla" = let the compiler choose — the default path)."""
+    try:
+        fn = ALGORITHMS[algorithm]
+    except KeyError:
+        raise ValueError(f"algorithm={algorithm!r}; expected one of "
+                         f"{sorted(ALGORITHMS)}") from None
+    return fn(x, axis_name=axis_name)

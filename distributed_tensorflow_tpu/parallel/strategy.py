@@ -453,13 +453,17 @@ class Strategy:
 
         def mesh_value(v):
             """Mesh-placed values pass through; values pinned elsewhere
-            (AggregatingVariable home devices — central storage) are read
-            to host first: the PS read, re-placed by jit per in_specs."""
+            (AggregatingVariable home devices — central storage) are
+            re-placed onto the mesh (the PS read — an async device copy,
+            not a blocking host round-trip)."""
             val = _orig_value(v)
             sh = getattr(val, "sharding", None)
             if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
                 return val
-            return np.asarray(val)
+            try:
+                return jax.device_put(val, NamedSharding(self.mesh, v.spec))
+            except Exception:
+                return np.asarray(val)     # cross-backend fallback
 
         var_vals = [mesh_value(v) for v in variables]
         var_specs = [v.spec for v in variables]

@@ -113,11 +113,16 @@ class InfeedLoop:
 
     def next(self, timeout: float = 60.0):
         with self._cv:
-            self._cv.wait_for(
+            ready = self._cv.wait_for(
                 lambda: self._buf or self._done or self._err, timeout)
             if self._err is not None:
                 raise self._err
             if not self._buf:
+                if not ready:
+                    # producer still alive but slow: NOT end-of-data
+                    raise TimeoutError(
+                        f"infeed produced nothing in {timeout}s "
+                        f"(source iterator or device staging stalled)")
                 raise StopIteration
             batch = self._buf.popleft()
             self._cv.notify_all()

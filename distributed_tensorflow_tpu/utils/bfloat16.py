@@ -34,26 +34,36 @@ POLICIES = {
     "bfloat16": Policy("bfloat16", jnp.bfloat16, jnp.bfloat16),
 }
 
-_STATE = threading.local()
+# Global default (set_global_policy: visible to ALL threads — worker
+# lanes, infeed threads) + a thread-local scope stack for `with` blocks.
+_GLOBAL = {"policy": POLICIES["float32"]}
+_SCOPES = threading.local()
+
+
+def _scope_stack() -> list:
+    if not hasattr(_SCOPES, "stack"):
+        _SCOPES.stack = []
+    return _SCOPES.stack
 
 
 def get_policy() -> Policy:
-    return getattr(_STATE, "policy", POLICIES["float32"])
+    stack = _scope_stack()
+    return stack[-1] if stack else _GLOBAL["policy"]
 
 
 def set_global_policy(policy: "Policy | str"):
-    _STATE.policy = (POLICIES[policy] if isinstance(policy, str)
-                     else policy)
+    _GLOBAL["policy"] = (POLICIES[policy] if isinstance(policy, str)
+                         else policy)
 
 
 @contextlib.contextmanager
 def policy_scope(policy: "Policy | str"):
-    prev = get_policy()
-    set_global_policy(policy)
+    p = POLICIES[policy] if isinstance(policy, str) else policy
+    _scope_stack().append(p)
     try:
-        yield get_policy()
+        yield p
     finally:
-        _STATE.policy = prev
+        _scope_stack().pop()
 
 
 @contextlib.contextmanager

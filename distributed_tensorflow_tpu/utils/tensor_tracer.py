@@ -34,8 +34,7 @@ _TRACE_MODES = ("norm", "max-abs", "nan-count", "summary")
 def _stats(x) -> dict:
     """The per-tensor statistic bundle (≙ trace_mode=summary)."""
     x = jnp.asarray(x)
-    xf = x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.floating) \
-        else x.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
     return {
         "norm": jnp.linalg.norm(xf.ravel()),
         "max": jnp.max(xf) if x.size else jnp.float32(0),
@@ -46,10 +45,14 @@ def _stats(x) -> dict:
     }
 
 
-class _Collector(threading.local):
+class _Collector:
+    """Process-global (NOT thread-local: debug callbacks may run on
+    runtime threads, not the thread that entered the tracer)."""
+
     def __init__(self):
         self.events: list[tuple[str, dict]] = []
         self.active = False
+        self.lock = threading.Lock()
 
 
 _COLLECTOR = _Collector()
@@ -69,10 +72,11 @@ def trace_point(name: str, x, *, enabled: bool | None = None):
     def record(**host_stats):
         # instrumentation is baked at TRACE time; collection is gated at
         # CALL time (a compiled fn may outlive the tracer context)
-        if _COLLECTOR.active:
-            _COLLECTOR.events.append(
-                (name,
-                 {k: np.asarray(v).item() for k, v in host_stats.items()}))
+        with _COLLECTOR.lock:
+            if _COLLECTOR.active:
+                _COLLECTOR.events.append(
+                    (name, {k: np.asarray(v).item()
+                            for k, v in host_stats.items()}))
 
     jax.debug.callback(record, **stats)
     return x
@@ -111,18 +115,24 @@ class TensorTracer:
     """
 
     def __enter__(self):
-        _COLLECTOR.events = []
-        _COLLECTOR.active = True
+        with _COLLECTOR.lock:
+            _COLLECTOR.events = []
+            _COLLECTOR.active = True
         return self
 
     def __exit__(self, *exc):
-        _COLLECTOR.active = False
+        # async dispatch: callbacks may still be in flight — drain them
+        # BEFORE deactivating or they'd be silently dropped
+        jax.effects_barrier()
+        with _COLLECTOR.lock:
+            _COLLECTOR.active = False
         return False
 
     def report(self) -> TraceReport:
         # callbacks are async: drain outstanding work first
         jax.effects_barrier()
-        return TraceReport(list(_COLLECTOR.events))
+        with _COLLECTOR.lock:
+            return TraceReport(list(_COLLECTOR.events))
 
 
 def trace_flax(module, variables, *args, mutable=False,

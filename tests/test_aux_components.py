@@ -293,3 +293,23 @@ def test_strategy_gauge_set_by_scope(devices):
     with s.scope():
         pass
     assert strategy_gauge.value() == "MirroredStrategy"
+
+
+# -- legacy distribute coordinator (≙ distribute_coordinator.py:627) -------
+
+def test_run_distribute_coordinator_standalone(devices):
+    from distributed_tensorflow_tpu.coordinator.distribute_coordinator \
+        import CoordinatorMode, run_distribute_coordinator
+
+    def worker_fn(ctx):
+        assert ctx.is_chief
+        assert not ctx.distributed_mode
+        assert dtx.get_strategy() is ctx.strategy
+        v = ctx.strategy.create_variable(np.zeros(()), name="c")
+        ctx.strategy.run(lambda: v.assign_add(1.0))
+        return float(np.asarray(v.read_value()))
+
+    out = run_distribute_coordinator(
+        worker_fn, dtx.MirroredStrategy(),
+        mode=CoordinatorMode.STANDALONE_CLIENT)
+    assert out == 1.0

@@ -171,3 +171,29 @@ def test_metrics():
         time.sleep(0.01)
     assert t.count == 1
     assert t.total_seconds > 0.005
+
+
+def test_per_worker_closures_run_in_fifo_order():
+    """Cross-program collective-ordering guarantee for the PS path
+    (≙ SURVEY §5.2: the reference rebuilds collective launch order with
+    CollectiveKeys; here per-worker FIFO dispatch IS the order): closures
+    bound to one worker lane execute strictly in schedule order."""
+    from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
+        ClusterCoordinator)
+    import threading
+    order = []
+    lock = threading.Lock()
+    coord = ClusterCoordinator(num_workers=1)   # one lane -> FIFO
+
+    def make(i):
+        def fn():
+            with lock:
+                order.append(i)
+            return i
+        return fn
+
+    rvs = [coord.schedule(make(i)) for i in range(20)]
+    coord.join()
+    assert coord.fetch(rvs) == list(range(20))
+    assert order == list(range(20))
+    coord.shutdown()
