@@ -59,6 +59,20 @@ def _load():
         lib.dtx_pipeline_num_records.argtypes = [ctypes.c_void_p]
         lib.dtx_pipeline_batches_per_epoch.restype = ctypes.c_int64
         lib.dtx_pipeline_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.dtx_tfrecord_create.restype = ctypes.c_void_p
+        lib.dtx_tfrecord_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        lib.dtx_pipeline_row_bytes.restype = ctypes.c_int64
+        lib.dtx_pipeline_row_bytes.argtypes = [ctypes.c_void_p]
+        lib.dtx_pipeline_failed.restype = ctypes.c_int
+        lib.dtx_pipeline_failed.argtypes = [ctypes.c_void_p]
+        lib.dtx_pipeline_next2.restype = ctypes.c_void_p
+        lib.dtx_pipeline_next2.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return lib
 
@@ -69,39 +83,35 @@ def write_records(path: str, array: np.ndarray) -> None:
         f.write(np.ascontiguousarray(array).tobytes())
 
 
-class NativeRecordDataset:
-    """Iterator of (batch_array, epoch) with native prefetch.
+def write_tfrecords(path: str, payloads) -> None:
+    """Write byte payloads in TFRecord framing (length + masked crc32c),
+    readable by :class:`NativeTFRecordDataset` and by TensorFlow."""
+    from distributed_tensorflow_tpu.utils.summary import tfrecord_frame
+    with open(path, "wb") as f:
+        for p in payloads:
+            f.write(tfrecord_frame(bytes(p)))
 
-    record_dtype/record_shape describe ONE record; batches come back as
-    (batch, *record_shape) arrays. ``num_shards``/``shard_index`` select
-    this host's partition (≙ DATA auto-sharding).
-    """
 
-    def __init__(self, paths, record_dtype, record_shape, batch_size: int,
-                 *, shuffle: bool = True, seed: int = 0,
-                 num_threads: int = 4, queue_depth: int = 8,
-                 num_shards: int = 1, shard_index: int = 0,
-                 drop_remainder: bool = True):
+class _NativePipelineBase:
+    """Shared lifecycle for the native pipeline handles: path
+    normalization, existence checks, counters, iteration protocol,
+    close/__del__ and failure propagation (dtx_pipeline_failed)."""
+
+    def _open(self, paths, create_fn):
         if isinstance(paths, (str, os.PathLike)):
             paths = [paths]
         self._paths = [os.fspath(p) for p in paths]
-        self.record_dtype = np.dtype(record_dtype)
-        self.record_shape = tuple(record_shape)
-        self.record_bytes = (self.record_dtype.itemsize
-                             * int(np.prod(self.record_shape or (1,))))
-        self.batch_size = batch_size
-        lib = _load()
+        missing = [p for p in self._paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(f"no such record file(s): {missing}")
+        self._lib = _load()
         arr = (ctypes.c_char_p * len(self._paths))(
             *[p.encode() for p in self._paths])
-        self._h = lib.dtx_pipeline_create(
-            arr, len(self._paths), self.record_bytes, batch_size,
-            int(shuffle), seed, num_threads, queue_depth, num_shards,
-            shard_index, int(drop_remainder))
+        self._h = create_fn(self._lib, arr, len(self._paths))
         if not self._h:
-            raise FileNotFoundError(
-                f"native pipeline failed to open {self._paths} "
-                f"(empty shard or missing file)")
-        self._lib = lib
+            raise ValueError(
+                f"native pipeline rejected {self._paths} (empty shard, "
+                f"shard smaller than a batch, or corrupt framing)")
 
     @property
     def num_records(self) -> int:
@@ -111,25 +121,13 @@ class NativeRecordDataset:
     def batches_per_epoch(self) -> int:
         return self._lib.dtx_pipeline_batches_per_epoch(self._h)
 
-    def next_batch(self):
-        """Blocking: returns (array, epoch). The array is a COPY (the
-        native buffer is recycled immediately)."""
-        data = ctypes.POINTER(ctypes.c_uint8)()
-        n = ctypes.c_int64()
-        epoch = ctypes.c_int64()
-        bh = self._lib.dtx_pipeline_next(
-            self._h, ctypes.byref(data), ctypes.byref(n),
-            ctypes.byref(epoch))
-        if not bh:
-            raise StopIteration
-        try:
-            nbytes = int(n.value) * self.record_bytes
-            flat = np.ctypeslib.as_array(data, shape=(nbytes,))
-            out = flat.view(self.record_dtype).reshape(
-                (int(n.value),) + self.record_shape).copy()
-        finally:
-            self._lib.dtx_pipeline_return(self._h, bh)
-        return out, int(epoch.value)
+    def _check_stream_end(self):
+        """nullptr from Next: distinguish data failure from shutdown."""
+        if self._lib.dtx_pipeline_failed(self._h):
+            raise ValueError(
+                f"native pipeline failed mid-stream on {self._paths} "
+                f"(IO error or crc mismatch)")
+        raise StopIteration
 
     def __iter__(self):
         return self
@@ -147,3 +145,101 @@ class NativeRecordDataset:
             self.close()
         except Exception:
             pass
+
+
+class NativeRecordDataset(_NativePipelineBase):
+    """Iterator of (batch_array, epoch) with native prefetch.
+
+    record_dtype/record_shape describe ONE record; batches come back as
+    (batch, *record_shape) arrays. ``num_shards``/``shard_index`` select
+    this host's partition (≙ DATA auto-sharding).
+    """
+
+    def __init__(self, paths, record_dtype, record_shape, batch_size: int,
+                 *, shuffle: bool = True, seed: int = 0,
+                 num_threads: int = 4, queue_depth: int = 8,
+                 num_shards: int = 1, shard_index: int = 0,
+                 drop_remainder: bool = True):
+        self.record_dtype = np.dtype(record_dtype)
+        self.record_shape = tuple(record_shape)
+        self.record_bytes = (self.record_dtype.itemsize
+                             * int(np.prod(self.record_shape or (1,))))
+        self.batch_size = batch_size
+        self._open(paths, lambda lib, arr, n: lib.dtx_pipeline_create(
+            arr, n, self.record_bytes, batch_size, int(shuffle), seed,
+            num_threads, queue_depth, num_shards, shard_index,
+            int(drop_remainder)))
+
+    def next_batch(self):
+        """Blocking: returns (array, epoch). The array is a COPY (the
+        native buffer is recycled immediately)."""
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        epoch = ctypes.c_int64()
+        bh = self._lib.dtx_pipeline_next(
+            self._h, ctypes.byref(data), ctypes.byref(n),
+            ctypes.byref(epoch))
+        if not bh:
+            self._check_stream_end()
+        try:
+            nbytes = int(n.value) * self.record_bytes
+            flat = np.ctypeslib.as_array(data, shape=(nbytes,))
+            out = flat.view(self.record_dtype).reshape(
+                (int(n.value),) + self.record_shape).copy()
+        finally:
+            self._lib.dtx_pipeline_return(self._h, bh)
+        return out, int(epoch.value)
+
+
+class NativeTFRecordDataset(_NativePipelineBase):
+    """Native TFRecord reader with shuffle/shard/prefetch.
+
+    ≙ the reference's C++ RecordReader + tf.data TFRecordDataset
+    (tensorflow/core/lib/io/record_reader; SURVEY.md §2.7): the framing
+    scan (seek-only, length-bounds-validated), per-epoch shuffle,
+    DATA-policy sharding, and threaded batch assembly all run in native
+    code (native/pipeline.cc TFRecord mode); payload crc32c is verified
+    by the worker threads at read time so dataset bytes are read exactly
+    once. Batches surface as a zero-padded (batch, max_record_bytes)
+    uint8 array plus per-row lengths; ``next_records`` gives the payloads
+    as bytes.
+    """
+
+    def __init__(self, paths, batch_size: int, *, shuffle: bool = True,
+                 seed: int = 0, num_threads: int = 4, queue_depth: int = 8,
+                 num_shards: int = 1, shard_index: int = 0,
+                 drop_remainder: bool = True, verify_crc: bool = True):
+        self.batch_size = batch_size
+        self._open(paths, lambda lib, arr, n: lib.dtx_tfrecord_create(
+            arr, n, batch_size, int(shuffle), seed, num_threads,
+            queue_depth, num_shards, shard_index, int(drop_remainder),
+            int(verify_crc)))
+        self.row_bytes = self._lib.dtx_pipeline_row_bytes(self._h)
+
+    def next_batch(self):
+        """Blocking: returns (padded_uint8_array, lengths, epoch); the
+        arrays are COPIES (native buffers recycle immediately)."""
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        lengths = ctypes.POINTER(ctypes.c_int64)()
+        n = ctypes.c_int64()
+        epoch = ctypes.c_int64()
+        bh = self._lib.dtx_pipeline_next2(
+            self._h, ctypes.byref(data), ctypes.byref(lengths),
+            ctypes.byref(n), ctypes.byref(epoch))
+        if not bh:
+            self._check_stream_end()
+        try:
+            count = int(n.value)
+            flat = np.ctypeslib.as_array(
+                data, shape=(count * self.row_bytes,))
+            rows = flat.reshape(count, self.row_bytes).copy()
+            lens = np.ctypeslib.as_array(lengths, shape=(count,)).copy()
+        finally:
+            self._lib.dtx_pipeline_return(self._h, bh)
+        return rows, lens, int(epoch.value)
+
+    def next_records(self):
+        """Blocking: the next batch as a list of payload ``bytes``."""
+        rows, lens, epoch = self.next_batch()
+        return [rows[i, :lens[i]].tobytes()
+                for i in range(rows.shape[0])], epoch

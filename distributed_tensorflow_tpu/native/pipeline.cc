@@ -37,53 +37,126 @@ namespace {
 
 struct Batch {
   std::vector<uint8_t> data;
+  std::vector<int64_t> lengths;  // per-row payload bytes (TFRecord mode)
   int64_t epoch = -1;
   int64_t batch_index = -1;
 };
 
+// crc32c (Castagnoli, reflected) + the TFRecord mask — for verifying the
+// framing of files we index (≙ tensorflow/core/lib/io/record_reader).
+struct Crc32c {
+  uint32_t table[256];
+  Crc32c() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+  }
+  uint32_t operator()(const uint8_t* p, size_t n) const {
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; ++i) c = table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+  }
+  uint32_t Masked(const uint8_t* p, size_t n) const {
+    uint32_t c = (*this)(p, n);
+    return ((c >> 15) | (c << 17)) + 0xA282EAD8u;
+  }
+};
+
 class Pipeline {
  public:
+  // record_bytes > 0: fixed-size records (row = record_bytes).
+  // record_bytes == 0: TFRecord framing — scan each file's
+  // length/crc/payload/crc structure to index variable-length records;
+  // rows are padded to the longest payload and per-row lengths reported.
   Pipeline(const char** paths, int num_paths, int64_t record_bytes,
            int64_t batch_size, int shuffle, uint64_t seed, int num_threads,
            int64_t queue_depth, int64_t num_shards, int64_t shard_index,
-           int drop_remainder)
+           int drop_remainder, int verify_crc = 0)
       : record_bytes_(record_bytes),
         batch_size_(batch_size),
         shuffle_(shuffle),
         seed_(seed),
         num_shards_(num_shards < 1 ? 1 : num_shards),
         shard_index_(shard_index),
-        drop_remainder_(drop_remainder) {
+        drop_remainder_(drop_remainder),
+        tfrecord_(record_bytes == 0),
+        verify_crc_(verify_crc) {
+    int64_t max_len = 0;
     for (int i = 0; i < num_paths; ++i) {
       FILE* f = std::fopen(paths[i], "rb");
       if (!f) { ok_ = false; return; }
-      std::fseek(f, 0, SEEK_END);
-      int64_t bytes = std::ftell(f);
+      if (tfrecord_) {
+        if (!ScanTFRecord(f, i, verify_crc, &max_len)) {
+          std::fclose(f);
+          ok_ = false;
+          return;
+        }
+      } else {
+        std::fseek(f, 0, SEEK_END);
+        int64_t bytes = std::ftell(f);
+        int64_t n = bytes / record_bytes_;
+        for (int64_t r = 0; r < n; ++r)
+          index_.push_back({i, r * record_bytes_, record_bytes_});
+      }
       std::fclose(f);
-      int64_t n = bytes / record_bytes_;
-      for (int64_t r = 0; r < n; ++r)
-        index_.push_back({i, r * record_bytes_});
       files_.emplace_back(paths[i]);
     }
+    if (tfrecord_) record_bytes_ = max_len;  // row stride = longest payload
     // Static shard over records (≙ DATA autoshard policy).
     std::vector<Entry> mine;
     for (size_t i = shard_index_; i < index_.size(); i += num_shards_)
       mine.push_back(index_[i]);
     index_.swap(mine);
-    if (index_.empty()) { ok_ = false; return; }
+    if (index_.empty() || record_bytes_ <= 0) { ok_ = false; return; }
 
     int64_t nb = static_cast<int64_t>(index_.size()) / batch_size_;
     if (!drop_remainder_ && index_.size() % batch_size_) ++nb;
+    if (nb == 0) { ok_ = false; return; }  // shard < batch: no SIGFPE
     batches_per_epoch_ = nb;
 
     for (int64_t i = 0; i < queue_depth; ++i) {
       auto* b = new Batch();
       b->data.resize(record_bytes_ * batch_size_);
+      b->lengths.resize(batch_size_);
       free_.push_back(b);
     }
     int64_t nt = num_threads < 1 ? 1 : num_threads;
     for (int64_t t = 0; t < nt; ++t)
       workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  // TFRecord framing: u64le length, u32le masked-crc(length), payload,
+  // u32le masked-crc(payload). The scan is seek-only (headers validated,
+  // lengths bounds-checked against the file size — a corrupt length
+  // cannot index past EOF, OOM the row stride, or wrap negative);
+  // payload CRCs are verified by the WORKERS at read time, so dataset
+  // bytes are read exactly once and startup never reads the data.
+  bool ScanTFRecord(FILE* f, int file_idx, int verify_crc,
+                    int64_t* max_len) {
+    static const Crc32c crc;
+    std::fseek(f, 0, SEEK_END);
+    const int64_t fsize = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    uint8_t header[12];
+    for (;;) {
+      size_t got = std::fread(header, 1, 12, f);
+      if (got == 0) return true;          // clean EOF
+      if (got != 12) return false;        // truncated header
+      uint64_t len;
+      uint32_t len_crc;
+      std::memcpy(&len, header, 8);
+      std::memcpy(&len_crc, header + 8, 4);
+      if (verify_crc && crc.Masked(header, 8) != len_crc) return false;
+      int64_t payload_off = std::ftell(f);
+      int64_t slen = static_cast<int64_t>(len);
+      if (slen < 0 || payload_off + slen + 4 > fsize) return false;
+      if (std::fseek(f, slen + 4, SEEK_CUR) != 0) return false;
+      index_.push_back({file_idx, payload_off, slen});
+      if (slen > *max_len) *max_len = slen;
+    }
   }
 
   ~Pipeline() {
@@ -100,8 +173,10 @@ class Pipeline {
   }
 
   bool ok() const { return ok_; }
+  bool failed() const { return failed_; }
   int64_t num_records() const { return static_cast<int64_t>(index_.size()); }
   int64_t batches_per_epoch() const { return batches_per_epoch_; }
+  int64_t row_bytes() const { return record_bytes_; }
 
   // Blocks until the batch with the next sequential batch_index is ready;
   // returns its buffer (caller must Return() it). Delivering strictly in
@@ -135,7 +210,7 @@ class Pipeline {
   }
 
  private:
-  struct Entry { int file; int64_t offset; };
+  struct Entry { int file; int64_t offset; int64_t length; };
 
   void WorkerLoop() {
     // Each worker owns a FILE* per input file (no seek contention).
@@ -164,12 +239,34 @@ class Pipeline {
         for (int64_t i = 0; i < count; ++i)
           picks[i] = index_[epoch_order_[start + i]];
       }
+      static const Crc32c crc;
+      bool bad = false;
       for (int64_t i = 0; i < count; ++i) {
         FILE* f = fps[picks[i].file];
         std::fseek(f, picks[i].offset, SEEK_SET);
-        size_t got = std::fread(buf->data.data() + i * record_bytes_, 1,
-                                record_bytes_, f);
-        (void)got;
+        uint8_t* row = buf->data.data() + i * record_bytes_;
+        size_t got = std::fread(row, 1, picks[i].length, f);
+        if (static_cast<int64_t>(got) != picks[i].length) { bad = true; }
+        if (tfrecord_ && verify_crc_ && !bad) {
+          // payload crc sits right after the payload; data's in hand —
+          // verify here so dataset bytes are read exactly once
+          uint32_t data_crc;
+          if (std::fread(&data_crc, 1, 4, f) != 4 ||
+              crc.Masked(row, picks[i].length) != data_crc)
+            bad = true;
+        }
+        if (picks[i].length < record_bytes_)
+          std::memset(row + picks[i].length, 0,
+                      record_bytes_ - picks[i].length);
+        buf->lengths[i] = picks[i].length;
+      }
+      if (bad) {
+        std::lock_guard<std::mutex> lk(mu_);
+        failed_ = true;
+        stop_ = true;
+        cv_ready_.notify_all();
+        cv_free_.notify_all();
+        break;
       }
       {
         std::lock_guard<std::mutex> lk(mu_);
@@ -206,6 +303,8 @@ class Pipeline {
   int64_t shuffled_epoch_ = -1;
 
   int64_t record_bytes_, batch_size_;
+  bool tfrecord_ = false;
+  int verify_crc_ = 0;
   int shuffle_;
   uint64_t seed_;
   int64_t num_shards_, shard_index_;
@@ -222,6 +321,7 @@ class Pipeline {
   int64_t next_batch_ = 0;
   int64_t next_deliver_ = 0;
   bool stop_ = false;
+  std::atomic<bool> failed_{false};   // IO error / crc mismatch mid-read
 
   std::vector<std::thread> workers_;
 };
@@ -264,5 +364,41 @@ void dtx_pipeline_return(void* h, void* batch) {
 }
 
 void dtx_pipeline_destroy(void* h) { delete static_cast<Pipeline*>(h); }
+
+// -- TFRecord mode (variable-length framed records) -------------------------
+
+void* dtx_tfrecord_create(const char** paths, int num_paths,
+                          int64_t batch_size, int shuffle, uint64_t seed,
+                          int num_threads, int64_t queue_depth,
+                          int64_t num_shards, int64_t shard_index,
+                          int drop_remainder, int verify_crc) {
+  auto* p = new Pipeline(paths, num_paths, /*record_bytes=*/0, batch_size,
+                         shuffle, seed, num_threads, queue_depth,
+                         num_shards, shard_index, drop_remainder,
+                         verify_crc);
+  if (!p->ok()) { delete p; return nullptr; }
+  return p;
+}
+
+int64_t dtx_pipeline_row_bytes(void* h) {
+  return static_cast<Pipeline*>(h)->row_bytes();
+}
+
+// 1 if a worker hit an IO error or crc mismatch (the stream stopped
+// because the DATA is bad, not because it ended).
+int dtx_pipeline_failed(void* h) {
+  return static_cast<Pipeline*>(h)->failed() ? 1 : 0;
+}
+
+// Like dtx_pipeline_next but also exposes the per-row payload lengths
+// (rows are zero-padded to row_bytes).
+void* dtx_pipeline_next2(void* h, uint8_t** data, int64_t** lengths,
+                         int64_t* n_records, int64_t* epoch) {
+  Batch* b = static_cast<Pipeline*>(h)->Next(n_records, epoch);
+  if (!b) return nullptr;
+  *data = b->data.data();
+  *lengths = b->lengths.data();
+  return b;
+}
 
 }  // extern "C"

@@ -107,10 +107,16 @@ def _masked_crc(data: bytes) -> int:
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
 
 
-def _tfrecord(payload: bytes) -> bytes:
+def tfrecord_frame(payload: bytes) -> bytes:
+    """Frame one payload in TFRecord format (length + masked crc32c +
+    payload + masked crc32c). Public: also used by the native input
+    layer's TFRecord writer (input/native_loader.write_tfrecords)."""
     header = struct.pack("<Q", len(payload))
     return (header + struct.pack("<I", _masked_crc(header))
             + payload + struct.pack("<I", _masked_crc(payload)))
+
+
+_tfrecord = tfrecord_frame   # internal alias
 
 
 # ---------------------------------------------------------------------------
