@@ -248,8 +248,10 @@ def remat_policy_for(cfg: TransformerConfig):
         # backward never recomputes the flash kernel forward.
         "attn": jax.checkpoint_policies.save_only_these_names("attn_out"),
         # Save matmul outputs AND attention outputs: backward recomputes
-        # neither the projections nor the flash kernel — the fastest
-        # policy that still fits the v5e at moderate batch.
+        # neither. Measured SLOWER than "dots" on v5e at this model size
+        # (saving attention outputs costs more bandwidth than the
+        # full-sequence-block kernel recompute); kept for configs where
+        # the kernel recompute dominates (longer sequences, small tiles).
         "dots_attn": jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_out")),

@@ -158,9 +158,12 @@ def _pad_seq(x, multiple):
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
 
 
-def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                   causal_offset=None):
     batch, heads, q_len, d = q.shape
     k_len = k.shape[2]
+    if causal_offset is None:
+        causal_offset = k_len - q_len
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
     bh = batch * heads
@@ -175,7 +178,7 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          kv_len=k_len, causal_offset=k_len - q_len),
+                          kv_len=k_len, causal_offset=causal_offset),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -300,10 +303,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k, interpret):
+def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k,
+                    interpret, causal_offset=None):
     q, k, v, out, lse = res
     batch, heads, q_len, d = q.shape
     k_len = k.shape[2]
+    if causal_offset is None:
+        causal_offset = k_len - q_len
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
     bh = batch * heads
@@ -328,7 +334,7 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k, interpret):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          kv_len=k_len, causal_offset=k_len - q_len),
+                          kv_len=k_len, causal_offset=causal_offset),
         grid=(bh, nq, nk),
         in_specs=[
             q_spec,
@@ -349,7 +355,7 @@ def _flash_backward(res, g, *, sm_scale, causal, block_q, block_k, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          q_len=q_len, causal_offset=k_len - q_len),
+                          q_len=q_len, causal_offset=causal_offset),
         grid=(bh, nk, nq),
         in_specs=[qj_spec, k_spec, k_spec, qj_spec, rowj_spec, rowj_spec],
         out_specs=[k_spec, k_spec],

@@ -13,8 +13,11 @@ capability gap the TPU-native framework fills as a first-class feature:
   `ring_flash_attention` (the TPU default) runs the Pallas flash kernel
   per block and ``lax.cond``-skips fully-masked causal future blocks
   outright; `ring_attention` is the unfused reference-math form kept for
-  CPU CI and numerics cross-checks. (Wall-clock is set by the last rank
-  either way — a load-balanced "zigzag" chunk layout is future work.)
+  CPU CI and numerics cross-checks. For causal workloads,
+  `striped_flash_attention` (impl="striped") distributes tokens
+  round-robin so every step is triangular on every rank — the
+  load-balanced schedule whose critical path is ~n/2 block-equivalents
+  instead of the contiguous layout's n on the last rank.
 
 - **Ulysses** (`ulysses_attention`): all-to-all re-shard — heads gather
   the full sequence, attention runs locally per head subset, then
@@ -278,6 +281,150 @@ def ring_flash_attention(q, k, v, *, axis_name: str = "sp",
                        block_k, interpret)
 
 
+# ---------------------------------------------------------------------------
+# Striped (load-balanced) ring attention: tokens are distributed
+# round-robin (token t on device t mod n), so EVERY ring step is a
+# near-triangular block on EVERY device — the causal work is balanced,
+# unlike contiguous chunks where rank r does r+1 full blocks and the
+# last rank sets the wall-clock (Striped Attention, Brandon et al.).
+# ---------------------------------------------------------------------------
+
+def stripe_layout(x, n: int, axis: int = 2):
+    """Contiguous layout -> striped: row j*n + r moves to stripe r slot j
+    (device r's local row j holds global position j*n + r). A global op:
+    under a sequence-sharded jit, GSPMD lowers it to an all-to-all."""
+    s = x.shape[axis]
+    if s % n:
+        raise ValueError(f"seq {s} not divisible by stripes {n}")
+    shape = x.shape[:axis] + (s // n, n) + x.shape[axis + 1:]
+    perm = list(range(len(shape)))
+    perm[axis], perm[axis + 1] = perm[axis + 1], perm[axis]
+    return x.reshape(shape).transpose(perm).reshape(x.shape)
+
+
+def unstripe_layout(x, n: int, axis: int = 2):
+    """Inverse of :func:`stripe_layout`."""
+    s = x.shape[axis]
+    shape = x.shape[:axis] + (n, s // n) + x.shape[axis + 1:]
+    perm = list(range(len(shape)))
+    perm[axis], perm[axis + 1] = perm[axis + 1], perm[axis]
+    return x.reshape(shape).transpose(perm).reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _striped_flash(q, k, v, axis_name, sm_scale, block_q, block_k,
+                   interpret):
+    out, _ = _striped_fwd_impl(q, k, v, axis_name, sm_scale, block_q,
+                               block_k, interpret)
+    return out
+
+
+# Causal-mask derivation for stripes: local row j has global position
+# j*n + me, a visiting row i has i*n + src, so q >= k  <=>
+# j >= i + (src > me) — i.e. kernel causal_offset 0 (src <= me) or
+# -1 (src > me, strict). The cond predicate below is exactly `src > me`.
+
+
+def _striped_fwd_impl(q, k, v, axis_name, sm_scale, block_q, block_k,
+                      interpret):
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block(kv, strict):
+        # strict (src > me): local row j may attend visiting row i only
+        # for j > i (kernel causal_offset -1); else the inclusive
+        # diagonal (offset 0) — see _striped_offsets' derivation
+        return jax.lax.cond(
+            strict,
+            lambda ops: _attn._flash_forward(
+                q, ops[0], ops[1], sm_scale, True, block_q, block_k,
+                interpret, causal_offset=-1),
+            lambda ops: _attn._flash_forward(
+                q, ops[0], ops[1], sm_scale, True, block_q, block_k,
+                interpret, causal_offset=0),
+            kv)
+
+    k_cur, v_cur = k, v
+    o_acc = lse_acc = None
+    for step in range(n):
+        src = (me - step) % n
+        o_b, lse_b = block((k_cur, v_cur), src > me)
+        if step == 0:
+            o_acc, lse_acc = o_b.astype(jnp.float32), lse_b
+        else:
+            o_acc, lse_acc = _combine_stats(o_acc, lse_acc, o_b, lse_b)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _striped_fwd(q, k, v, axis_name, sm_scale, block_q, block_k,
+                 interpret):
+    out, lse = _striped_fwd_impl(q, k, v, axis_name, sm_scale, block_q,
+                                 block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _striped_bwd(axis_name, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_bwd(ops, strict):
+        return jax.lax.cond(
+            strict,
+            lambda o: _attn._flash_backward(
+                (q, o[0], o[1], out, lse), g, sm_scale=sm_scale,
+                causal=True, block_q=block_q, block_k=block_k,
+                interpret=interpret, causal_offset=-1),
+            lambda o: _attn._flash_backward(
+                (q, o[0], o[1], out, lse), g, sm_scale=sm_scale,
+                causal=True, block_q=block_q, block_k=block_k,
+                interpret=interpret, causal_offset=0),
+            ops)
+
+    k_cur, v_cur = k, v
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    for step in range(n):
+        src = (me - step) % n
+        dqb, dkb, dvb = block_bwd((k_cur, v_cur), src > me)
+        dq = dq + dqb.astype(jnp.float32)
+        dk_acc = dk_acc + dkb.astype(jnp.float32)
+        dv_acc = dv_acc + dvb.astype(jnp.float32)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_striped_flash.defvjp(_striped_fwd, _striped_bwd)
+
+
+def striped_flash_attention(q, k, v, *, axis_name: str = "sp",
+                            sm_scale: float | None = None,
+                            block_q: int = 512, block_k: int = 1024,
+                            interpret: bool = False):
+    """Striped causal ring attention (shard_map region fn): inputs must
+    be in STRIPE layout (:func:`stripe_layout` — device r holds global
+    positions r, r+n, r+2n, ...). Every step is a triangular block on
+    every device, so the ring's critical path is ~n/2 block-equivalents
+    instead of the contiguous schedule's n on the last rank."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _striped_flash(q, k, v, axis_name, sm_scale, block_q, block_k,
+                          interpret)
+
+
 def ulysses_attention(q, k, v, *, axis_name: str = "sp",
                       causal: bool = False,
                       sm_scale: float | None = None,
@@ -346,6 +493,33 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
     attn_impl = _resolve_attn_impl(attn_impl)
     if spec is None:
         spec = P(None, None, axis_name, None)
+
+    if impl == "striped":
+        if not causal:
+            raise ValueError("striped attention is a causal schedule; "
+                             "use impl='ring' for bidirectional")
+        if attn_impl == "unfused":
+            raise ValueError(
+                "striped attention is built on the flash kernel; pass "
+                "attn_impl='flash' (TPU) or 'interpret' (CPU CI), or use "
+                "impl='ring' for the unfused path")
+        n = mesh.shape[axis_name]
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec,
+                           check_rep=False)
+        def region(q, k, v):
+            return striped_flash_attention(
+                q, k, v, axis_name=axis_name, block_q=block_q,
+                block_k=block_k, interpret=attn_impl == "interpret")
+
+        def striped_global(q, k, v):
+            # relayout to stripes (an all-to-all over sp under GSPMD),
+            # run the balanced ring, restore the contiguous layout
+            qs, ks, vs = (stripe_layout(t, n) for t in (q, k, v))
+            return unstripe_layout(region(qs, ks, vs), n)
+
+        return striped_global
 
     if impl == "ring":
         if attn_impl in ("flash", "interpret"):
