@@ -85,7 +85,7 @@ class TransformerConfig:
     # make_sharded_train_step) has an "sp" axis > 1, attention runs as
     # ring attention over it (parallel/sequence_parallel.py).
     mesh: Any = None
-    sp_impl: str = "ring"             # "ring" | "ulysses"
+    sp_impl: str = "ring"        # "ring" | "ulysses" | "striped" (causal)
     # per-step attention inside SP: "flash" | "unfused" | "interpret";
     # None = auto (flash on TPU — sequence_parallel._resolve_attn_impl)
     sp_attn_impl: str | None = None

@@ -138,7 +138,13 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
 def _combine_stats(o_acc, lse_acc, o_b, lse_b):
     """Merge one block's (normalized out, lse) into the accumulators —
     the cross-block online-softmax recombination: given per-block
-    normalized outputs, o = Σ o_b·exp(lse_b − lse_tot)."""
+    normalized outputs, o = Σ o_b·exp(lse_b − lse_tot).
+
+    The flash kernel stores lse = +inf as its EMPTY-row sentinel (a q row
+    whose every k was masked, e.g. strict steps at tiny local seq); an
+    empty row contributes nothing, which is exactly lse = -inf here."""
+    lse_acc = jnp.where(jnp.isposinf(lse_acc), -jnp.inf, lse_acc)
+    lse_b = jnp.where(jnp.isposinf(lse_b), -jnp.inf, lse_b)
     lse_new = jnp.logaddexp(lse_acc, lse_b)
     alpha = jnp.where(jnp.isneginf(lse_acc), 0.0,
                       jnp.exp(lse_acc - jnp.where(jnp.isneginf(lse_new),
@@ -151,6 +157,62 @@ def _combine_stats(o_acc, lse_acc, o_b, lse_b):
     return o_new, lse_new
 
 
+# Shared ring machinery: the contiguous and striped schedules differ
+# ONLY in their per-step block functions; the rotation loops, the
+# online-softmax accumulation, and the rotating dk/dv gradient
+# accumulators (which land each chunk's gradient home after a full
+# circuit) are identical and live here once.
+
+def _ring_fwd_loop(q, k, v, axis_name, step_block):
+    """step_block(step, src, me, (k, v)) -> (o_block, lse_block)."""
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = k, v
+    o_acc = lse_acc = None
+    for step in range(n):
+        src = (me - step) % n
+        o_b, lse_b = step_block(step, src, me, (k_cur, v_cur))
+        if step == 0:
+            o_acc = o_b.astype(jnp.float32)
+            lse_acc = jnp.where(jnp.isposinf(lse_b), -jnp.inf, lse_b)
+        else:
+            o_acc, lse_acc = _combine_stats(o_acc, lse_acc, o_b, lse_b)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_bwd_loop(q, k, v, axis_name, step_block_bwd):
+    """step_block_bwd(step, src, me, (k, v)) -> (dq, dk, dv) per block;
+    dk/dv accumulators rotate alongside their chunks, plus one final hop
+    home (the chunk visiting device d at the last step belongs to
+    d+1)."""
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = k, v
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    for step in range(n):
+        src = (me - step) % n
+        dqb, dkb, dvb = step_block_bwd(step, src, me, (k_cur, v_cur))
+        dq = dq + dqb.astype(jnp.float32)
+        dk_acc = dk_acc + dkb.astype(jnp.float32)
+        dv_acc = dv_acc + dvb.astype(jnp.float32)
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _ring_flash(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
                 interpret):
@@ -159,46 +221,37 @@ def _ring_flash(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
     return out
 
 
-def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
-                         block_k, interpret):
-    n = jax.lax.psum(1, axis_name)
-    me = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+def _ring_step_fwd(q, sm_scale, causal, block_q, block_k, interpret):
+    """Contiguous-schedule per-step forward: diagonal causal at step 0,
+    full blocks for past chunks, skipped kernels for future chunks."""
     b, h, s, d = q.shape
 
     def block(kv, block_causal):
-        kk, vv = kv
-        return _attn._flash_forward(q, kk, vv, sm_scale, block_causal,
-                                    block_q, block_k, interpret)
+        return _attn._flash_forward(q, kv[0], kv[1], sm_scale,
+                                    block_causal, block_q, block_k,
+                                    interpret)
 
     def skip(kv):
         return (jnp.zeros((b, h, s, d), q.dtype),
                 jnp.full((b, h, s), -jnp.inf, jnp.float32))
 
-    k_cur, v_cur = k, v
-    o_acc = None
-    for step in range(n):
+    def step_block(step, src, me, kv):
         if step == 0:
-            # my own chunk: causal diagonal block
-            o_b, lse_b = block((k_cur, v_cur), causal)
-            o_acc = o_b.astype(jnp.float32)
-            lse_acc = lse_b
-        else:
-            src = (me - step) % n
-            if causal:
-                # past chunks (src < me) are FULL blocks; future chunks
-                # are fully masked — skip the kernel entirely (the
-                # causal work-skipping the ring schedule allows)
-                o_b, lse_b = jax.lax.cond(
-                    src < me, lambda kv: block(kv, False), skip,
-                    (k_cur, v_cur))
-            else:
-                o_b, lse_b = block((k_cur, v_cur), False)
-            o_acc, lse_acc = _combine_stats(o_acc, lse_acc, o_b, lse_b)
-        if step != n - 1:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-    return o_acc.astype(q.dtype), lse_acc
+            return block(kv, causal)     # my own chunk: causal diagonal
+        if not causal:
+            return block(kv, False)
+        # past chunks (src < me) are FULL blocks; future chunks are
+        # fully masked — skip the kernel entirely
+        return jax.lax.cond(src < me, lambda o: block(o, False), skip, kv)
+
+    return step_block
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_q,
+                         block_k, interpret):
+    return _ring_fwd_loop(
+        q, k, v, axis_name,
+        _ring_step_fwd(q, sm_scale, causal, block_q, block_k, interpret))
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
@@ -211,52 +264,27 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, sm_scale, block_q, block_k,
 def _ring_flash_bwd(axis_name, causal, sm_scale, block_q, block_k,
                     interpret, res, g):
     """Ring backward: per-block flash backward against the GLOBAL lse
-    (p = exp(s − lse_global) is exact), with dk/dv accumulators that
-    rotate alongside their k/v chunks so each chunk's gradient arrives
-    home after a full circuit."""
+    (p = exp(s − lse_global) is exact)."""
     q, k, v, out, lse = res
-    n = jax.lax.psum(1, axis_name)
-    me = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def block_bwd(ops, block_causal):
-        kk, vv = ops
         return _attn._flash_backward(
-            (q, kk, vv, out, lse), g, sm_scale=sm_scale,
+            (q, ops[0], ops[1], out, lse), g, sm_scale=sm_scale,
             causal=block_causal, block_q=block_q, block_k=block_k,
             interpret=interpret)
 
     def skip(ops):
         return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
 
-    k_cur, v_cur = k, v
-    dq = jnp.zeros(q.shape, jnp.float32)
-    dk_acc = jnp.zeros(k.shape, jnp.float32)
-    dv_acc = jnp.zeros(v.shape, jnp.float32)
-    for step in range(n):
+    def step_block(step, src, me, kv):
         if step == 0:
-            dqb, dkb, dvb = block_bwd((k_cur, v_cur), causal)
-        else:
-            src = (me - step) % n
-            if causal:
-                dqb, dkb, dvb = jax.lax.cond(
-                    src < me, lambda o: block_bwd(o, False), skip,
-                    (k_cur, v_cur))
-            else:
-                dqb, dkb, dvb = block_bwd((k_cur, v_cur), False)
-        dq = dq + dqb.astype(jnp.float32)
-        dk_acc = dk_acc + dkb.astype(jnp.float32)
-        dv_acc = dv_acc + dvb.astype(jnp.float32)
-        if step != n - 1:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
-            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
-    # buffers now hold chunk (me+1)'s gradients: one final hop home
-    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
-    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
-    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
-            dv_acc.astype(v.dtype))
+            return block_bwd(kv, causal)
+        if not causal:
+            return block_bwd(kv, False)
+        return jax.lax.cond(src < me, lambda o: block_bwd(o, False), skip,
+                            kv)
+
+    return _ring_bwd_loop(q, k, v, axis_name, step_block)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -322,21 +350,15 @@ def _striped_flash(q, k, v, axis_name, sm_scale, block_q, block_k,
 # Causal-mask derivation for stripes: local row j has global position
 # j*n + me, a visiting row i has i*n + src, so q >= k  <=>
 # j >= i + (src > me) — i.e. kernel causal_offset 0 (src <= me) or
-# -1 (src > me, strict). The cond predicate below is exactly `src > me`.
+# -1 (src > me, strict). The cond predicates below are exactly
+# `src > me`.
 
 
 def _striped_fwd_impl(q, k, v, axis_name, sm_scale, block_q, block_k,
                       interpret):
-    n = jax.lax.psum(1, axis_name)
-    me = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def block(kv, strict):
-        # strict (src > me): local row j may attend visiting row i only
-        # for j > i (kernel causal_offset -1); else the inclusive
-        # diagonal (offset 0) — see _striped_offsets' derivation
+    def step_block(step, src, me, kv):
         return jax.lax.cond(
-            strict,
+            src > me,
             lambda ops: _attn._flash_forward(
                 q, ops[0], ops[1], sm_scale, True, block_q, block_k,
                 interpret, causal_offset=-1),
@@ -345,19 +367,7 @@ def _striped_fwd_impl(q, k, v, axis_name, sm_scale, block_q, block_k,
                 interpret, causal_offset=0),
             kv)
 
-    k_cur, v_cur = k, v
-    o_acc = lse_acc = None
-    for step in range(n):
-        src = (me - step) % n
-        o_b, lse_b = block((k_cur, v_cur), src > me)
-        if step == 0:
-            o_acc, lse_acc = o_b.astype(jnp.float32), lse_b
-        else:
-            o_acc, lse_acc = _combine_stats(o_acc, lse_acc, o_b, lse_b)
-        if step != n - 1:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-    return o_acc.astype(q.dtype), lse_acc
+    return _ring_fwd_loop(q, k, v, axis_name, step_block)
 
 
 def _striped_fwd(q, k, v, axis_name, sm_scale, block_q, block_k,
@@ -369,13 +379,10 @@ def _striped_fwd(q, k, v, axis_name, sm_scale, block_q, block_k,
 
 def _striped_bwd(axis_name, sm_scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    n = jax.lax.psum(1, axis_name)
-    me = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def block_bwd(ops, strict):
+    def step_block(step, src, me, kv):
         return jax.lax.cond(
-            strict,
+            src > me,
             lambda o: _attn._flash_backward(
                 (q, o[0], o[1], out, lse), g, sm_scale=sm_scale,
                 causal=True, block_q=block_q, block_k=block_k,
@@ -384,27 +391,9 @@ def _striped_bwd(axis_name, sm_scale, block_q, block_k, interpret, res, g):
                 (q, o[0], o[1], out, lse), g, sm_scale=sm_scale,
                 causal=True, block_q=block_q, block_k=block_k,
                 interpret=interpret, causal_offset=0),
-            ops)
+            kv)
 
-    k_cur, v_cur = k, v
-    dq = jnp.zeros(q.shape, jnp.float32)
-    dk_acc = jnp.zeros(k.shape, jnp.float32)
-    dv_acc = jnp.zeros(v.shape, jnp.float32)
-    for step in range(n):
-        src = (me - step) % n
-        dqb, dkb, dvb = block_bwd((k_cur, v_cur), src > me)
-        dq = dq + dqb.astype(jnp.float32)
-        dk_acc = dk_acc + dkb.astype(jnp.float32)
-        dv_acc = dv_acc + dvb.astype(jnp.float32)
-        if step != n - 1:
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
-            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
-    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
-    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
-    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
-            dv_acc.astype(v.dtype))
+    return _ring_bwd_loop(q, k, v, axis_name, step_block)
 
 
 _striped_flash.defvjp(_striped_fwd, _striped_bwd)
@@ -490,6 +479,9 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
     "interpret" (Pallas in interpreter mode — CPU CI). None = auto:
     flash on TPU, unfused elsewhere.
     """
+    if impl not in ("ring", "ulysses", "striped"):
+        raise ValueError(f"impl={impl!r}; expected one of "
+                         f"('ring', 'ulysses', 'striped')")
     attn_impl = _resolve_attn_impl(attn_impl)
     if spec is None:
         spec = P(None, None, axis_name, None)
@@ -514,8 +506,13 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
                 block_k=block_k, interpret=attn_impl == "interpret")
 
         def striped_global(q, k, v):
-            # relayout to stripes (an all-to-all over sp under GSPMD),
-            # run the balanced ring, restore the contiguous layout
+            # Relayout to stripes (an all-to-all over sp under GSPMD),
+            # run the balanced ring, restore the contiguous layout.
+            # NOTE: this drop-in wrapper pays 4 relayouts per call; at
+            # the long sequences SP targets, attention compute (S²/n)
+            # dwarfs the relayout bandwidth (4·S·D). Models wanting the
+            # zero-relayout form can stripe tokens ONCE at the input and
+            # call striped_flash_attention directly per layer.
             qs, ks, vs = (stripe_layout(t, n) for t in (q, k, v))
             return unstripe_layout(region(qs, ks, vs), n)
 

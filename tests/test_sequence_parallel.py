@@ -127,3 +127,28 @@ def test_ring_attention_in_jit(qkv, devices):
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_striped_one_token_per_device_no_nan(devices):
+    """seq == sp size: every strict step is an EMPTY block (the kernel's
+    +inf-lse sentinel) — the recombination must treat it as zero
+    contribution, not poison the output with NaN."""
+    rng = jax.random.PRNGKey(3)
+    q, k, v = jax.random.normal(rng, (3, 2, 4, 8, 16), jnp.float32)
+    mesh = make_mesh({"sp": 8})
+    fn = make_ring_attention(mesh, causal=True, impl="striped",
+                             attn_impl="interpret", block_q=8, block_k=8)
+    out = fn(q, k, v)
+    assert np.isfinite(np.asarray(out)).all()
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_make_ring_attention_rejects_unknown_impl(devices):
+    mesh = make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="impl="):
+        make_ring_attention(mesh, impl="zigzag")
+    with pytest.raises(ValueError, match="flash kernel"):
+        make_ring_attention(mesh, causal=True, impl="striped",
+                            attn_impl="unfused")
