@@ -99,6 +99,7 @@ class PreemptionCheckpointHandler:
         self._CONFIRM_PREFIX = f"dtx_preemption/{job}/confirm"
         self._confirm_round = 0
         self._sync_error: BaseException | None = None
+        self._grace_deadline: float | None = None
 
         # restore first (≙ failure_handling.py:647 restore-on-init)
         latest = self._manager.restore_or_initialize()
@@ -309,12 +310,19 @@ class PreemptionCheckpointHandler:
     def _check_preemption_and_maybe_checkpoint(self):
         if self._exited:
             return
+        if self._grace_deadline is not None:
+            # already checkpointed; training continues until the platform
+            # grace window closes (≙ failure_handling.py:1204 — the
+            # reference KEEPS RUNNING during the grace period, banking
+            # extra steps, rather than sleeping it away)
+            if time.time() >= self._grace_deadline:
+                self._exit()
+            return
         save_at = self._agree_on_preemption()
         if save_at is None or self._step < save_at:
             return
         if not self._confirm_stop_step(save_at):
             return
-        deadline = time.time() + (self._config.grace_period or 0.0)
         if self._config.save_fn is not None:
             self._config.save_fn()
             # NOTE: no key retirement here — a custom save_fn has no
@@ -337,13 +345,14 @@ class PreemptionCheckpointHandler:
                 agent.key_value_delete(self._STEPS_PREFIX)
             except Exception:
                 pass
-        # grace-period countdown (≙ failure_handling.py:1204): wait out
-        # the full window in small slices so tests can interrupt.
-        while True:
-            remaining = deadline - time.time()
-            if remaining <= 0:
-                break
-            time.sleep(min(remaining, 0.1))
+        if self._config.grace_period:
+            # checkpoint secured; bank extra training steps until the
+            # platform window closes, then exit at a step boundary
+            self._grace_deadline = time.time() + self._config.grace_period
+            return
+        self._exit()
+
+    def _exit(self):
         self._exited = True
         if self._config.exit_fn is not None:
             self._config.exit_fn()

@@ -145,3 +145,37 @@ def test_preemption_watcher():
     w.block_until_worker_exit(timeout=5)
     assert w.preemption_message is not None
     w.stop()
+
+
+def test_preemption_grace_period_keeps_training(tmp_path):
+    """≙ failure_handling.py:1204: after the preemption checkpoint, the
+    job keeps BANKING STEPS until the grace window closes (the reference
+    trains through the grace period; it does not sleep it away)."""
+    import time as _time
+    s = dtx.MirroredStrategy()
+    with s.scope():
+        v = s.create_variable(np.zeros(()), name="g")
+    mgr = CheckpointManager(Checkpoint(v=v), str(tmp_path))
+    exited = []
+    handler = PreemptionCheckpointHandler(
+        mgr, TerminationConfig(exit_fn=lambda: exited.append(True),
+                               grace_period=0.5))
+
+    def step():
+        v.assign_add(1.0)
+
+    handler.run(step)
+    handler.watch_preemption()
+    t0 = _time.perf_counter()
+    handler.run(step)              # checkpoints here, does NOT block
+    assert _time.perf_counter() - t0 < 0.4, "grace period slept, not banked"
+    assert not exited              # still inside the grace window
+    saved = mgr.latest_checkpoint
+    assert saved is not None
+    steps_after_save = 0
+    while not exited and steps_after_save < 1000:
+        handler.run(step)          # extra steps during the window
+        steps_after_save += 1
+        _time.sleep(0.01)
+    assert exited                  # window closed -> exit at boundary
+    assert steps_after_save > 5    # genuinely kept training
