@@ -357,6 +357,27 @@ class Cluster:
             w.stop()
 
 
+class _IteratorBuilder:
+    """Picklable zero-arg factory rebuilding a worker-side iterator
+    (attached to the handle so a restarted worker self-heals)."""
+
+    def __init__(self, dataset_fn):
+        self.dataset_fn = dataset_fn
+
+    def __call__(self):
+        return iter(self.dataset_fn())
+
+
+def _create_worker_iterator(dataset_fn):
+    """Runs ON the worker (via remote dispatch): build the dataset there
+    and register the live iterator, returning an opaque handle."""
+    from distributed_tensorflow_tpu.coordinator.remote_dispatch import (
+        current_worker_service)
+    service = current_worker_service()
+    builder = _IteratorBuilder(dataset_fn)
+    return service.create_resource(builder, builder=builder)
+
+
 class ClusterCoordinator:
     """Async training driver (≙ cluster_coordinator.py:1399).
 
@@ -406,12 +427,46 @@ class ClusterCoordinator:
             values, is_leaf=lambda v: isinstance(v, RemoteValue))
 
     def create_per_worker_dataset(self, dataset_fn: Callable) -> PerWorkerValues:
-        """≙ create_per_worker_dataset (:1604): one iterator per worker."""
-        iters = []
-        for i in range(self.num_workers):
-            ds = dataset_fn()
-            iters.append(iter(ds))
-        return PerWorkerValues(iters)
+        """≙ create_per_worker_dataset (:1604): one iterator per worker.
+
+        With remote lanes the iterator is created ON each worker process
+        (the reference's semantics — worker-side datasets feed
+        worker-side steps without shipping data through the
+        coordinator); closures receive an opaque handle that resolves to
+        the live iterator inside the worker (remote_dispatch
+        resource registry). Local lanes keep coordinator-side iterators.
+        """
+        if any(w.lane is not None for w in self.cluster.workers):
+            return PerWorkerValues(self._create_on_workers(
+                _create_worker_iterator, (dataset_fn,)))
+        return PerWorkerValues([iter(dataset_fn())
+                                for _ in range(self.num_workers)])
+
+    def _create_on_workers(self, fn, args, *, attempts: int = 3,
+                           timeout_s: float = 120.0) -> list:
+        """Fan a pinned closure out to EVERY worker lane in parallel
+        (publish all tasks, then gather), retrying per worker on
+        preemption — the transparent-retry contract, pinned rather than
+        re-routed (per-worker resources belong to a specific worker)."""
+        lanes = [w.lane for w in self.cluster.workers]
+        seqs = [lane.submit(fn, args, {}) for lane in lanes]
+        results: list = [None] * len(lanes)
+        for i, (lane, seq) in enumerate(zip(lanes, seqs)):
+            last: BaseException | None = None
+            for _ in range(attempts):
+                try:
+                    results[i] = lane.wait(seq, timeout_s=timeout_s)
+                    last = None
+                    break
+                except WorkerPreemptionError as e:
+                    last = e          # worker may come back: resubmit
+                    seq = lane.submit(fn, args, {})
+            if last is not None:
+                raise WorkerPreemptionError(
+                    f"worker {lane.worker_id} unavailable after "
+                    f"{attempts} attempts creating a per-worker "
+                    f"resource") from last
+        return results
 
     def create_per_worker_resource(self, resource_fn: Callable) -> PerWorkerValues:
         vals = PerWorkerValues([resource_fn() for _ in range(self.num_workers)])

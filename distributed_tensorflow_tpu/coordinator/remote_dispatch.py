@@ -75,6 +75,10 @@ class RemoteLane:
         self.agent = agent or coordination_service()
         self.staleness_s = staleness_s
         self._seq = 0
+        # execute() may be called from the Worker dispatch thread AND
+        # directly (per-worker resource creation): seq allocation must
+        # be atomic or two callers share a task slot
+        self._seq_lock = threading.Lock()
         self._last_hb: bytes | None = None
         self._last_change = time.monotonic()
 
@@ -94,16 +98,22 @@ class RemoteLane:
         return now - self._last_change < self.staleness_s
 
     # -- execution --------------------------------------------------------
-    def execute(self, fn: Callable, args: tuple, kwargs: dict,
-                timeout_s: float | None = None) -> Any:
-        """Ship one closure; block for its result; translate worker death
+    def submit(self, fn: Callable, args: tuple, kwargs: dict) -> int:
+        """Publish one closure without waiting; returns its seq (pair
+        with :meth:`wait` — lets callers fan tasks out to many lanes
+        before blocking on any result)."""
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        payload = pickle.dumps((fn, args, kwargs))
+        self.agent.key_value_set(_task_key(self.worker_id, seq), payload)
+        return seq
+
+    def wait(self, seq: int, timeout_s: float | None = None) -> Any:
+        """Block for a submitted closure's result; translate worker death
         into WorkerPreemptionError (the retryable class)."""
         from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
             import WorkerPreemptionError
-        seq = self._seq
-        self._seq += 1
-        payload = pickle.dumps((fn, args, kwargs))
-        self.agent.key_value_set(_task_key(self.worker_id, seq), payload)
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         while True:
             res = self.agent.key_value_try_get(
@@ -124,23 +134,47 @@ class RemoteLane:
         raise RemoteClosureError(
             f"closure failed on worker {self.worker_id}:\n{data}")
 
+    def execute(self, fn: Callable, args: tuple, kwargs: dict,
+                timeout_s: float | None = None) -> Any:
+        """submit + wait."""
+        return self.wait(self.submit(fn, args, kwargs), timeout_s)
+
 
 class _ResourceHandle:
     """Worker-side resource reference (≙ per-worker resources: the object
-    stays on the worker; the coordinator holds an opaque handle)."""
+    stays on the worker; the coordinator holds an opaque handle).
 
-    def __init__(self, worker_id: int, handle: int):
+    ``builder`` (a picklable zero-arg factory) makes handles SELF-HEALING
+    across worker restarts: a restarted worker whose registry lost the
+    object rebuilds it on first use instead of failing the closure —
+    ≙ the reference re-creating per-worker resources after worker
+    recovery (cluster_coordinator.py per-worker dataset re-creation).
+    """
+
+    def __init__(self, worker_id: int, handle: int, builder=None):
         self.worker_id = worker_id
         self.handle = handle
+        self.builder = builder
 
 
 def resolve_resources(args, registry: dict):
-    """Worker-side: swap _ResourceHandle leaves for the live objects."""
+    """Worker-side: swap _ResourceHandle leaves for the live objects,
+    rebuilding missing ones from their builder (worker restarted)."""
     import jax
+
+    def resolve(v):
+        if not isinstance(v, _ResourceHandle):
+            return v
+        if v.handle not in registry:
+            if v.builder is None:
+                raise KeyError(
+                    f"resource handle {v.handle} unknown on this worker "
+                    f"(restarted?) and carries no builder")
+            registry[v.handle] = v.builder()
+        return registry[v.handle]
+
     return jax.tree_util.tree_map(
-        lambda v: registry[v.handle] if isinstance(v, _ResourceHandle)
-        else v,
-        args, is_leaf=lambda v: isinstance(v, _ResourceHandle))
+        resolve, args, is_leaf=lambda v: isinstance(v, _ResourceHandle))
 
 
 class RemoteWorkerService:
@@ -173,12 +207,15 @@ class RemoteWorkerService:
             time.sleep(_HEARTBEAT_INTERVAL)
 
     # -- resource registry (coordinator schedules these as closures) -----
-    def create_resource(self, fn, *args, **kwargs) -> _ResourceHandle:
+    def create_resource(self, fn, *args, builder=None,
+                        **kwargs) -> _ResourceHandle:
+        """``builder``: optional picklable zero-arg re-creation factory
+        stored on the handle (self-healing across worker restarts)."""
         obj = fn(*args, **kwargs)
         h = self._next_handle
         self._next_handle += 1
         self.resources[h] = obj
-        return _ResourceHandle(self.worker_id, h)
+        return _ResourceHandle(self.worker_id, h, builder=builder)
 
     # -- main loop --------------------------------------------------------
     def _initial_seq(self) -> int:

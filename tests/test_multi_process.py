@@ -255,6 +255,45 @@ def _remote_failover_worker(tmpdir):
     return _remote_dispatch_worker(tmpdir, slow=True)
 
 
+def _range_dataset():
+    return iter(range(100, 1000, 100))
+
+
+def _consume_next(it):
+    return next(it)
+
+
+def _per_worker_dataset_worker():
+    """Worker-side datasets: the iterator LIVES on the worker process;
+    closures consume it through an opaque handle (≙ per-worker datasets,
+    cluster_coordinator.py:1604)."""
+    from distributed_tensorflow_tpu.cluster import bootstrap
+    from distributed_tensorflow_tpu.coordinator import remote_dispatch
+    from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
+        ClusterCoordinator, PerWorkerValues)
+    runtime = bootstrap.initialize()
+    if runtime.process_id != 0:
+        remote_dispatch.run_worker_loop()
+        return ("worker-done", runtime.process_id)
+
+    coord = ClusterCoordinator(
+        remote_worker_ids=list(range(1, runtime.num_processes)))
+    per_worker_it = coord.create_per_worker_dataset(_range_dataset)
+    assert isinstance(per_worker_it, PerWorkerValues)
+    # schedule 4 closures: each consumes the NEXT element of whichever
+    # worker's iterator it lands on — worker-side state advances
+    rvs = [coord.schedule(_consume_next, args=(per_worker_it,))
+           for _ in range(4)]
+    coord.join(timeout=120)
+    values = sorted(coord.fetch(rvs))
+    coord.shutdown()
+    # 2 workers × first two elements each (whatever the dispatch split,
+    # values come from {100, 200, 300, 400} with per-worker monotonicity)
+    ok = all(v in (100, 200, 300, 400) for v in values) and \
+        values[0] == 100
+    return ("coordinator", ok, values)
+
+
 def _remote_basic_worker(tmpdir):
     return _remote_dispatch_worker(tmpdir, slow=False)
 
@@ -369,6 +408,15 @@ def test_remote_coordinator_dispatch(tmp_path):
     assert coord[1], f"wrong results: {coord[2]}"
     workers = [v for v in result.return_values if v[0] == "worker-done"]
     assert len(workers) == 2     # both worker loops exited via shutdown
+
+
+def test_per_worker_datasets_on_remote_workers():
+    """create_per_worker_dataset places iterators ON worker processes;
+    scheduled closures consume them via resource handles."""
+    result = mpr.run(_per_worker_dataset_worker, num_workers=3,
+                     timeout=240)
+    coord = [v for v in result.return_values if v[0] == "coordinator"][0]
+    assert coord[1], f"unexpected values: {coord[2]}"
 
 
 def test_remote_dispatch_failover_on_worker_kill(tmp_path):
