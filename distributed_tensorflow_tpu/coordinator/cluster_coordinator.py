@@ -378,6 +378,15 @@ def _create_worker_iterator(dataset_fn):
     return service.create_resource(builder, builder=builder)
 
 
+def _create_worker_resource(resource_fn):
+    """Runs ON the worker: build and register an arbitrary per-worker
+    resource (resource_fn itself is the rebuild factory)."""
+    from distributed_tensorflow_tpu.coordinator.remote_dispatch import (
+        current_worker_service)
+    return current_worker_service().create_resource(
+        resource_fn, builder=resource_fn)
+
+
 class ClusterCoordinator:
     """Async training driver (≙ cluster_coordinator.py:1399).
 
@@ -469,7 +478,15 @@ class ClusterCoordinator:
         return results
 
     def create_per_worker_resource(self, resource_fn: Callable) -> PerWorkerValues:
-        vals = PerWorkerValues([resource_fn() for _ in range(self.num_workers)])
+        """One resource per worker; with remote lanes the object is
+        created and lives ON the worker process (closures get a
+        self-healing handle), like per-worker datasets."""
+        if any(w.lane is not None for w in self.cluster.workers):
+            vals = PerWorkerValues(self._create_on_workers(
+                _create_worker_resource, (resource_fn,)))
+        else:
+            vals = PerWorkerValues([resource_fn()
+                                    for _ in range(self.num_workers)])
         self._per_worker_resources.append(vals)
         return vals
 
