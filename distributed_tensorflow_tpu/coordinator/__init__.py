@@ -13,3 +13,9 @@ from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
     WorkerPreemptionError,
 )
 from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+from distributed_tensorflow_tpu.coordinator import remote_dispatch
+from distributed_tensorflow_tpu.coordinator.distribute_coordinator import (
+    CoordinatorMode,
+    WorkerContext,
+    run_distribute_coordinator,
+)

@@ -152,3 +152,20 @@ def test_make_ring_attention_rejects_unknown_impl(devices):
     with pytest.raises(ValueError, match="flash kernel"):
         make_ring_attention(mesh, causal=True, impl="striped",
                             attn_impl="unfused")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grads(qkv, causal, devices):
+    """all_to_all has a well-defined transpose: Ulysses gradients must
+    match full attention (the one SP schedule previously without
+    gradient coverage)."""
+    q, k, v = qkv
+    mesh = make_mesh({"sp": 8})
+    fn = make_ring_attention(mesh, causal=causal, impl="ulysses")
+    gr = jax.grad(lambda *a: (mha_reference(*a, causal=causal) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda *a: (fn(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name} mismatch")
