@@ -100,6 +100,7 @@ class PreemptionCheckpointHandler:
         self._confirm_round = 0
         self._sync_error: BaseException | None = None
         self._grace_deadline: float | None = None
+        self._finalizing = False
 
         # restore first (≙ failure_handling.py:647 restore-on-init)
         latest = self._manager.restore_or_initialize()
@@ -177,6 +178,12 @@ class PreemptionCheckpointHandler:
         if self._sync_thread is not None and self._sync_thread.is_alive():
             self._sync_thread.join(timeout=600)
         self._save_at = self._step          # save at wherever we stopped
+        # Finalize mode: this process CANNOT step further (its loop is
+        # over). The confirm protocol must not send it back to "run to
+        # the raised target" — it publishes its step as final and loops
+        # confirm rounds until peers converge, then saves, so the
+        # committed checkpoint always contains this host's shards.
+        self._finalizing = True
         self._check_preemption_and_maybe_checkpoint()
 
     def run(self, distributed_train_fn: Callable, *args, **kwargs):
@@ -271,6 +278,14 @@ class PreemptionCheckpointHandler:
         SAME step. Runs on the main thread; a blocked process has already
         enqueued all its steps, so peers' in-flight collectives complete.
 
+        A process in finalize mode (its loop is over — it cannot step)
+        publishes its step with a ``!`` final marker. A round also
+        converges when EVERY entry is final-marked: no host can advance,
+        so all save now at a common checkpoint number (max of the
+        published steps) — every host contributes shards rather than a
+        laggard silently dropping out while peers block on the shard
+        barrier.
+
         Returns True when this process should save now.
         """
         from distributed_tensorflow_tpu.cluster.coordination import (
@@ -282,14 +297,22 @@ class PreemptionCheckpointHandler:
         while True:
             r = self._confirm_round
             try:
+                mark = "!" if self._finalizing else ""
                 agent.key_value_set(
                     f"{self._CONFIRM_PREFIX}{r}/p{agent.process_id}",
-                    str(self._step))
+                    f"{self._step}{mark}")
                 agent.barrier(f"{self._CONFIRM_PREFIX}{r}/barrier",
                               timeout_s=600)
-                steps = [int(v) for _, v in agent.key_value_dir_get(
+                entries = [v.decode() for _, v in agent.key_value_dir_get(
                     f"{self._CONFIRM_PREFIX}{r}/")]
+                steps = [int(e.rstrip("!")) for e in entries]
                 final = max(steps)
+                # Convergence when no more catching-up is possible:
+                # every process still BELOW the target has declared its
+                # loop over. (Processes at the target never need to
+                # advance, final-marked or not.)
+                blocked = all(e.endswith("!") for e, s in
+                              zip(entries, steps) if s < final)
             except Exception as e:
                 self._sync_error = e
                 return True                # degraded best-effort save
@@ -300,12 +323,24 @@ class PreemptionCheckpointHandler:
             self._save_at = final
             if min(steps) == final:
                 return True                # all stopped at the same step
-            if self._step < final:
+            if blocked:
+                # No below-target process can advance (their loops are
+                # over — the signal landed on someone's last steps):
+                # save what we have under a common number so no host's
+                # shards are missing from the commit.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "preemption finalize: hosts stopped at unequal steps "
+                    "%s; committing best-effort checkpoint at %d",
+                    sorted(steps), final)
+                return True
+            if not self._finalizing and self._step < final:
                 # laggard: run to the raised target, then confirm again
                 return False
-            # already at the target: confirm again without stepping
-            # (blocking here is safe — all our steps are enqueued, so
-            # peers' in-flight collectives still complete)
+            # already at the target (or final, waiting for peers to
+            # reach it / finish their loops): confirm again without
+            # stepping — all our steps are enqueued, so peers' in-flight
+            # collectives still complete
 
     def _check_preemption_and_maybe_checkpoint(self):
         if self._exited:

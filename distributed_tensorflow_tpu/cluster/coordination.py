@@ -18,12 +18,33 @@ Multi-process: operations delegate to the TSL coordination service that
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 
 
 class CoordinationError(RuntimeError):
     """A coordination-service operation failed (timeout, peer error)."""
+
+
+def _parse_task_id(node) -> "int | None":
+    """Task id from a live-nodes entry.
+
+    Formats seen from TSL: int, ``"/job:jax_worker/task:3"``, ``"3"``.
+    The task number is parsed from the trailing ``task:<n>`` field (NOT
+    by collecting digits — a job name containing a digit, e.g.
+    ``jax_worker_2``, must not mangle the id). Unrecognized formats
+    return None.
+    """
+    if isinstance(node, int):
+        return node
+    s = str(node)
+    m = re.search(r"task:(\d+)\s*$", s)
+    if m:
+        return int(m.group(1))
+    if s.strip().isdigit():
+        return int(s.strip())
+    return None
 
 
 class BarrierTimeoutError(CoordinationError):
@@ -212,17 +233,18 @@ class CoordinationServiceAgent:
             nodes = c.get_live_nodes([])
             out = []
             for n in nodes:
-                # formats seen: int, "/job:jax_worker/task:3", "3"
-                if isinstance(n, int):
-                    out.append(n)
-                    continue
-                s = str(n)
-                digits = "".join(ch for ch in s if ch.isdigit())
-                if digits:
-                    out.append(int(digits))
+                tid = _parse_task_id(n)
+                if tid is not None:
+                    out.append(tid)
             return sorted(set(out))
         except Exception:
             # service variant without get_live_nodes: assume all alive
+            import logging
+            logging.getLogger(__name__).warning(
+                "coordination service has no usable get_live_nodes; "
+                "assuming all %d processes alive (organic failure "
+                "detection degraded to heartbeats only)",
+                self.num_processes)
             return list(range(self.num_processes))
 
 

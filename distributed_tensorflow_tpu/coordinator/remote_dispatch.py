@@ -13,18 +13,32 @@ store:
 
     coordinator                           worker process w
     -----------                           ----------------
-    task/<w>/<seq>  <- pickle(fn,args)    blocking get task/<w>/<seq>
-    poll result/<w>/<seq> ------------->  run fn
-      | heartbeat stale?                  set result/<w>/<seq>
-      v                                   seq += 1
-    WorkerPreemptionError -> re-queue
+    g<G>/task/<w>/<seq> <- pickle(...)    blocking get g<G>/task/<w>/<seq>
+    blocking get g<G>/result/<w>/<seq>    run fn
+      | heartbeat stale?                  set g<G>/result/<w>/<seq>
+      v                                   g<G>/done/<w> = seq+1 (watermark)
+    WorkerPreemptionError -> re-queue     seq += 1
+
+Lifecycle rules (a long async-PS job schedules 10^5-10^6 closures, so the
+KV store must stay bounded — ≙ the reference's per-closure grpc calls
+leaving nothing behind):
+
+- Every key lives under a per-coordinator-incarnation GENERATION
+  namespace ``g<G>`` (G from an atomic counter). A crash-restarted
+  coordinator gets a fresh G and can never read a prior incarnation's
+  results; workers follow the published ``current_gen``.
+- The coordinator DELETES task+result keys as soon as a result is
+  consumed; the worker's restart fast-forward reads the ``done/<w>``
+  watermark instead of scanning result keys.
+- Waits are BLOCKING coordination-service gets (no 20 ms polling): one
+  RPC per staleness window instead of 50/s per lane.
 
 Death detection is organic: each worker service bumps a heartbeat key a
 few times a second; a coordinator lane that stops seeing bumps while
 waiting raises ``WorkerPreemptionError`` — the producer the retry
 machinery in cluster_coordinator.py needs. This is a CONTROL plane: data
 (model state) moves inside SPMD programs over ICI/DCN, not through the
-KV store.
+KV store (``MAX_PAYLOAD_BYTES`` enforces it).
 """
 
 from __future__ import annotations
@@ -36,12 +50,18 @@ import traceback
 from typing import Any, Callable
 
 from distributed_tensorflow_tpu.cluster.coordination import (
+    CoordinationError,
     CoordinationServiceAgent,
     coordination_service,
 )
 
-_PREFIX = "dtx_coord"
+_ROOT = "dtx_coord"
 _HEARTBEAT_INTERVAL = 0.2
+
+#: Closure payloads ride the coordination service's KV store, which is a
+#: control plane. Anything bigger than this belongs in the SPMD data
+#: plane (device arrays / checkpoints), not in a pickled closure.
+MAX_PAYLOAD_BYTES = 4 << 20
 
 
 class RemoteClosureError(RuntimeError):
@@ -49,19 +69,62 @@ class RemoteClosureError(RuntimeError):
 
 
 def _hb_key(worker_id: int) -> str:
-    return f"{_PREFIX}/hb/{worker_id}"
+    return f"{_ROOT}/hb/{worker_id}"
 
 
-def _task_key(worker_id: int, seq: int) -> str:
-    return f"{_PREFIX}/task/{worker_id}/{seq}"
+def _gen_dir(gen: int) -> str:
+    return f"{_ROOT}/g{gen}"
 
 
-def _result_key(worker_id: int, seq: int) -> str:
-    return f"{_PREFIX}/result/{worker_id}/{seq}"
+def _task_key(gen: int, worker_id: int, seq: int) -> str:
+    return f"{_gen_dir(gen)}/task/{worker_id}/{seq}"
 
 
-def _shutdown_key() -> str:
-    return f"{_PREFIX}/shutdown"
+def _result_key(gen: int, worker_id: int, seq: int) -> str:
+    return f"{_gen_dir(gen)}/result/{worker_id}/{seq}"
+
+
+def _done_key(gen: int, worker_id: int) -> str:
+    """Watermark: next seq this worker should run (restart fast-forward)."""
+    return f"{_gen_dir(gen)}/done/{worker_id}"
+
+
+def _shutdown_key(gen: int) -> str:
+    return f"{_gen_dir(gen)}/shutdown"
+
+
+# ---------------------------------------------------------------------------
+# Generations: one per coordinator incarnation.
+# ---------------------------------------------------------------------------
+
+_GEN_LOCK = threading.Lock()
+_GENERATION: int | None = None
+
+
+def _coordinator_generation(agent: CoordinationServiceAgent) -> int:
+    """This coordinator process's generation — allocated once, published
+    as ``current_gen`` for workers to follow. A restarted coordinator
+    allocates a fresh one, so stale task/result keys from a crashed
+    incarnation are unreachable (and its immediate predecessor's
+    namespace is garbage-collected here)."""
+    global _GENERATION
+    with _GEN_LOCK:
+        if _GENERATION is None:
+            gen = agent.key_value_increment(f"{_ROOT}/generation")
+            if gen > 1:        # GC a crashed predecessor's namespace
+                try:
+                    agent.key_value_delete(_gen_dir(gen - 1))
+                except Exception:
+                    pass
+            agent.key_value_set(f"{_ROOT}/current_gen", str(gen))
+            _GENERATION = gen
+        return _GENERATION
+
+
+def _reset_generation_for_tests():
+    global _GENERATION
+    with _GEN_LOCK:
+        _GENERATION = None
 
 
 class RemoteLane:
@@ -74,6 +137,7 @@ class RemoteLane:
         self.worker_id = worker_id
         self.agent = agent or coordination_service()
         self.staleness_s = staleness_s
+        self.generation = _coordinator_generation(self.agent)
         self._seq = 0
         # execute() may be called from the Worker dispatch thread AND
         # directly (per-worker resource creation): seq allocation must
@@ -102,24 +166,48 @@ class RemoteLane:
         """Publish one closure without waiting; returns its seq (pair
         with :meth:`wait` — lets callers fan tasks out to many lanes
         before blocking on any result)."""
+        payload = pickle.dumps((fn, args, kwargs))
+        if len(payload) > MAX_PAYLOAD_BYTES:
+            raise ValueError(
+                f"closure payload is {len(payload)} bytes "
+                f"(> {MAX_PAYLOAD_BYTES}): the KV control plane is not a "
+                f"data path — move bulk data via SPMD programs, "
+                f"checkpoints, or per-worker datasets")
         with self._seq_lock:
             seq = self._seq
             self._seq += 1
-        payload = pickle.dumps((fn, args, kwargs))
-        self.agent.key_value_set(_task_key(self.worker_id, seq), payload)
+        self.agent.key_value_set(
+            _task_key(self.generation, self.worker_id, seq), payload)
         return seq
 
     def wait(self, seq: int, timeout_s: float | None = None) -> Any:
         """Block for a submitted closure's result; translate worker death
-        into WorkerPreemptionError (the retryable class)."""
+        into WorkerPreemptionError (the retryable class). Consumed task +
+        result keys are deleted — the KV store stays bounded regardless
+        of how many closures the job schedules."""
         from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
             import WorkerPreemptionError
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        rkey = _result_key(self.generation, self.worker_id, seq)
         while True:
-            res = self.agent.key_value_try_get(
-                _result_key(self.worker_id, seq))
-            if res is not None:
+            # Blocking get in staleness-sized slices: wakes immediately
+            # when the worker publishes, touches the service once per
+            # slice otherwise (vs the previous 50 polls/s).
+            slice_s = self.staleness_s
+            if deadline is not None:
+                slice_s = min(slice_s, max(deadline - time.monotonic(),
+                                           0.01))
+            t0 = time.monotonic()
+            try:
+                res = self.agent.key_value_get(rkey, timeout_s=slice_s)
                 break
+            except CoordinationError:
+                # Not published yet — but if the get failed FAST (service
+                # error, not a timeout), back off instead of hot-spinning
+                # until the heartbeat staleness window closes.
+                waited = time.monotonic() - t0
+                if waited < slice_s:
+                    time.sleep(min(0.1, slice_s - waited))
             if not self.alive():
                 raise WorkerPreemptionError(
                     f"worker {self.worker_id} heartbeat stale "
@@ -127,7 +215,24 @@ class RemoteLane:
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"closure {seq} on worker {self.worker_id} timed out")
-            time.sleep(0.02)
+        # Consume: bump the worker's watermark past this seq FIRST, then
+        # delete the task/result keys. If the worker died after publishing
+        # the result but before advancing its own watermark, a restarted
+        # worker would otherwise block forever on the already-deleted task
+        # key (heartbeat alive, lane hung). Advance-only: never regress a
+        # watermark the worker has already pushed further.
+        dkey = _done_key(self.generation, self.worker_id)
+        try:
+            cur = self.agent.key_value_try_get(dkey)
+            if cur is None or int(cur) < seq + 1:
+                self.agent.key_value_set(dkey, str(seq + 1))
+        except Exception:
+            pass
+        for k in (rkey, _task_key(self.generation, self.worker_id, seq)):
+            try:
+                self.agent.key_value_delete(k)
+            except Exception:
+                pass
         status, data = pickle.loads(res)
         if status == "ok":
             return data
@@ -149,9 +254,12 @@ class _ResourceHandle:
     object rebuilds it on first use instead of failing the closure —
     ≙ the reference re-creating per-worker resources after worker
     recovery (cluster_coordinator.py per-worker dataset re-creation).
+    Handle ids embed the worker INCARNATION (an atomic counter bumped at
+    service start), so a stale handle can never alias a fresh resource
+    on a restarted worker — it misses the registry and rebuilds.
     """
 
-    def __init__(self, worker_id: int, handle: int, builder=None):
+    def __init__(self, worker_id: int, handle: str, builder=None):
         self.worker_id = worker_id
         self.handle = handle
         self.builder = builder
@@ -182,7 +290,10 @@ class RemoteWorkerService:
     service): pull task keys in sequence, execute, publish results.
 
     Run via ``run_worker_loop()`` from a worker task's main; returns when
-    the coordinator publishes the shutdown key.
+    the coordinator publishes the shutdown key. Follows the published
+    ``current_gen``: if a new coordinator incarnation appears mid-loop,
+    the service switches namespaces and resumes from the new generation's
+    watermark.
     """
 
     def __init__(self, worker_id: int | None = None,
@@ -190,7 +301,9 @@ class RemoteWorkerService:
         self.agent = agent or coordination_service()
         self.worker_id = (worker_id if worker_id is not None
                           else self.agent.process_id)
-        self.resources: dict[int, Any] = {}
+        self.resources: dict[str, Any] = {}
+        self._incarnation = self.agent.key_value_increment(
+            f"{_ROOT}/incarnation/{self.worker_id}")
         self._next_handle = 0
         self._stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -201,7 +314,8 @@ class RemoteWorkerService:
         while not self._stop.is_set():
             n += 1
             try:
-                self.agent.key_value_set(_hb_key(self.worker_id), str(n))
+                self.agent.key_value_set(_hb_key(self.worker_id),
+                                         f"{self._incarnation}:{n}")
             except Exception:
                 return                      # service gone: job is over
             time.sleep(_HEARTBEAT_INTERVAL)
@@ -212,42 +326,57 @@ class RemoteWorkerService:
         """``builder``: optional picklable zero-arg re-creation factory
         stored on the handle (self-healing across worker restarts)."""
         obj = fn(*args, **kwargs)
-        h = self._next_handle
+        h = f"{self._incarnation}:{self._next_handle}"
         self._next_handle += 1
         self.resources[h] = obj
         return _ResourceHandle(self.worker_id, h, builder=builder)
 
     # -- main loop --------------------------------------------------------
-    def _initial_seq(self) -> int:
-        """Restart support: fast-forward past tasks that already have
-        results (a restarted worker must not re-run completed closures)."""
-        done = {int(k.rsplit("/", 1)[1]) for k, _ in
-                self.agent.key_value_dir_get(
-                    f"{_PREFIX}/result/{self.worker_id}/")}
-        seq = 0
-        while seq in done:
-            seq += 1
-        return seq
+    def _current_gen(self) -> int | None:
+        raw = self.agent.key_value_try_get(f"{_ROOT}/current_gen")
+        return int(raw) if raw is not None else None
 
-    def run(self, poll_s: float = 0.05):
+    def _initial_seq(self, gen: int) -> int:
+        """Restart support: resume from the completed-seq watermark (a
+        restarted worker must not re-run completed closures)."""
+        raw = self.agent.key_value_try_get(_done_key(gen, self.worker_id))
+        return int(raw) if raw is not None else 0
+
+    def run(self, poll_s: float = 0.5):
+        """Serve closures until the coordinator's shutdown key appears.
+
+        ``poll_s`` is the blocking-get slice for the task key — purely a
+        shutdown/generation-switch responsiveness bound, not a poll rate
+        (the get wakes immediately when a task is published).
+        """
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            daemon=True)
         self._hb_thread.start()
-        seq = self._initial_seq()
+        gen: int | None = None
+        seq = 0
         try:
             while True:
-                if self.agent.key_value_try_get(_shutdown_key()) is not None:
+                cur = self._current_gen()
+                if cur is None:          # no coordinator incarnation yet
+                    time.sleep(min(poll_s, 0.05))
+                    continue
+                if cur != gen:           # adopt the (new) coordinator
+                    gen, seq = cur, self._initial_seq(cur)
+                if self.agent.key_value_try_get(
+                        _shutdown_key(gen)) is not None:
                     # ack so the coordinator (which hosts the coordination
                     # service) won't tear it down under our last RPCs
                     self._stop.set()
                     self.agent.key_value_set(
-                        f"{_PREFIX}/shutdown_ack/{self.worker_id}", "1")
+                        f"{_gen_dir(gen)}/shutdown_ack/{self.worker_id}",
+                        "1")
                     return
-                payload = self.agent.key_value_try_get(
-                    _task_key(self.worker_id, seq))
-                if payload is None:
-                    time.sleep(poll_s)
-                    continue
+                try:
+                    payload = self.agent.key_value_get(
+                        _task_key(gen, self.worker_id, seq),
+                        timeout_s=poll_s)
+                except CoordinationError:
+                    continue             # no task yet: re-check shutdown
                 fn, args, kwargs = pickle.loads(payload)
                 try:
                     args = resolve_resources(args, self.resources)
@@ -260,8 +389,10 @@ class RemoteWorkerService:
                 except BaseException:
                     resp = pickle.dumps(("error", traceback.format_exc()))
                 self.agent.key_value_set(
-                    _result_key(self.worker_id, seq), resp)
+                    _result_key(gen, self.worker_id, seq), resp)
                 seq += 1
+                self.agent.key_value_set(_done_key(gen, self.worker_id),
+                                         str(seq))
         finally:
             self._stop.set()
 
@@ -298,21 +429,23 @@ def shutdown_workers(agent: CoordinationServiceAgent | None = None,
     wait for acks — the coordinator hosts the coordination service, so it
     must not exit while workers still have RPCs in flight."""
     agent = agent or coordination_service()
-    agent.key_value_set(_shutdown_key(), "1")
+    gen = _coordinator_generation(agent)
+    agent.key_value_set(_shutdown_key(gen), "1")
     deadline = time.monotonic() + timeout_s
     pending = set(worker_ids or ())
     while pending and time.monotonic() < deadline:
         for wid in list(pending):
             if agent.key_value_try_get(
-                    f"{_PREFIX}/shutdown_ack/{wid}") is not None:
+                    f"{_gen_dir(gen)}/shutdown_ack/{wid}") is not None:
                 pending.discard(wid)
         if pending:
             time.sleep(0.05)
-    # Retire the whole namespace (TSL key_value_delete is recursive for
-    # directories): a later coordinator/worker generation in the same job
-    # must not read this generation's shutdown key, stale results
-    # (RemoteLane seqs restart at 0!), or heartbeats.
-    try:
-        agent.key_value_delete(_PREFIX)
-    except Exception:
-        pass
+    # Retire this generation's namespace + the heartbeat keys (TSL
+    # key_value_delete is recursive for directories). The generation
+    # counter itself survives: a later coordinator in the same job gets a
+    # strictly newer incarnation.
+    for key in (_gen_dir(gen), f"{_ROOT}/hb"):
+        try:
+            agent.key_value_delete(key)
+        except Exception:
+            pass

@@ -97,6 +97,12 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Fused (chunked) cross-entropy: > 0 splits the sequence into this many
+    # chunks and computes logits + CE per chunk inside a rematerialized
+    # lax.scan, so the (B, S, vocab) fp32 logits tensor never materializes
+    # in HBM (the memory wall that capped global batch at 8 on v5e).
+    # 0 = classic full-logits path.
+    loss_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -297,7 +303,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden=False):
         cfg = self.cfg
         embed = param_with_axes(
             "embed", nn.initializers.normal(0.02),
@@ -329,6 +335,10 @@ class TransformerLM(nn.Module):
                 x, _ = block(cfg, name=f"layer_{i}")(x, None)
 
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # Fused-loss path: the caller computes chunked logits + CE
+            # against the tied embedding itself (fused_next_token_loss).
+            return x
         logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
         return logits.astype(jnp.float32)
 
@@ -345,6 +355,56 @@ def next_token_loss(logits, tokens):
     return losses.mean()
 
 
+def fused_next_token_loss(hidden, embed, tokens, *, num_chunks,
+                          compute_dtype=jnp.bfloat16):
+    """Chunked next-token CE over the tied embedding — the fused loss.
+
+    Equivalent to ``next_token_loss(einsum(hidden, embed), tokens)`` but
+    the (B, S, vocab) fp32 logits tensor never exists: each of
+    ``num_chunks`` sequence chunks computes its (B, S/num_chunks, vocab)
+    logits inside a rematerialized ``lax.scan`` body, reduces them to a
+    partial CE sum, and the backward recomputes one chunk's logits at a
+    time. This removes the dominant HBM peak of the training step (for
+    transformer_big at batch 16 / seq 1024 / vocab 32k the logits +
+    their cotangent alone are 4 GiB fp32).
+
+    ≙ the reference's fused softmax-CE op
+    (TF/python/ops/nn_ops.py softmax_cross_entropy_with_logits lowering
+    to a fused XLA reduction) — extended to also fuse away the vocab
+    projection, which the reference never needed because GPU HBM held
+    its logits.
+    """
+    B, S, D = hidden.shape
+    if S % num_chunks:
+        raise ValueError(f"seq len {S} not divisible by "
+                         f"loss num_chunks={num_chunks}")
+    C = S // num_chunks
+    # Position t predicts token t+1; the final position has no target and
+    # is masked out — identical semantics to next_token_loss.
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1)
+    emb = embed.astype(compute_dtype)
+    xs = (hidden.reshape(B, num_chunks, C, D).swapaxes(0, 1),
+          targets.reshape(B, num_chunks, C).swapaxes(0, 1),
+          mask.reshape(B, num_chunks, C).swapaxes(0, 1))
+
+    def chunk_body(carry, xtm):
+        xc, tc, mc = xtm
+        logits = jnp.einsum("bcd,vd->bcv", xc.astype(compute_dtype),
+                            emb).astype(jnp.float32)
+        ls = optax.softmax_cross_entropy_with_integer_labels(logits, tc)
+        return carry + jnp.sum(ls * mc), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(chunk_body,
+                       policy=jax.checkpoint_policies.nothing_saveable),
+        jnp.zeros((), jnp.float32), xs)
+    return total / (B * (S - 1))
+
+
 def make_optimizer(cfg: TransformerConfig):
     return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
 
@@ -354,15 +414,24 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
     the per-layer load-balancing aux losses (flax "losses" collection)
     are summed into the objective (≙ Switch Transformer training)."""
 
+    fused = cfg.loss_chunks > 0
+
+    def objective(out, params, tokens):
+        if fused:
+            return fused_next_token_loss(out, params["embed"], tokens,
+                                         num_chunks=cfg.loss_chunks,
+                                         compute_dtype=cfg.dtype)
+        return next_token_loss(out, tokens)
+
     def loss_fn(params, tokens):
         if cfg.moe_experts > 0:
-            logits, out_vars = model.apply({"params": params}, tokens,
-                                           mutable=["losses"])
+            out, out_vars = model.apply({"params": params}, tokens, fused,
+                                        mutable=["losses"])
             aux = sum(jnp.sum(leaf) for leaf in
                       jax.tree_util.tree_leaves(out_vars.get("losses", {})))
-            return next_token_loss(logits, tokens) + aux
-        logits = model.apply({"params": params}, tokens)
-        return next_token_loss(logits, tokens)
+            return objective(out, params, tokens) + aux
+        out = model.apply({"params": params}, tokens, fused)
+        return objective(out, params, tokens)
 
     def train_step(state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"],

@@ -22,7 +22,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -105,7 +105,7 @@ def make_pipelined_fn(mesh: Mesh, stage_fn: Callable, *,
             inner, mesh=mesh,
             in_specs=(param_spec, data_spec),
             out_specs=data_spec,
-            check_rep=False)(stacked_params, x_mb)
+            check_vma=False)(stacked_params, x_mb)
 
     return run
 

@@ -322,7 +322,16 @@ class Strategy:
             mesh, self.data_axis_names, communication_options)
         self.extended = StrategyExtended(self)
         self._variables: list[DistributedVariable] = []
-        self._run_cache: dict = {}
+        # Bounded LRU of compiled run() programs. The BOUND is the real
+        # protection: each entry's compiled fn closes over its variables,
+        # pinning them (and their device arrays) until eviction — so the
+        # cache holds at most _run_cache_size programs' worth. Keys use
+        # weakref tokens rather than raw id()s for hygiene (an id can be
+        # reused by a new object after GC; a weakref cannot compare equal
+        # to a different object's ref).
+        import collections
+        self._run_cache: "collections.OrderedDict" = collections.OrderedDict()
+        self._run_cache_size = 128
 
     # -- basic facts ------------------------------------------------------
     @property
@@ -472,14 +481,26 @@ class Strategy:
         # without this the TF-parity path would retrace every step.
         # NOTE: a lambda recreated each call defeats the cache — pass a
         # stable function object in training loops.
+        import weakref
+
+        def stable_token(v):
+            # weakref tokens cannot alias a new object after GC the way
+            # raw id()s can (two refs compare unequal once a referent
+            # dies); unweakreffable objects fall back to identity.
+            try:
+                return weakref.ref(v)
+            except TypeError:
+                return id(v)
+
         cache_key = (
             fn, args_treedef, tuple(split_mask), tuple(sharded_mask),
             tuple((x.shape, str(x.dtype)) for x in stacked),
-            tuple(id(v) for v in variables),
+            tuple(stable_token(v) for v in variables),
             tuple((tuple(v.shape), str(v.dtype)) for v in variables),
         )
         cached = self._run_cache.get(cache_key)
         if cached is not None:
+            self._run_cache.move_to_end(cache_key)
             new_var_vals, out_stacked = cached(tuple(var_vals), *stacked)
             for v, val in zip(variables, new_var_vals):
                 v._set_raw(val)
@@ -543,6 +564,8 @@ class Strategy:
             check_vma=False,
         ))
         self._run_cache[cache_key] = shard_fn
+        while len(self._run_cache) > self._run_cache_size:
+            self._run_cache.popitem(last=False)
         new_var_vals, out_stacked = shard_fn(tuple(var_vals), *stacked)
 
         for v, val in zip(variables, new_var_vals):

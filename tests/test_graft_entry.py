@@ -1,0 +1,32 @@
+"""The driver's dryrun contract must hold WITHOUT conftest's CPU forcing.
+
+Round-1 and round-2 both failed MULTICHIP for environment reasons (mesh
+from the 1-chip default backend; eager ops dispatched to a broken TPU
+tunnel). This test reproduces the driver scenario: a parent process with
+no XLA_FLAGS / JAX_PLATFORMS set calls dryrun_multichip(8), which must
+succeed via its scrubbed-env subprocess layer.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.multiprocess
+def test_dryrun_multichip_without_env_forcing():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "DTX_DRYRUN_IN_SUBPROCESS")}
+    env["PALLAS_AXON_POOL_IPS"] = ""   # keep the test off the TPU tunnel
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"),
+         "--dryrun", "8"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    oks = re.findall(r"dryrun_multichip\(8\): .+ ok", proc.stdout)
+    assert len(oks) == 7, proc.stdout
