@@ -19,3 +19,7 @@ from distributed_tensorflow_tpu.coordinator.distribute_coordinator import (
     WorkerContext,
     run_distribute_coordinator,
 )
+from distributed_tensorflow_tpu.coordinator.evaluator import (
+    SidecarEvaluator,
+    train_and_evaluate,
+)

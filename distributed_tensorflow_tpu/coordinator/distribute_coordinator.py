@@ -79,9 +79,18 @@ def run_distribute_coordinator(
                                          task_type=task_type or "",
                                          task_id=task_id or 0)
 
-    if mode is CoordinatorMode.INDEPENDENT_WORKER and cluster_spec:
+    from distributed_tensorflow_tpu.cluster.resolver import EVALUATOR
+    if (mode is CoordinatorMode.INDEPENDENT_WORKER and cluster_spec
+            and task_type != EVALUATOR):
+        # The evaluator task is its own single-task world (≙ the
+        # reference's "evaluator" special case :627): it must never join
+        # the SPMD rendezvous or trainers' collectives would wait on it.
         bootstrap.initialize(resolver=resolver)
 
     ctx = WorkerContext(strategy, cluster_spec, task_type, task_id)
+    if strategy is None:
+        # strategy-less orchestration (the worker_fn builds its own
+        # sharded programs, e.g. train_and_evaluate roles)
+        return worker_fn(ctx)
     with strategy.scope():
         return worker_fn(ctx)

@@ -1,0 +1,149 @@
+"""Sidecar evaluator task + ``train_and_evaluate`` orchestration.
+
+≙ the Estimator-era continuous-evaluation orchestration the reference
+runs through ``run_distribute_coordinator``
+(tensorflow/python/distribute/distribute_coordinator.py:627 — the
+"evaluator" task runs eval in its own single-task world while
+chief/workers train) and the keras sidecar evaluator
+(tf_keras SidecarEvaluator: watch a checkpoint directory, evaluate every
+new checkpoint, write summaries, stop at a final step).
+
+TPU-native shape: the evaluator is a process OUTSIDE the SPMD world — it
+never joins ``jax.distributed`` (the trainers' collectives must not wait
+on it) and sees training progress only through the checkpoint directory,
+whose index-last commit protocol (checkpoint/checkpoint.py) guarantees
+it can only observe complete checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Callable
+
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpoint,
+    latest_checkpoint,
+)
+
+
+class SidecarEvaluator:
+    """Continuously evaluate every new checkpoint in a directory.
+
+    ``eval_fn(checkpoint, step) -> dict[str, float]`` runs after the
+    checkpoint is restored in place; returned metrics are written as TB
+    scalars to ``summary_dir`` (utils/summary.py — real event files).
+
+    Stops when a checkpoint with number >= ``final_step`` has been
+    evaluated (≙ the reference stopping at the final checkpoint), or
+    after ``idle_timeout_s`` with nothing new (trainer died).
+    """
+
+    def __init__(self, checkpoint: Checkpoint, directory: str,
+                 eval_fn: Callable[[Checkpoint, int], dict],
+                 *, checkpoint_name: str = "ckpt",
+                 summary_dir: str | None = None,
+                 poll_interval_s: float = 0.5,
+                 final_step: int | None = None,
+                 idle_timeout_s: float = 120.0):
+        self._checkpoint = checkpoint
+        self._directory = directory
+        self._eval_fn = eval_fn
+        self._name = checkpoint_name
+        self._summary_dir = summary_dir
+        self._poll_s = poll_interval_s
+        self._final_step = final_step
+        self._idle_timeout_s = idle_timeout_s
+
+    @staticmethod
+    def _step_of(path: str) -> int:
+        m = re.search(r"-(\d+)$", path)
+        return int(m.group(1)) if m else -1
+
+    def run(self) -> list[tuple[int, dict]]:
+        """The evaluator loop; returns [(step, metrics), ...] evaluated."""
+        writer = None
+        if self._summary_dir is not None:
+            from distributed_tensorflow_tpu.utils.summary import (
+                SummaryWriter)
+            writer = SummaryWriter(self._summary_dir,
+                                   filename_suffix=".eval")
+        evaluated: list[tuple[int, dict]] = []
+        seen: set[str] = set()
+        deadline = time.monotonic() + self._idle_timeout_s
+        try:
+            while True:
+                path = latest_checkpoint(self._directory, self._name)
+                if path is not None and path not in seen:
+                    seen.add(path)
+                    step = self._step_of(path)
+                    try:
+                        restored = self._checkpoint.restore(path)
+                    except (OSError, KeyError, ValueError):
+                        # rotation race: the trainer swept this
+                        # checkpoint mid-restore — skip it, the next
+                        # poll sees a newer one (tf_keras
+                        # SidecarEvaluator tolerates this the same way)
+                        continue
+                    # restore() assigns variables in place but returns
+                    # plain leaves; fold top-level ones back into the
+                    # checkpoint so eval_fn sees the restored state
+                    for name, val in restored.items():
+                        obj = self._checkpoint._objects.get(name)
+                        if obj is not None and not hasattr(obj, "assign"):
+                            self._checkpoint._objects[name] = val
+                    metrics = self._eval_fn(self._checkpoint, step) or {}
+                    if writer is not None:
+                        writer.scalars(
+                            {f"eval/{k}": float(v)
+                             for k, v in metrics.items()}, step)
+                        writer.flush()
+                    evaluated.append((step, metrics))
+                    deadline = time.monotonic() + self._idle_timeout_s
+                    if (self._final_step is not None
+                            and step >= self._final_step):
+                        return evaluated
+                elif time.monotonic() > deadline:
+                    return evaluated          # trainer gone quiet: stop
+                else:
+                    time.sleep(self._poll_s)
+        finally:
+            if writer is not None:
+                writer.close()
+
+
+def train_and_evaluate(train_fn: Callable, eval_fn: Callable, strategy,
+                       cluster_spec=None, task_type: str | None = None,
+                       task_id: int | None = None) -> Any:
+    """Role dispatch for ported ``tf.estimator.train_and_evaluate``
+    scripts (≙ distribute_coordinator.py:627 orchestration): every task
+    calls this with its own TF_CONFIG; chief/worker tasks run
+    ``train_fn(context)`` inside the connected SPMD world, the
+    ``evaluator`` task runs ``eval_fn(context)`` in its own single-task
+    world WITHOUT joining the distributed runtime.
+
+    Both callbacks receive a ``WorkerContext``; the evaluator's context
+    has ``task_type == "evaluator"`` and typically constructs a
+    :class:`SidecarEvaluator` over the shared checkpoint directory.
+    """
+    from distributed_tensorflow_tpu.cluster.resolver import (
+        ClusterSpec, EVALUATOR, SimpleClusterResolver,
+        TFConfigClusterResolver)
+    from distributed_tensorflow_tpu.coordinator.distribute_coordinator \
+        import WorkerContext, run_distribute_coordinator
+
+    if isinstance(cluster_spec, dict):
+        cluster_spec = ClusterSpec(cluster_spec)
+    if cluster_spec is None:
+        resolver = TFConfigClusterResolver()
+        cluster_spec = resolver.cluster_spec()
+        task_type = task_type or resolver.task_type
+        task_id = task_id if task_id is not None else resolver.task_id
+
+    if task_type == EVALUATOR:
+        ctx = WorkerContext(strategy, cluster_spec, task_type, task_id)
+        return eval_fn(ctx)
+    return run_distribute_coordinator(
+        train_fn, strategy, cluster_spec=cluster_spec,
+        task_type=task_type, task_id=task_id)

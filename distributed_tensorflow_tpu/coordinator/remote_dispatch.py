@@ -143,6 +143,11 @@ class RemoteLane:
         # directly (per-worker resource creation): seq allocation must
         # be atomic or two callers share a task slot
         self._seq_lock = threading.Lock()
+        # Consumed-seq bookkeeping for the restart watermark: this lane
+        # is the SOLE writer of done/<w> (a single writer cannot race
+        # itself), advancing it to the contiguous consumed prefix.
+        self._consumed: set[int] = set()
+        self._watermark = 0
         self._last_hb: bytes | None = None
         self._last_change = time.monotonic()
 
@@ -215,19 +220,29 @@ class RemoteLane:
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"closure {seq} on worker {self.worker_id} timed out")
-        # Consume: bump the worker's watermark past this seq FIRST, then
-        # delete the task/result keys. If the worker died after publishing
-        # the result but before advancing its own watermark, a restarted
-        # worker would otherwise block forever on the already-deleted task
-        # key (heartbeat alive, lane hung). Advance-only: never regress a
-        # watermark the worker has already pushed further.
-        dkey = _done_key(self.generation, self.worker_id)
-        try:
-            cur = self.agent.key_value_try_get(dkey)
-            if cur is None or int(cur) < seq + 1:
-                self.agent.key_value_set(dkey, str(seq + 1))
-        except Exception:
-            pass
+        # Consume: advance the restart watermark BEFORE deleting the
+        # task/result keys, so a restarted worker can never block on a
+        # task key this consume already deleted. The lane is the single
+        # writer of done/<w>, advancing it to the CONTIGUOUS consumed
+        # prefix under the lane lock (two lane threads consuming
+        # different seqs cannot regress it; a completed-but-unconsumed
+        # seq above the watermark is simply re-run after a restart —
+        # its task key still exists and re-publishing the result is
+        # idempotent at-least-once, the reference's retry semantics).
+        with self._seq_lock:
+            self._consumed.add(seq)
+            advanced = False
+            while self._watermark in self._consumed:
+                self._consumed.discard(self._watermark)
+                self._watermark += 1
+                advanced = True
+            if advanced:
+                try:
+                    self.agent.key_value_set(
+                        _done_key(self.generation, self.worker_id),
+                        str(self._watermark))
+                except Exception:
+                    pass
         for k in (rkey, _task_key(self.generation, self.worker_id, seq)):
             try:
                 self.agent.key_value_delete(k)
@@ -388,11 +403,12 @@ class RemoteWorkerService:
                     resp = pickle.dumps(("ok", result))
                 except BaseException:
                     resp = pickle.dumps(("error", traceback.format_exc()))
+                # The coordinator (sole watermark writer) advances
+                # done/<w> as it consumes; the worker only publishes the
+                # result and moves on.
                 self.agent.key_value_set(
                     _result_key(gen, self.worker_id, seq), resp)
                 seq += 1
-                self.agent.key_value_set(_done_key(gen, self.worker_id),
-                                         str(seq))
         finally:
             self._stop.set()
 

@@ -214,6 +214,52 @@ def make_sharded_train_step(cfg: WideDeepConfig, mesh: Mesh,
     return state, wrapped
 
 
+def build_feature_config(cfg: WideDeepConfig):
+    """The Wide&Deep feature/table layout for the embedding API: a deep
+    table (embed_dim) and a dim-1 wide table (combiner=sum) per
+    categorical column, each with per-table Adagrad (≙ the feature_config
+    trees passed to reference tpu_embedding_v2.py:76)."""
+    from distributed_tensorflow_tpu import embedding as emb_lib
+    deep_tables = [emb_lib.TableConfig(v, cfg.embed_dim, name=f"table_{i}",
+                                       optimizer=emb_lib.Adagrad(
+                                           cfg.learning_rate))
+                   for i, v in enumerate(cfg.vocab_sizes)]
+    wide_tables = [emb_lib.TableConfig(v, 1, name=f"wide_{i}",
+                                       combiner="sum",
+                                       optimizer=emb_lib.Adagrad(
+                                           cfg.learning_rate))
+                   for i, v in enumerate(cfg.vocab_sizes)]
+    return {
+        "deep": tuple(emb_lib.FeatureConfig(t, name=f"deep_{i}")
+                      for i, t in enumerate(deep_tables)),
+        "wide": tuple(emb_lib.FeatureConfig(t, name=f"wide_{i}")
+                      for i, t in enumerate(wide_tables)),
+    }
+
+
+def _embedding_loss_fn(cfg: WideDeepConfig, feature_config, model):
+    """Shared W&D-through-embedding-API objective: deep acts into the
+    dense tower, wide acts summed into the logit, sigmoid CE."""
+    from distributed_tensorflow_tpu import embedding as emb_lib
+    n_tables = len(cfg.vocab_sizes)
+
+    def loss_fn(dense_params, tables, batch):
+        feats = {
+            "deep": tuple(batch["categorical"][:, i]
+                          for i in range(n_tables)),
+            "wide": tuple(batch["categorical"][:, i]
+                          for i in range(n_tables)),
+        }
+        acts = emb_lib.lookup(tables, feature_config, feats)
+        logits = model.apply({"params": dense_params},
+                             list(acts["deep"]), batch["dense"])
+        logits = logits + sum(w[:, 0] for w in acts["wide"])
+        return optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"].astype(jnp.float32)).mean()
+
+    return loss_fn
+
+
 class WideDeepDense(nn.Module):
     """The dense tower only: consumes PRE-LOOKED-UP embedding activations
     (the TPUEmbedding API path — ≙ how reference DLRM models consume
@@ -246,21 +292,7 @@ def make_embedding_train_step(cfg: WideDeepConfig, mesh: Mesh,
     """
     from distributed_tensorflow_tpu import embedding as emb_lib
 
-    deep_tables = [emb_lib.TableConfig(v, cfg.embed_dim, name=f"table_{i}",
-                                       optimizer=emb_lib.Adagrad(
-                                           cfg.learning_rate))
-                   for i, v in enumerate(cfg.vocab_sizes)]
-    wide_tables = [emb_lib.TableConfig(v, 1, name=f"wide_{i}",
-                                       combiner="sum",
-                                       optimizer=emb_lib.Adagrad(
-                                           cfg.learning_rate))
-                   for i, v in enumerate(cfg.vocab_sizes)]
-    feature_config = {
-        "deep": tuple(emb_lib.FeatureConfig(t, name=f"deep_{i}")
-                      for i, t in enumerate(deep_tables)),
-        "wide": tuple(emb_lib.FeatureConfig(t, name=f"wide_{i}")
-                      for i, t in enumerate(wide_tables)),
-    }
+    feature_config = build_feature_config(cfg)
 
     rng = jax.random.PRNGKey(seed)
     rng, emb_rng, dense_rng = jax.random.split(rng, 3)
@@ -295,19 +327,7 @@ def make_embedding_train_step(cfg: WideDeepConfig, mesh: Mesh,
     batch_shardings = {"dense": batch_sh, "categorical": batch_sh,
                        "label": batch_sh}
 
-    def loss_fn(dense_params, tables, batch):
-        feats = {
-            "deep": tuple(batch["categorical"][:, i]
-                          for i in range(n_tables)),
-            "wide": tuple(batch["categorical"][:, i]
-                          for i in range(n_tables)),
-        }
-        acts = emb_lib.lookup(tables, feature_config, feats)
-        logits = model.apply({"params": dense_params},
-                             list(acts["deep"]), batch["dense"])
-        logits = logits + sum(w[:, 0] for w in acts["wide"])
-        return optax.sigmoid_binary_cross_entropy(
-            logits, batch["label"].astype(jnp.float32)).mean()
+    loss_fn = _embedding_loss_fn(cfg, feature_config, model)
 
     def train_step(state, batch):
         loss, (dgrads, tgrads) = jax.value_and_grad(
@@ -343,3 +363,160 @@ def synthetic_clicks(cfg: WideDeepConfig, n: int, seed: int = 0):
     label = (score > np.median(score)).astype("int32")
     return {"dense": jnp.asarray(dense), "categorical": jnp.asarray(cat),
             "label": jnp.asarray(label)}
+
+
+# ---------------------------------------------------------------------------
+# Async parameter-server composition (BASELINE.md config #4):
+# embedding API tables + dense tower, trained asynchronously through the
+# ClusterCoordinator's remote dispatch. ≙ parameter_server_strategy_v2.py:77
+# (coordinator-owned variables, worker-computed steps) composed with
+# tpu_embedding_v2.py:76 (feature_config-driven tables) — the two APIs the
+# reference's config #4 uses together.
+#
+# Topology: the coordinator process owns the "server copy" of all state
+# (tables + slots + dense params + optax state); workers hold per-worker
+# datasets and compute gradients for whatever parameter snapshot each
+# scheduled closure carries; the coordinator applies gradients AS RESULTS
+# ARRIVE — the async-PS staleness semantics (a gradient may be computed
+# against parameters a few updates old, exactly like the reference's
+# unsynchronized PS reads/writes).
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=4)
+def _ps_feature_config(cfg: WideDeepConfig):
+    return build_feature_config(cfg)
+
+
+@_functools.lru_cache(maxsize=4)
+def _ps_optimizer(cfg: WideDeepConfig):
+    return make_optimizer(cfg)
+
+
+def ps_init_state(cfg: WideDeepConfig, seed: int = 0) -> dict:
+    """Coordinator-side server copy of the full DLRM state (host arrays —
+    small enough to ship inside scheduled closures; bulk activations
+    never leave the workers)."""
+    from distributed_tensorflow_tpu import embedding as emb_lib
+    rng = jax.random.PRNGKey(seed)
+    rng, emb_rng, dense_rng = jax.random.split(rng, 3)
+    feature_config = build_feature_config(cfg)
+    emb_state = emb_lib.create_state(feature_config, rng=emb_rng)
+    model = WideDeepDense(cfg)
+    n_tables = len(cfg.vocab_sizes)
+    sample_acts = [jnp.zeros((2, cfg.embed_dim)) for _ in range(n_tables)]
+    sample_dense = jnp.zeros((2, cfg.num_dense_features))
+    dense_params = model.init(dense_rng, sample_acts,
+                              sample_dense)["params"]
+    tx = make_optimizer(cfg)
+    return {"dense": {"params": dense_params,
+                      "opt_state": tx.init(dense_params)},
+            "emb": emb_state}
+
+
+@_functools.lru_cache(maxsize=4)
+def _ps_grad_program(cfg: WideDeepConfig):
+    """Worker-side compiled grad program, built once per process (the
+    worker's analogue of the reference's per-worker function library)."""
+    feature_config = build_feature_config(cfg)
+    model = WideDeepDense(cfg)
+    loss_fn = _embedding_loss_fn(cfg, feature_config, model)
+    return jax.jit(jax.value_and_grad(
+        lambda dp, tabs, batch: loss_fn(dp, tabs, batch),
+        argnums=(0, 1)))
+
+
+def ps_worker_grads(cfg: WideDeepConfig, dense_params, tables, it):
+    """Runs ON a worker (scheduled closure): pull the next batch from
+    THIS worker's dataset iterator (a per-worker resource handle) and
+    return (loss, dense grads, table grads) as host arrays."""
+    batch = next(it)
+    loss, (dgrads, tgrads) = _ps_grad_program(cfg)(dense_params, tables,
+                                                   batch)
+    host = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+    return host(loss), host(dgrads), host(tgrads)
+
+
+def ps_apply_grads(cfg: WideDeepConfig, state: dict, dgrads,
+                   tgrads) -> dict:
+    """Coordinator-side asynchronous apply: update the CURRENT server
+    copy with a (possibly stale) worker gradient."""
+    from distributed_tensorflow_tpu import embedding as emb_lib
+    tx = _ps_optimizer(cfg)
+    updates, opt_state = tx.update(dgrads, state["dense"]["opt_state"],
+                                   state["dense"]["params"])
+    dense_params = optax.apply_updates(state["dense"]["params"], updates)
+    emb = emb_lib.apply_gradients(state["emb"], tgrads,
+                                  _ps_feature_config(cfg))
+    return {"dense": {"params": dense_params, "opt_state": opt_state},
+            "emb": emb}
+
+
+def train_dlrm_async_ps(cfg: WideDeepConfig, coord, *, steps: int,
+                        batch_size: int = 32, max_in_flight: int = 4,
+                        dataset_seed: int = 0, log_every: int = 0,
+                        on_step=None):
+    """Drive config #4 end-to-end: per-worker datasets live on the
+    workers, grad closures are scheduled across them with transparent
+    preemption retry, and the coordinator folds results into the server
+    copy as they land. Returns (final_state, losses).
+
+    ``coord`` is a ClusterCoordinator (local lanes or remote worker
+    processes — the same loop runs over both transports).
+    """
+    state = ps_init_state(cfg)
+    dataset_fn = _functools.partial(_ps_dataset, cfg, batch_size,
+                                    dataset_seed)
+    per_worker_it = coord.create_per_worker_dataset(dataset_fn)
+    losses: list = []
+    in_flight: list = []
+    scheduled = 0
+    while scheduled < steps or in_flight:
+        while scheduled < steps and len(in_flight) < max_in_flight:
+            rv = coord.schedule(
+                ps_worker_grads,
+                args=(cfg, state["dense"]["params"],
+                      state["emb"]["tables"], per_worker_it))
+            in_flight.append(rv)
+            scheduled += 1
+        rv = in_flight.pop(0)
+        loss, dgrads, tgrads = rv.fetch()
+        state = ps_apply_grads(cfg, state, dgrads, tgrads)
+        losses.append(float(loss))
+        if on_step is not None:
+            on_step(len(losses))
+        if log_every and len(losses) % log_every == 0:
+            recent = losses[-log_every:]
+            print(f"step {len(losses):4d}  loss "
+                  f"{sum(recent) / len(recent):.4f}", flush=True)
+    return state, losses
+
+
+_LOCAL_DS_COUNTER = iter(range(1 << 30))
+
+
+def _ps_dataset(cfg: WideDeepConfig, batch_size: int, seed: int):
+    """Per-worker dataset factory (runs on the worker): an endless
+    shuffled stream over the synthetic click data. Each worker's stream
+    is decorrelated by its worker id (remote lanes) or a process-local
+    counter (thread lanes) — N workers must not feed N clones of the
+    same batch sequence (≙ the reference's per-worker dataset_fn
+    receiving a distinct InputContext.input_pipeline_id)."""
+    from distributed_tensorflow_tpu.coordinator.remote_dispatch import (
+        current_worker_service)
+    svc = current_worker_service()
+    wid = svc.worker_id if svc is not None else next(_LOCAL_DS_COUNTER)
+    seed = seed * 1009 + wid
+    data = synthetic_clicks(cfg, 1024, seed=seed)
+    data = {k: np.asarray(v) for k, v in data.items()}
+    n = data["label"].shape[0]
+
+    def gen():
+        rng = np.random.default_rng(seed)
+        while True:
+            idx = rng.integers(0, n, size=batch_size)
+            yield {k: jnp.asarray(v[idx]) for k, v in data.items()}
+
+    return gen()

@@ -54,7 +54,8 @@ def pick_unused_port() -> int:
 
 
 def create_cluster_spec(num_workers: int = 1, num_ps: int = 0,
-                        has_chief: bool = False) -> dict[str, list[str]]:
+                        has_chief: bool = False,
+                        has_evaluator: bool = False) -> dict[str, list[str]]:
     """≙ multi_worker_test_base.create_cluster_spec: localhost addresses
     with freshly picked ports."""
     spec: dict[str, list[str]] = {}
@@ -66,6 +67,8 @@ def create_cluster_spec(num_workers: int = 1, num_ps: int = 0,
     if num_ps:
         spec["ps"] = [f"127.0.0.1:{pick_unused_port()}"
                       for _ in range(num_ps)]
+    if has_evaluator:
+        spec["evaluator"] = [f"127.0.0.1:{pick_unused_port()}"]
     return spec
 
 
@@ -309,13 +312,15 @@ class MultiProcessRunner:
 
 
 def run(fn: Callable, *, num_workers: int = 2, num_ps: int = 0,
-        has_chief: bool = False, args: tuple = (), kwargs: dict | None = None,
+        has_chief: bool = False, has_evaluator: bool = False,
+        args: tuple = (), kwargs: dict | None = None,
         env: Mapping[str, str] | None = None, devices_per_process: int = 1,
         timeout: float = 300.0) -> MultiProcessRunnerResult:
     """One-call form (≙ multi_process_runner.run :1332): build a localhost
     cluster spec, start every task, join, return results."""
     spec = create_cluster_spec(num_workers=num_workers, num_ps=num_ps,
-                               has_chief=has_chief)
+                               has_chief=has_chief,
+                               has_evaluator=has_evaluator)
     runner = MultiProcessRunner(
         fn, spec, args=args, kwargs=kwargs, env=env,
         devices_per_process=devices_per_process, timeout=timeout)

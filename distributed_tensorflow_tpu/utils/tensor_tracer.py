@@ -16,6 +16,15 @@ TPU-native design — two complementary instruments:
   ``capture_intermediates`` and reduces every intermediate to the same
   statistics, returning a :class:`TraceReport` (≙ tensor_tracer_report's
   per-tensor table) that can locate e.g. the first NaN-producing module.
+- :func:`instrument` / :func:`trace_fn` — WHOLE-PROGRAM instrumentation
+  of any jittable function, no annotations required (≙ the reference's
+  per-op graph rewrite, tensor_tracer.py:1431 ``trace``): the function's
+  jaxpr is re-traced with the stats bundle attached to EVERY equation's
+  outputs (recursing through jit/remat/custom-grad sub-jaxprs), each
+  entry named by primitive + source line. Filterable by op-type/name
+  regex (≙ --trace_mode/--included_ops flags), report writable to a
+  file (≙ tensor_tracer_report.py), ``TraceReport.first_nan()`` is the
+  first-NaN localizer.
 """
 
 from __future__ import annotations
@@ -104,6 +113,18 @@ class TraceReport:
                 f"{int(s['nan_count']):6d} {int(s['inf_count']):6d}")
         return "\n".join(lines)
 
+    def write(self, path: str) -> str:
+        """Write the per-tensor table to ``path`` (≙ the reference's
+        trace report file, tensor_tracer_report.py ``create_report``)."""
+        import os
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(str(self) + "\n")
+            bad = self.first_nan()
+            f.write(f"\nfirst_nan: {bad}\n" if bad
+                    else "\nfirst_nan: none\n")
+        return path
+
 
 class TensorTracer:
     """Collects :func:`trace_point` events (≙ the tensor_tracer session).
@@ -170,3 +191,149 @@ def find_first_nan(module, variables, *args, **kwargs) -> "str | None":
     (the reference's headline debugging use case)."""
     _, report = trace_flax(module, variables, *args, **kwargs)
     return report.first_nan()
+
+
+# ---------------------------------------------------------------------------
+# Whole-program jaxpr instrumentation (≙ tensor_tracer.py per-op rewrite)
+# ---------------------------------------------------------------------------
+
+# Call-like primitives whose sub-jaxpr is inlined and instrumented too.
+# scan/while/cond are deliberately NOT entered: re-binding their bodies
+# per-equation would change trip semantics; their OUTPUTS are traced.
+# For per-op coverage INSIDE scanned transformer layers, trace with
+# cfg.scan_layers=False — the unrolled graph is exactly what the
+# reference instruments (its TF graphs are always layer-unrolled).
+_CALL_PRIMITIVES = {"jit", "pjit", "closed_call", "core_call",
+                    "remat", "remat2", "checkpoint",
+                    "custom_jvp_call", "custom_vjp_call",
+                    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr"}
+
+_SKIP_PRIMITIVES = {"debug_callback"}      # don't trace our own probes
+
+
+def _numeric_aval(aval) -> bool:
+    try:
+        return np.issubdtype(aval.dtype, np.number)
+    except Exception:
+        return False                       # PRNG keys, tokens, ...
+
+
+def instrument(fn: Callable, *, op_regex: "str | None" = None,
+               name_regex: "str | None" = None,
+               max_traced: "int | None" = None) -> Callable:
+    """Wrap ``fn`` so EVERY intermediate tensor is traced — no model
+    annotations needed (≙ the reference instrumenting every op of the
+    compiled TPU program, tensor_tracer.py:1431).
+
+    The wrapper stages ``fn`` to a jaxpr, then re-traces it equation by
+    equation, attaching the on-device stats bundle (via
+    :func:`trace_point`) to each numeric output; jit/remat/custom-grad
+    sub-jaxprs are entered recursively, so scan-layers models still get
+    per-op coverage of the layer body. The result is itself jittable;
+    run it under a :class:`TensorTracer` context to collect.
+
+    ``op_regex`` filters by primitive name (≙ --included_ops),
+    ``name_regex`` by the full entry name incl. source file:line,
+    ``max_traced`` caps the number of instrumented equations.
+    Forward-pass instrumentation: differentiating the wrapper re-derives
+    gradients through the INLINED sub-jaxprs (custom_vjp rules are not
+    re-attached), so use it for inference/loss numerics, not training.
+    """
+    import re as _re
+    from jax._src import source_info_util
+
+    op_re = _re.compile(op_regex) if op_regex else None
+    name_re = _re.compile(name_regex) if name_regex else None
+    from jax.extend import core as jexc
+
+    def wrapped(*args, **kwargs):
+        flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+        closed, out_shape = jax.make_jaxpr(
+            lambda *a: fn(*jax.tree_util.tree_unflatten(in_tree, a)[0],
+                          **jax.tree_util.tree_unflatten(in_tree, a)[1]),
+            return_shape=True)(*flat_args)
+        out_tree = jax.tree_util.tree_structure(out_shape)
+        counter = {"n": 0, "traced": 0}
+
+        def read(env, v):
+            return v.val if isinstance(v, jexc.Literal) else env[id(v)]
+
+        def eval_jaxpr(jaxpr, consts, args, prefix):
+            env: dict = {}
+            for v, c in zip(jaxpr.constvars, consts):
+                env[id(v)] = c
+            for v, a in zip(jaxpr.invars, args):
+                env[id(v)] = a
+            for eqn in jaxpr.eqns:
+                prim = eqn.primitive
+                invals = [read(env, v) for v in eqn.invars]
+                sub = None
+                if prim.name in _CALL_PRIMITIVES:
+                    sub = (eqn.params.get("jaxpr")
+                           or eqn.params.get("call_jaxpr")
+                           or eqn.params.get("fun_jaxpr"))
+                if sub is not None:
+                    if hasattr(sub, "jaxpr"):      # ClosedJaxpr
+                        sub_jaxpr, sub_consts = sub.jaxpr, sub.consts
+                    else:
+                        sub_jaxpr, sub_consts = sub, []
+                    sub_name = eqn.params.get("name", prim.name)
+                    outs = eval_jaxpr(sub_jaxpr, sub_consts, invals,
+                                      f"{prefix}{sub_name}/")
+                else:
+                    outs = prim.bind(*invals, **eqn.params)
+                    if not prim.multiple_results:
+                        outs = [outs]
+                    if prim.name not in _SKIP_PRIMITIVES:
+                        src = source_info_util.summarize(eqn.source_info)
+                        for j, (var, val) in enumerate(
+                                zip(eqn.outvars, outs)):
+                            if not _numeric_aval(var.aval):
+                                continue
+                            idx = counter["n"]
+                            counter["n"] += 1
+                            tag = ("" if len(eqn.outvars) == 1
+                                   else f".{j}")
+                            name = (f"{idx:04d} {prefix}{prim.name}{tag} "
+                                    f"{src}")
+                            if op_re and not op_re.search(prim.name):
+                                continue
+                            if name_re and not name_re.search(name):
+                                continue
+                            if (max_traced is not None
+                                    and counter["traced"] >= max_traced):
+                                continue
+                            counter["traced"] += 1
+                            outs[j] = trace_point(name, val, enabled=True)
+                for var, val in zip(eqn.outvars, outs):
+                    env[id(var)] = val
+            return [read(env, v) for v in jaxpr.outvars]
+
+        flat_out = eval_jaxpr(closed.jaxpr, closed.consts, flat_args, "")
+        return jax.tree_util.tree_unflatten(out_tree, flat_out)
+
+    return wrapped
+
+
+def trace_fn(fn: Callable, *args, report_path: "str | None" = None,
+             op_regex: "str | None" = None,
+             name_regex: "str | None" = None,
+             max_traced: "int | None" = None, **kwargs):
+    """One-shot whole-program trace: run ``fn(*args, **kwargs)`` fully
+    instrumented, return ``(outputs, TraceReport)`` and optionally write
+    the report file (≙ tensor_tracer_report.py's on-disk report).
+
+        out, report = trace_fn(train_step, state, batch,
+                               report_path="/tmp/tt/report.txt")
+        report.first_nan()   # "0042 layers/mul <file>:<line> ..." or None
+    """
+    inst = instrument(fn, op_regex=op_regex, name_regex=name_regex,
+                      max_traced=max_traced)
+    tt = TensorTracer()
+    with tt:
+        out = inst(*args, **kwargs)
+        out = jax.block_until_ready(out)
+    report = tt.report()
+    if report_path is not None:
+        report.write(report_path)
+    return out, report

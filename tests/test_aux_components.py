@@ -313,3 +313,79 @@ def test_run_distribute_coordinator_standalone(devices):
         worker_fn, dtx.MirroredStrategy(),
         mode=CoordinatorMode.STANDALONE_CLIENT)
     assert out == 1.0
+
+
+def test_instrument_traces_every_equation(devices):
+    """Whole-program jaxpr instrumentation: every numeric intermediate
+    gets a stats entry, no annotations (≙ tensor_tracer.py per-op
+    rewrite), and the wrapper stays jit-compatible."""
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.utils.tensor_tracer import trace_fn
+
+    def f(x):
+        y = jnp.sin(x) * 2.0
+        z = jax.jit(lambda a: a + 1.0)(y)   # entered recursively
+        return z.sum()
+
+    out, report = trace_fn(f, jnp.ones((4, 4)))
+    np.testing.assert_allclose(float(out),
+                               float((jnp.sin(jnp.ones((4, 4))) * 2
+                                      + 1).sum()), rtol=1e-6)
+    names = [n for n, _ in report.entries]
+    assert any("sin" in n for n in names), names
+    assert any("mul" in n for n in names), names
+    assert any("add" in n for n in names), names        # inside the jit
+    assert any("reduce_sum" in n for n in names), names
+    # source-location suffix present (file:line localization)
+    assert any(".py" in n for n in names), names
+
+
+def test_instrument_filters_and_report_file(tmp_path, devices):
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.utils.tensor_tracer import trace_fn
+
+    def f(x):
+        return (jnp.sin(x) * jnp.cos(x)).sum()
+
+    _, report = trace_fn(f, jnp.ones((8,)), op_regex="sin|cos",
+                         report_path=str(tmp_path / "tt" / "report.txt"))
+    names = [n for n, _ in report.entries]
+    assert names and all(("sin" in n or "cos" in n) for n in names), names
+    text = (tmp_path / "tt" / "report.txt").read_text()
+    assert "first_nan: none" in text
+
+
+def test_instrument_locates_injected_nan_in_flagship(devices):
+    """The round-3 'done' criterion: locate an injected NaN inside the
+    flagship transformer WITHOUT any model annotation, from the jaxpr
+    alone, with a source-line report entry."""
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, synthetic_tokens)
+    from distributed_tensorflow_tpu.utils.tensor_tracer import trace_fn
+    from flax.linen import partitioning as nn_partitioning
+    from distributed_tensorflow_tpu.models.transformer import (
+        LOGICAL_AXIS_RULES)
+
+    cfg = TransformerConfig.tiny(n_layers=1)
+    model = TransformerLM(cfg)
+    tokens = synthetic_tokens(2, cfg.max_seq_len, cfg.vocab_size)
+    with nn_partitioning.axis_rules(list(LOGICAL_AXIS_RULES)):
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    # poison ONE weight deep inside the stacked layers
+    bad = jax.tree_util.tree_map(lambda x: x, params)
+    wi = np.array(bad["layers"]["mlp"]["wi"])   # writable copy
+    wi[..., 0, 0] = np.nan
+    bad["layers"]["mlp"]["wi"] = jnp.asarray(wi)
+
+    def fwd(params, tokens):
+        with nn_partitioning.axis_rules(list(LOGICAL_AXIS_RULES)):
+            return model.apply({"params": params}, tokens).sum()
+
+    _, report = trace_fn(fwd, bad, tokens)
+    first = report.first_nan()
+    assert first is not None
+    # healthy params: no NaN anywhere
+    _, clean = trace_fn(fwd, params, tokens)
+    assert clean.first_nan() is None

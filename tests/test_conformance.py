@@ -44,3 +44,22 @@ class TestParameterServerV1Conformance(StrategyConformance):
         from distributed_tensorflow_tpu.parallel.parameter_server import (
             ParameterServerStrategyV1)
         return ParameterServerStrategyV1()
+
+
+class TestParameterServerV2Conformance(StrategyConformance):
+    """PS V2 (async dispatch model): the synchronous Strategy surface it
+    still exposes — scope/create_variable/run/reduce — must conform; the
+    async closure path is covered by tests/test_coordinator.py and the
+    multi-process suite."""
+
+    def make_strategy(self):
+        from distributed_tensorflow_tpu.parallel.parameter_server import (
+            ParameterServerStrategy)
+        return ParameterServerStrategy()
+
+
+class TestTPUStrategyConformance(StrategyConformance):
+    def make_strategy(self):
+        from distributed_tensorflow_tpu.parallel.tpu_strategy import (
+            TPUStrategy)
+        return TPUStrategy()

@@ -558,3 +558,141 @@ def test_finalize_commits_full_checkpoint_on_unequal_stops(tmp_path):
 
 
 
+
+
+def _dlrm_ps_worker(tmpdir):
+    """Config #4 composed end-to-end: DLRM through the embedding API,
+    trained async via remote dispatch across worker PROCESSES, surviving
+    one worker kill mid-run (≙ parameter_server_strategy_v2.py:77 +
+    tpu_embedding_v2.py:76 used together — BASELINE.md config #4)."""
+    from distributed_tensorflow_tpu.cluster import bootstrap
+    from distributed_tensorflow_tpu.coordinator import remote_dispatch
+    from distributed_tensorflow_tpu.coordinator.cluster_coordinator import (
+        ClusterCoordinator)
+    from distributed_tensorflow_tpu.models import wide_deep as wd
+    runtime = bootstrap.initialize()
+    if runtime.process_id != 0:
+        if runtime.process_id == 2:
+            with open(os.path.join(tmpdir, "victim_ready"), "w") as f:
+                f.write("1")
+        remote_dispatch.run_worker_loop()
+        return ("worker-done", runtime.process_id)
+
+    cfg = wd.WideDeepConfig.tiny(learning_rate=0.05)
+    coord = ClusterCoordinator(
+        remote_worker_ids=list(range(1, runtime.num_processes)))
+    while not os.path.exists(os.path.join(tmpdir, "victim_ready")):
+        time.sleep(0.1)
+
+    def on_step(n):
+        if n == 10:      # mid-run: datasets live, closures in flight
+            with open(os.path.join(tmpdir, "kill_now"), "w") as f:
+                f.write("1")           # parent kills worker 2 now
+            # block until the kill really happened so the remaining 50
+            # steps all run WITHOUT worker 2
+            deadline = time.monotonic() + 60
+            while not os.path.exists(os.path.join(tmpdir, "killed")):
+                assert time.monotonic() < deadline, "kill never confirmed"
+                time.sleep(0.05)
+
+    state, losses = wd.train_dlrm_async_ps(cfg, coord, steps=60,
+                                           batch_size=32,
+                                           max_in_flight=4,
+                                           on_step=on_step)
+    coord.shutdown()
+    first = sum(losses[:10]) / 10
+    last = sum(losses[-10:]) / 10
+    return ("coordinator", len(losses), first, last)
+
+
+@pytest.mark.multiprocess
+def test_dlrm_async_ps_end_to_end(tmp_path):
+    spec = mpr.create_cluster_spec(num_workers=3)
+    runner = mpr.MultiProcessRunner(
+        _dlrm_ps_worker, spec, args=(str(tmp_path),), timeout=300)
+    runner.start()
+    deadline = time.monotonic() + 180
+    while not (tmp_path / "kill_now").exists():
+        assert time.monotonic() < deadline, "coordinator never started"
+        time.sleep(0.1)
+    runner.terminate("worker", 2)
+    (tmp_path / "killed").write_text("1")
+    result = runner.join(timeout=300, raise_on_error=False)
+    coord = [t for t in result.tasks.values()
+             if t.error is None and t.exitcode == 0
+             and t.value and t.value[0] == "coordinator"]
+    assert coord, {k: (t.exitcode, t.error and t.error[-500:])
+                   for k, t in result.tasks.items()}
+    _, n_losses, first, last = coord[0].value
+    assert n_losses == 60          # every scheduled step completed
+    assert last < first, (first, last)     # loss still converging
+    assert result.tasks[("worker", 2)].exitcode != 0   # really killed
+
+
+def _train_and_evaluate_task(tmpdir):
+    """Role-dispatched train_and_evaluate: chief+worker train and write
+    rotating checkpoints; the evaluator task (OUTSIDE the SPMD world)
+    evaluates each one and writes TB summaries
+    (≙ distribute_coordinator.py:627 evaluator orchestration)."""
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, CheckpointManager)
+    from distributed_tensorflow_tpu.cluster import bootstrap
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator, train_and_evaluate)
+
+    FINAL = 3                               # checkpoints 1..3
+
+    def train_fn(ctx):
+        # both trainers run lockstep SPMD-style steps; the chief saves
+        runtime = bootstrap.runtime()
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        w = jnp.zeros(())
+        ckpt = Checkpoint(w=w)
+        mgr = CheckpointManager(ckpt, tmpdir, checkpoint_name="tne",
+                                max_to_keep=2)
+        for step in range(1, FINAL + 1):
+            w = w + 1.0
+            ckpt._objects["w"] = w
+            agent.barrier(f"tne_step/{step}", timeout_s=120)
+            mgr.save(checkpoint_number=step)
+            time.sleep(0.3)       # give the evaluator a rotation window
+        bootstrap.shutdown()
+        return ("trainer", runtime.process_id)
+
+    def eval_fn(ctx):
+        assert ctx.task_type == "evaluator"
+        ckpt = Checkpoint(w=jnp.zeros(()))
+        ev = SidecarEvaluator(
+            ckpt, tmpdir,
+            lambda c, step: {"w": float(np.asarray(c._objects["w"]))},
+            checkpoint_name="tne",
+            summary_dir=os.path.join(tmpdir, "eval_logs"),
+            poll_interval_s=0.1, final_step=FINAL, idle_timeout_s=90)
+        evaluated = ev.run()
+        return ("evaluator", evaluated)
+
+    return train_and_evaluate(train_fn, eval_fn, strategy=None)
+
+
+@pytest.mark.multiprocess
+def test_train_and_evaluate_with_evaluator_task(tmp_path):
+    result = mpr.run(_train_and_evaluate_task, num_workers=2,
+                     has_evaluator=True, args=(str(tmp_path),),
+                     timeout=300)
+    values = result.return_values
+    trainers = [v for v in values if v[0] == "trainer"]
+    evals = [v for v in values if v[0] == "evaluator"]
+    assert len(trainers) == 2 and len(evals) == 1, values
+    evaluated = evals[0][1]
+    steps = [s for s, _ in evaluated]
+    # the evaluator saw checkpoints as they rotated and STOPPED at the
+    # final one; metrics came from the restored state (w == step)
+    assert steps[-1] == 3, evaluated
+    for s, m in evaluated:
+        assert m["w"] == float(s), evaluated
+    # TB event file with eval scalars exists
+    logs = os.listdir(tmp_path / "eval_logs")
+    assert any("events.out.tfevents" in f for f in logs), logs
