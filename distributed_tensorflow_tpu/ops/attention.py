@@ -437,7 +437,7 @@ def sharded_flash_attention(q, k, v, mesh, *, causal: bool = False,
     """
     import math
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from distributed_tensorflow_tpu.cluster.topology import \
         attention_shard_spec
@@ -457,4 +457,4 @@ def sharded_flash_attention(q, k, v, mesh, *, causal: bool = False,
                            block_q=block_q, block_k=block_k,
                            implementation=implementation)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)

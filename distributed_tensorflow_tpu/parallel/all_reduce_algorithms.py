@@ -21,7 +21,7 @@ contribution per device), e.g.::
 
     out = shard_map(lambda x: ring_all_reduce(x, "dp"),
                     mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
-                    check_rep=False)(stacked_contributions)
+                    check_vma=False)(stacked_contributions)
 """
 
 from __future__ import annotations

@@ -35,7 +35,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops.attention import (
@@ -499,7 +499,7 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
 
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=(spec, spec, spec), out_specs=spec,
-                           check_rep=False)
+                           check_vma=False)
         def region(q, k, v):
             return striped_flash_attention(
                 q, k, v, axis_name=axis_name, block_q=block_q,
@@ -543,7 +543,7 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
                                      causal=causal, attn_fn=attn_fn)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, check_vma=False)
     def sharded(q, k, v):
         return fn(q, k, v)
 

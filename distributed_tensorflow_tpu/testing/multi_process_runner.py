@@ -72,6 +72,20 @@ def create_cluster_spec(num_workers: int = 1, num_ps: int = 0,
     return spec
 
 
+def _child_env(devices_per_process: int) -> dict[str, str]:
+    """Child-process env for a CPU-backed cluster task: force the CPU
+    platform and exactly ``devices_per_process`` host devices (scrubbing
+    any forced count inherited from the parent's XLA_FLAGS, e.g.
+    conftest's =8)."""
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count="
+                 f"{devices_per_process}")
+    env.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": " ".join(flags)})
+    return env
+
+
 @dataclasses.dataclass
 class TaskResult:
     task_type: str
@@ -191,18 +205,12 @@ class MultiProcessRunner:
         task_index = 0
         for task_type in sorted(self._spec):
             for task_id, _ in enumerate(self._spec[task_type]):
-                env = dict(os.environ)
+                env = _child_env(self._devices)
                 env.update({
                     "TF_CONFIG": json.dumps({
                         "cluster": self._spec,
                         "task": {"type": task_type, "index": task_id},
                     }),
-                    "JAX_PLATFORMS": "cpu",
-                    "XLA_FLAGS": (
-                        env.get("XLA_FLAGS", "").replace(
-                            "--xla_force_host_platform_device_count=8", "")
-                        + f" --xla_force_host_platform_device_count="
-                          f"{self._devices}"),
                     "DTX_MPR_NUM_TASKS": str(ntasks),
                     "DTX_MPR_TASK_INDEX": str(task_index),
                 })
@@ -329,3 +337,289 @@ def run(fn: Callable, *, num_workers: int = 2, num_ps: int = 0,
         return runner.join(timeout)
     finally:
         runner.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# Pool runner: persistent task processes amortizing spawn + jax import
+# ---------------------------------------------------------------------------
+
+_POOL_TASK_DIED = "pool task died without reporting"
+
+def _pool_task_cleanup():
+    """Reset per-task process state between pooled runs.
+
+    Every pooled run gets a FRESH cluster (new coordination-service ports
+    in a fresh TF_CONFIG), so between runs the child must disconnect from
+    the old service and drop the backends built against it; the next
+    run's ``bootstrap.initialize`` then rebuilds both. Framework
+    singletons that cache cluster facts are reset the same way.
+    """
+    import contextlib
+
+    import jax
+
+    with contextlib.suppress(Exception):
+        from distributed_tensorflow_tpu.cluster import bootstrap
+        bootstrap.shutdown()
+    with contextlib.suppress(Exception):
+        if jax._src.distributed.global_state.client is not None:
+            jax.distributed.shutdown()
+    with contextlib.suppress(Exception):
+        from jax._src import xla_bridge
+        xla_bridge._clear_backends()
+    jax.clear_caches()
+    with contextlib.suppress(Exception):
+        from distributed_tensorflow_tpu.cluster import coordination
+        coordination._LOCAL._kv.clear()
+        coordination._LOCAL._barriers.clear()
+    with contextlib.suppress(Exception):
+        # A coordinator's generation is per-cluster-incarnation state:
+        # carrying it into the next pooled run would skip publishing
+        # current_gen on the NEW coordination service and strand every
+        # worker loop.
+        import sys as _sys
+        rd = _sys.modules.get(
+            "distributed_tensorflow_tpu.coordinator.remote_dispatch")
+        if rd is not None:
+            rd._reset_generation_for_tests()
+
+
+def _pool_child_main(base_env: dict, conn, ready_path: str):
+    """Persistent pool-task entry: import jax ONCE, then serve tasks.
+
+    Protocol (one message per task): recv ``(env_updates, stdout_path,
+    payload)``; run; send ``("ok", value)`` / ``("error", traceback)``.
+    A ``None`` message shuts the process down.
+    ≙ multi_process_runner.MultiProcessPoolRunner's _pool_runner_worker
+    (reference multi_process_runner.py:902,1000) — persistent workers
+    pulling closures off a pipe instead of re-spawning per test.
+    """
+    os.environ.update(base_env)
+    sys.stdout.flush(); sys.stderr.flush()
+    import jax
+    jax.config.update("jax_platforms", base_env.get("JAX_PLATFORMS", "cpu"))
+    with contextlib.suppress(Exception):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    with open(ready_path, "w") as f:
+        f.write("ready")
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        if msg is None:
+            break
+        env_updates, stdout_path, payload = msg
+        out_f = open(stdout_path, "w", buffering=1)
+        os.dup2(out_f.fileno(), 1)
+        os.dup2(out_f.fileno(), 2)
+        # Hermeticity: restore every env key this run touches, so a
+        # caller-supplied ``env`` can't leak into later pooled runs.
+        env_saved = {k: os.environ.get(k) for k in env_updates}
+        try:
+            os.environ.update(env_updates)
+            fn, args, kwargs = pickle.loads(payload)
+            value = fn(*args, **kwargs)
+            try:
+                conn.send(("ok", value))
+            except Exception:
+                conn.send(("ok", repr(value)))
+        except BaseException:
+            conn.send(("error", traceback.format_exc()))
+        finally:
+            try:
+                _pool_task_cleanup()
+            except BaseException:
+                pass
+            for k, old in env_saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            out_f.flush()
+            out_f.close()       # fds 1/2 keep their dup'd descriptors
+    os._exit(0)
+
+
+class MultiProcessPoolRunner:
+    """A persistent pool of cluster-task processes.
+
+    ≙ multi_process_runner.MultiProcessPoolRunner (reference
+    multi_process_runner.py:902): tests share long-lived task processes
+    so each test pays pipe round-trips instead of process spawn + jax
+    import (the dominant cost of a multi-process suite on a small CI
+    box). Unlike the reference's pool — which keeps ONE cluster alive
+    across tests — every :meth:`run` here builds a fresh localhost
+    cluster spec (fresh coordination-service ports), so tests stay
+    hermetic: no KV/barrier-name leakage between tests.
+
+    Tasks that are killed mid-test (fault-injection) must keep using
+    :class:`MultiProcessRunner`; a pool child that dies marks the pool
+    broken and the next ``run`` transparently restarts it.
+    """
+
+    def __init__(self, *, num_workers: int = 2, num_ps: int = 0,
+                 has_chief: bool = False, has_evaluator: bool = False,
+                 devices_per_process: int = 1,
+                 env: Mapping[str, str] | None = None):
+        self._shape = dict(num_workers=num_workers, num_ps=num_ps,
+                           has_chief=has_chief, has_evaluator=has_evaluator)
+        self._devices = devices_per_process
+        self._extra_env = dict(env or {})
+        self._procs: dict[tuple[str, int], Any] = {}
+        self._conns: dict[tuple[str, int], Any] = {}
+        self._tmpdir = None
+        self._run_seq = 0
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def started(self) -> bool:
+        return bool(self._procs)
+
+    def _task_keys(self) -> list[tuple[str, int]]:
+        spec = create_cluster_spec(**self._shape)
+        return [(t, i) for t in sorted(spec) for i in range(len(spec[t]))]
+
+    def start(self, timeout: float = 120.0):
+        import tempfile
+        self.shutdown()
+        self._tmpdir = tempfile.mkdtemp(prefix="mpp_")
+        ready_paths = {}
+        for key in self._task_keys():
+            env = _child_env(self._devices)
+            env.update(self._extra_env)
+            parent_conn, child_conn = _MP.Pipe()
+            ready = os.path.join(self._tmpdir,
+                                 f"ready_{key[0]}_{key[1]}")
+            p = _MP.Process(target=_pool_child_main,
+                            args=(env, child_conn, ready), daemon=True)
+            p.start()
+            child_conn.close()
+            self._procs[key] = p
+            self._conns[key] = parent_conn
+            ready_paths[key] = ready
+        deadline = time.monotonic() + timeout
+        for key, path in ready_paths.items():
+            while not os.path.exists(path):
+                if time.monotonic() > deadline:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"pool task {key} failed to come up in {timeout}s")
+                if self._procs[key].exitcode is not None:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"pool task {key} died during startup")
+                time.sleep(0.05)
+        return self
+
+    def shutdown(self):
+        for conn in self._conns.values():
+            with contextlib.suppress(Exception):
+                conn.send(None)
+        for p in self._procs.values():
+            p.join(5)
+            if p.is_alive():
+                p.kill()
+                p.join(5)
+        self._procs.clear()
+        self._conns.clear()
+
+    # -- dispatch ---------------------------------------------------------
+    def run(self, fn: Callable, *, args: tuple = (),
+            kwargs: dict | None = None,
+            env: Mapping[str, str] | None = None,
+            timeout: float = 300.0,
+            raise_on_error: bool = True) -> MultiProcessRunnerResult:
+        """Run ``fn`` once per cluster task on the pooled processes.
+
+        Same contract as module-level :func:`run`, minus process-kill
+        support. A fresh cluster spec (fresh ports) is generated per
+        call; TF_CONFIG is re-injected through the task pipe.
+        """
+        if not self.started:
+            self.start()
+        elif any(p.exitcode is not None for p in self._procs.values()):
+            # A child died while the pool was idle (OOM-kill, crash in
+            # cleanup): restart transparently, as the class contract says.
+            self.start()
+        self._run_seq += 1
+        spec = create_cluster_spec(**self._shape)
+        payload = pickle.dumps((fn, args, kwargs or {}))
+        ntasks = sum(len(v) for v in spec.values())
+        stdout_paths: dict[tuple[str, int], str] = {}
+        task_index = 0
+        for task_type in sorted(spec):
+            for task_id in range(len(spec[task_type])):
+                key = (task_type, task_id)
+                env_updates = {
+                    "TF_CONFIG": json.dumps({
+                        "cluster": spec,
+                        "task": {"type": task_type, "index": task_id},
+                    }),
+                    "DTX_MPR_NUM_TASKS": str(ntasks),
+                    "DTX_MPR_TASK_INDEX": str(task_index),
+                }
+                env_updates.update(env or {})
+                stdout_path = os.path.join(
+                    self._tmpdir,
+                    f"run{self._run_seq}_{task_type}_{task_id}.out")
+                stdout_paths[key] = stdout_path
+                self._conns[key].send(
+                    (env_updates, stdout_path, payload))
+                task_index += 1
+
+        results: dict[tuple[str, int], TaskResult] = {}
+        deadline = time.monotonic() + timeout
+        pending = dict(self._conns)
+        broken = False
+        while pending and time.monotonic() < deadline:
+            for key, conn in list(pending.items()):
+                value, error, got = None, None, False
+                if conn.poll(0.05):
+                    try:
+                        status, data = conn.recv()
+                        got = True
+                        if status == "ok":
+                            value = data
+                        else:
+                            error = data
+                    except (EOFError, OSError):
+                        got, error, broken = True, _POOL_TASK_DIED, True
+                elif self._procs[key].exitcode is not None:
+                    got, error, broken = True, _POOL_TASK_DIED, True
+                if got:
+                    stdout = ""
+                    path = stdout_paths[key]
+                    if os.path.exists(path):
+                        with open(path, errors="replace") as f:
+                            stdout = f.read()
+                    results[key] = TaskResult(
+                        task_type=key[0], task_id=key[1],
+                        exitcode=self._procs[key].exitcode or 0,
+                        value=value, error=error, stdout=stdout)
+                    del pending[key]
+        if pending or broken:
+            self.shutdown()      # next run restarts cleanly
+            if pending:
+                raise UnexpectedSubprocessExitError(
+                    f"pooled tasks {sorted(pending)} did not report within "
+                    f"{timeout}s (pool restarted)",
+                    MultiProcessRunnerResult(results))
+        result = MultiProcessRunnerResult(results)
+        if raise_on_error:
+            # Same exception split as MultiProcessRunner.join: a task
+            # that RAISED -> SubprocessError (with traceback); a task
+            # that DIED without reporting -> UnexpectedSubprocessExitError.
+            crashed = {k: t for k, t in results.items()
+                       if t.error == _POOL_TASK_DIED}
+            if crashed:
+                raise UnexpectedSubprocessExitError(
+                    f"pooled tasks {sorted(crashed)} died without "
+                    f"reporting (pool restarted)", result)
+            errors = {k: t for k, t in results.items()
+                      if t.error is not None}
+            if errors:
+                k = sorted(errors)[0]
+                raise SubprocessError(
+                    f"pooled task {k} raised:\n{errors[k].error}", result)
+        return result

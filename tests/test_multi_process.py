@@ -352,15 +352,34 @@ def _resume_training_worker(tmpdir, preempt_at, total_steps):
 # ---------------------------------------------------------------------------
 # tests
 # ---------------------------------------------------------------------------
+# Tests that never kill a task run on module-scoped POOLS (persistent
+# processes, fresh cluster ports per run — ≙ the reference's
+# MultiProcessPoolRunner, multi_process_runner.py:902) to amortize the
+# spawn + jax-import cost that dominates this suite's wall-clock.
+# Fault-injection tests keep the spawn-per-task MultiProcessRunner.
 
-def test_cross_process_collective():
-    result = mpr.run(_psum_worker, num_workers=2, timeout=180)
+@pytest.fixture(scope="module")
+def pool2():
+    pool = mpr.MultiProcessPoolRunner(num_workers=2)
+    yield pool
+    pool.shutdown()
+
+
+@pytest.fixture(scope="module")
+def pool3():
+    pool = mpr.MultiProcessPoolRunner(num_workers=3)
+    yield pool
+    pool.shutdown()
+
+
+def test_cross_process_collective(pool2):
+    result = pool2.run(_psum_worker, timeout=180)
     vals = sorted(result.return_values)
     assert vals == [(0, 2, 3.0), (1, 2, 3.0)]
 
 
-def test_kv_store_barrier_increment():
-    result = mpr.run(_kv_barrier_worker, num_workers=2, timeout=180)
+def test_kv_store_barrier_increment(pool2):
+    result = pool2.run(_kv_barrier_worker, timeout=180)
     assert len(result.return_values) == 2
     gots = sorted(v[0] for v in result.return_values)
     assert gots == ["hello-0", "hello-1"]
@@ -370,14 +389,13 @@ def test_kv_store_barrier_increment():
     assert all(v[2] == 2 for v in result.return_values)
 
 
-def test_multi_host_sharded_checkpoint(tmp_path):
-    result = mpr.run(_ckpt_worker, num_workers=2, args=(str(tmp_path),),
-                     timeout=240)
+def test_multi_host_sharded_checkpoint(tmp_path, pool2):
+    result = pool2.run(_ckpt_worker, args=(str(tmp_path),), timeout=240)
     assert result.return_values == [True, True]
 
 
-def test_barrier_timeout_fails_fast():
-    result = mpr.run(_barrier_timeout_worker, num_workers=2, timeout=180)
+def test_barrier_timeout_fails_fast(pool2):
+    result = pool2.run(_barrier_timeout_worker, timeout=180)
     outcomes = sorted(result.return_values)
     assert outcomes == ["skipped", "timeout"]
 
@@ -432,9 +450,9 @@ def _finalize_laggard_worker(tmpdir):
     return runtime.process_id, saved is not None
 
 
-def test_preemption_agreement_across_processes(tmp_path):
-    result = mpr.run(_preemption_worker, num_workers=2,
-                     args=(str(tmp_path),), timeout=240)
+def test_preemption_agreement_across_processes(tmp_path, pool2):
+    result = pool2.run(_preemption_worker, args=(str(tmp_path),),
+                       timeout=240)
     assert len(result.return_values) == 2
     by_proc = dict(result.return_values)
     # both processes checkpointed (at the agreed step); save steps match
@@ -449,22 +467,21 @@ def test_preemption_agreement_across_processes(tmp_path):
     assert "shard_0.npz" in files and "shard_1.npz" in files
 
 
-def test_remote_coordinator_dispatch(tmp_path):
+def test_remote_coordinator_dispatch(tmp_path, pool3):
     """Closures scheduled on the coordinator run in remote worker
     PROCESSES (≙ cluster_coordinator.py:1027 grpc dispatch)."""
-    result = mpr.run(_remote_basic_worker, num_workers=3,
-                     args=(str(tmp_path),), timeout=240)
+    result = pool3.run(_remote_basic_worker, args=(str(tmp_path),),
+                       timeout=240)
     coord = [v for v in result.return_values if v[0] == "coordinator"][0]
     assert coord[1], f"wrong results: {coord[2]}"
     workers = [v for v in result.return_values if v[0] == "worker-done"]
     assert len(workers) == 2     # both worker loops exited via shutdown
 
 
-def test_per_worker_datasets_on_remote_workers():
+def test_per_worker_datasets_on_remote_workers(pool3):
     """create_per_worker_dataset places iterators ON worker processes;
     scheduled closures consume them via resource handles."""
-    result = mpr.run(_per_worker_dataset_worker, num_workers=3,
-                     timeout=240)
+    result = pool3.run(_per_worker_dataset_worker, timeout=240)
     coord = [v for v in result.return_values if v[0] == "coordinator"][0]
     assert coord[1], f"unexpected values: {coord[2]}"
 
@@ -493,7 +510,7 @@ def test_remote_dispatch_failover_on_worker_kill(tmp_path):
     assert result.tasks[("worker", 2)].exitcode != 0   # really killed
 
 
-def test_preemption_restart_resume_training(tmp_path):
+def test_preemption_restart_resume_training(tmp_path, pool2):
     """The full fault-tolerance story across PROCESS GENERATIONS:
     generation 1 trains, gets preempted (signal on one process),
     checkpoints at the agreed step and stops; generation 2 (fresh
@@ -501,8 +518,8 @@ def test_preemption_restart_resume_training(tmp_path):
     final state must equal uninterrupted training — the order-sensitive
     recurrence catches any lost, repeated, or torn step."""
     total = 12
-    r1 = mpr.run(_resume_training_worker, num_workers=2,
-                 args=(str(tmp_path), 4, total), timeout=300)
+    r1 = pool2.run(_resume_training_worker,
+                   args=(str(tmp_path), 4, total), timeout=300)
     assert len(r1.return_values) == 2
     for _pid, t, _w in r1.return_values:
         assert t < total, "generation 1 should have been preempted"
@@ -510,8 +527,8 @@ def test_preemption_restart_resume_training(tmp_path):
     cks = [d for d in os.listdir(tmp_path) if d.startswith("resume-")]
     assert cks, os.listdir(tmp_path)
 
-    r2 = mpr.run(_resume_training_worker, num_workers=2,
-                 args=(str(tmp_path), None, total), timeout=300)
+    r2 = pool2.run(_resume_training_worker,
+                   args=(str(tmp_path), None, total), timeout=300)
     expect = 1.0
     for t in range(total):
         expect = expect * 1.5 + t
@@ -542,9 +559,9 @@ def test_killed_process_detected(tmp_path):
 
 
 @pytest.mark.multiprocess
-def test_finalize_commits_full_checkpoint_on_unequal_stops(tmp_path):
-    result = mpr.run(_finalize_laggard_worker, num_workers=2,
-                     args=(str(tmp_path),), timeout=240)
+def test_finalize_commits_full_checkpoint_on_unequal_stops(tmp_path, pool2):
+    result = pool2.run(_finalize_laggard_worker, args=(str(tmp_path),),
+                       timeout=240)
     by_proc = dict(result.return_values)
     assert by_proc[0] and by_proc[1]
     cks = [d for d in os.listdir(tmp_path) if d.startswith("fin-")
@@ -696,3 +713,53 @@ def test_train_and_evaluate_with_evaluator_task(tmp_path):
     # TB event file with eval scalars exists
     logs = os.listdir(tmp_path / "eval_logs")
     assert any("events.out.tfevents" in f for f in logs), logs
+
+
+# ---------------------------------------------------------------------------
+# pool-runner semantics
+# ---------------------------------------------------------------------------
+
+def _own_pid():
+    import os as _os
+    return _os.getpid()
+
+
+def _raise_worker():
+    raise ValueError("intentional")
+
+
+def test_pool_reuses_processes_across_runs(pool2):
+    """The whole point of the pool: consecutive runs land on the SAME
+    OS processes (no spawn / jax re-import), and a fresh distributed
+    cluster still comes up correctly on every run."""
+    pids1 = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    pids2 = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    assert pids1 == pids2 and len(pids1) == 2
+    # distributed runs work on the same pooled processes before/after
+    r = pool2.run(_psum_worker, timeout=180)
+    assert sorted(r.return_values) == [(0, 2, 3.0), (1, 2, 3.0)]
+    pids3 = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    assert pids3 == pids1
+
+
+def test_pool_task_error_does_not_break_pool(pool2):
+    """A raising closure reports SubprocessError; the pool stays usable
+    (≙ MultiProcessPoolRunner surviving test failures)."""
+    pids_before = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    with pytest.raises(mpr.SubprocessError, match="intentional"):
+        pool2.run(_raise_worker, timeout=60)
+    pids_after = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    assert pids_after == pids_before
+
+
+def test_pool_restarts_after_idle_child_death(pool2):
+    """A pool child that dies while idle must not strand the fixture:
+    the next run detects the dead task and restarts the pool."""
+    pids = sorted(pool2.run(_own_pid, timeout=60).return_values)
+    pool2._procs[("worker", 0)].kill()
+    pool2._procs[("worker", 0)].join(10)
+    pids2 = sorted(pool2.run(_own_pid, timeout=120).return_values)
+    assert len(pids2) == 2 and pids2 != pids
+    # and distributed runs still work on the restarted pool
+    r = pool2.run(_psum_worker, timeout=180)
+    assert sorted(r.return_values) == [(0, 2, 3.0), (1, 2, 3.0)]
