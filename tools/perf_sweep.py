@@ -98,9 +98,17 @@ def run_one(spec: dict, n_iters=10, reps=3):
 
 
 if __name__ == "__main__":
-    for s in (sys.argv[1:] or ["batch=16"]):
-        try:
-            run_one(parse(s))
-        except Exception as e:       # keep sweeping past OOMs
-            print(f"{parse(s)}  FAILED: {type(e).__name__}: {e}",
-                  flush=True)
+    specs = sys.argv[1:] or ["batch=16"]
+    if len(specs) > 1:
+        # One subprocess per config: compiled executables and live
+        # buffers from an earlier config otherwise sit in HBM and turn
+        # later configs into spurious OOMs.
+        import subprocess
+        for s in specs:
+            subprocess.run([sys.executable, __file__, s], check=False)
+        sys.exit(0)
+    try:
+        run_one(parse(specs[0]))
+    except Exception as e:           # keep sweeping past OOMs
+        print(f"{parse(specs[0])}  FAILED: {type(e).__name__}: {e}",
+              flush=True)
