@@ -105,10 +105,13 @@ if __name__ == "__main__":
         # later configs into spurious OOMs.
         import subprocess
         for s in specs:
-            subprocess.run([sys.executable, __file__, s], check=False)
+            rc = subprocess.run([sys.executable, __file__, s],
+                                check=False).returncode
+            if rc != 0:
+                print(f"{s}  FAILED: subprocess exited {rc}", flush=True)
         sys.exit(0)
+    spec = parse(specs[0])
     try:
-        run_one(parse(specs[0]))
+        run_one(spec)
     except Exception as e:           # keep sweeping past OOMs
-        print(f"{parse(specs[0])}  FAILED: {type(e).__name__}: {e}",
-              flush=True)
+        print(f"{spec}  FAILED: {type(e).__name__}: {e}", flush=True)
