@@ -83,15 +83,16 @@ def main():
         #   and fuse ACROSS layer boundaries (scan pins one conservative
         #   loop body) — +0.05 MFU over the scanned stack;
         # - remat=False: with the layer stack unrolled and the fused
-        #   chunked cross-entropy (loss_chunks=4) keeping the (B,S,vocab)
+        #   chunked cross-entropy (loss_chunks=8) keeping the (B,S,vocab)
         #   logits out of HBM, the full activation set fits at batch 4 —
         #   the backward recomputes NOTHING (+0.07 over remat="dots");
         # - full-sequence Pallas tiles (1024/1024 — one block per (b,h)).
-        # Measured 0.596-0.597 MFU (round 2 best: 0.4642).
+        # Measured 0.577 MFU sustained at 20-step loops (round 2: 0.4642);
+        # lc=4 wins short bursts but lc=8 sustains better.
         cfg = TransformerConfig.transformer_big(max_seq_len=1024,
                                                 remat=False,
                                                 scan_layers=False,
-                                                loss_chunks=4,
+                                                loss_chunks=8,
                                                 attn_block_q=1024,
                                                 attn_block_k=1024)
         batch, n_iters, reps = 4, 20, 5

@@ -12,9 +12,27 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Persistent compilation cache: the suite is XLA-CPU-compile dominated
+# (hundreds of distinct SPMD programs on a 1-core box). Keys are
+# HLO+config hashes, so code changes invalidate exactly the programs
+# they touch; repeat CI runs skip recompiling everything else.
+# Set via env BEFORE importing jax (config defaults read env at import)
+# and not via jax.config, so multi_process_runner children inherit it.
+# (≙ the reference's bazel-level test result caching — same role.)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/dtx_jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# sitecustomize imports jax before conftest, so the env defaults above
+# only reach SPAWNED children; the parent needs runtime updates.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 
