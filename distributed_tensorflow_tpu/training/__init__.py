@@ -14,10 +14,11 @@ from distributed_tensorflow_tpu.training.callbacks import (
     History,
     LearningRateScheduler,
     ModelCheckpoint,
+    TensorBoard,
 )
 
 __all__ = [
     "Model", "losses", "metrics", "callbacks", "Callback", "History",
     "EarlyStopping", "ModelCheckpoint", "LearningRateScheduler",
-    "BackupAndRestore",
+    "BackupAndRestore", "TensorBoard",
 ]

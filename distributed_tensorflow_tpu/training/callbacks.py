@@ -223,3 +223,58 @@ class BackupAndRestore(Callback):
 
     def on_train_end(self, logs=None):
         shutil.rmtree(self.backup_dir, ignore_errors=True)
+
+
+class TensorBoard(Callback):
+    """Stream epoch metrics (and optionally weight histograms) to
+    TensorBoard event files (≙ tf_keras.callbacks.TensorBoard, backed by
+    utils/summary.SummaryWriter — no TF dependency).
+
+    Layout matches Keras: ``logdir/train`` for training metrics,
+    ``logdir/validation`` for ``val_*`` metrics.
+    """
+
+    def __init__(self, log_dir: str = "logs",
+                 histogram_freq: int = 0):
+        super().__init__()
+        self.log_dir = log_dir
+        self.histogram_freq = histogram_freq
+        self._writers = {}
+
+    def _writer(self, name: str):
+        """Lazy per-run writer: no spurious empty 'validation' run when
+        fit() has no validation data (matches Keras)."""
+        if name not in self._writers:
+            from distributed_tensorflow_tpu.utils.summary import \
+                SummaryWriter
+            self._writers[name] = SummaryWriter(
+                os.path.join(self.log_dir, name))
+        return self._writers[name]
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            if not isinstance(v, (int, float, np.floating)):
+                continue
+            if k.startswith("val_"):
+                self._writer("validation").scalar(
+                    f"epoch_{k[4:]}", float(v), epoch)
+            else:
+                self._writer("train").scalar(f"epoch_{k}", float(v), epoch)
+        if (self.histogram_freq and self.model is not None
+                and (epoch + 1) % self.histogram_freq == 0):
+            params = getattr(self.model, "_state", {}).get("params")
+            if params is not None:
+                import jax
+                flat = jax.tree_util.tree_flatten_with_path(params)[0]
+                for path, leaf in flat:
+                    name = "/".join(getattr(p, "key", str(p))
+                                    for p in path)
+                    self._writer("train").histogram(
+                        name, np.asarray(leaf), epoch)
+        for w in self._writers.values():
+            w.flush()
+
+    def on_train_end(self, logs=None):
+        for w in self._writers.values():
+            w.close()
+        self._writers = {}

@@ -76,6 +76,45 @@ def _encode_file_version(wall_time: float) -> bytes:
     return _double(1, wall_time) + _len_delim(3, b"brain.Event:2")
 
 
+def _packed_doubles(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _len_delim(field, payload)
+
+
+def _encode_histogram_event(tag: str, values, step: int,
+                            wall_time: float, bins: int = 30) -> bytes:
+    """Event carrying a HistogramProto (≙ tf.summary.histogram v1 wire
+    format, which TensorBoard's histograms/distributions dashboards read).
+
+    HistogramProto { min=1, max=2, num=3, sum=4, sum_squares=5,
+                     bucket_limit=6 (packed double), bucket=7 } —
+    bucket_limit[i] is the INCLUSIVE upper edge of bucket i.
+    """
+    import numpy as np
+    arr = np.asarray(values, dtype=np.float64).ravel()
+    # Log the finite subset: a diverging model (NaN/Inf weights) is
+    # exactly when users turn on histograms, and np.histogram raises on
+    # a non-finite range.
+    arr = arr[np.isfinite(arr)]
+    if arr.size == 0:
+        arr = np.zeros((1,))
+    lo, hi = float(arr.min()), float(arr.max())
+    if lo == hi:                       # single-value histogram
+        edges = np.array([lo, lo + 1e-12])
+        counts = np.array([float(arr.size)])
+    else:
+        counts, edges = np.histogram(arr, bins=bins)
+        counts = counts.astype(np.float64)
+    histo = (_double(1, lo) + _double(2, hi)
+             + _double(3, float(arr.size)) + _double(4, float(arr.sum()))
+             + _double(5, float(np.square(arr).sum()))
+             + _packed_doubles(6, edges[1:]) + _packed_doubles(7, counts))
+    # Summary.Value { tag=1, histo=5 }
+    sval = _len_delim(1, tag.encode()) + _len_delim(5, histo)
+    summary = _len_delim(1, sval)
+    return _double(1, wall_time) + _int64(2, step) + _len_delim(5, summary)
+
+
 # ---------------------------------------------------------------------------
 # TFRecord framing with masked crc32c
 # ---------------------------------------------------------------------------
@@ -148,6 +187,14 @@ class SummaryWriter:
     def scalars(self, values: dict, step: int):
         for tag, v in values.items():
             self.scalar(tag, v, step)
+
+    def histogram(self, tag: str, values, step: int,
+                  wall_time: float | None = None, bins: int = 30):
+        """Histogram summary (≙ tf.summary.histogram): weight/gradient
+        distributions for TensorBoard's histograms dashboard."""
+        self._write(_encode_histogram_event(
+            tag, values, int(step),
+            time.time() if wall_time is None else wall_time, bins=bins))
 
     def flush(self):
         with self._lock:

@@ -35,6 +35,13 @@ def worker_main(steps: int, global_batch: int, image_size: int):
 
     runtime = bootstrap.initialize()           # reads TF_CONFIG if present
     mesh = make_mesh({"dp": -1})               # all global devices
+    if global_batch % runtime.num_processes:
+        adjusted = (global_batch // runtime.num_processes
+                    * runtime.num_processes)
+        print(f"global batch {global_batch} not divisible by "
+              f"{runtime.num_processes} processes; using {adjusted}",
+              flush=True)
+        global_batch = adjusted
     cfg = resnet.ResNetConfig.resnet50() if image_size >= 128 \
         else resnet.ResNetConfig.tiny()
     state, step_fn = resnet.make_sharded_train_step(
