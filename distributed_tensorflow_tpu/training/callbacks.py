@@ -261,8 +261,9 @@ class TensorBoard(Callback):
             else:
                 self._writer("train").scalar(f"epoch_{k}", float(v), epoch)
         if (self.histogram_freq and self.model is not None
-                and (epoch + 1) % self.histogram_freq == 0):
-            params = getattr(self.model, "_state", {}).get("params")
+                and epoch % self.histogram_freq == 0):   # Keras phase
+            state = getattr(self.model, "_state", None) or {}
+            params = state.get("params")
             if params is not None:
                 import jax
                 flat = jax.tree_util.tree_flatten_with_path(params)[0]

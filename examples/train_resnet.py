@@ -35,6 +35,11 @@ def worker_main(steps: int, global_batch: int, image_size: int):
 
     runtime = bootstrap.initialize()           # reads TF_CONFIG if present
     mesh = make_mesh({"dp": -1})               # all global devices
+    if global_batch < runtime.num_processes:
+        raise SystemExit(
+            f"--global-batch {global_batch} is smaller than the process "
+            f"count {runtime.num_processes}; every process needs >= 1 "
+            f"sample")
     if global_batch % runtime.num_processes:
         adjusted = (global_batch // runtime.num_processes
                     * runtime.num_processes)

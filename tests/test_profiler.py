@@ -57,3 +57,25 @@ def test_trace_rejects_address_targets():
     from distributed_tensorflow_tpu.utils import profiler
     with pytest.raises(TypeError, match="grpc ProfilerService"):
         profiler.trace("host:6009", "/tmp/x")
+
+
+def test_op_profile_reads_back_device_ops(tmp_path):
+    """op_profile aggregates the collected trace into a per-op table
+    (device plane; on the CPU suite the host TFRT plane carries the
+    XLA Ops line)."""
+    import pytest
+    logdir = str(tmp_path / "profile3")
+    with profiler.profile(logdir):
+        x = jnp.ones((256, 256))
+        for _ in range(3):
+            x = jax.block_until_ready(x @ x + 1.0)
+    try:
+        rows = profiler.op_profile(logdir, top=10, device_substr="CPU")
+    except ImportError as e:
+        pytest.skip(str(e))
+    assert rows and rows[0].total_ms >= 0
+    allrows = profiler.op_profile(logdir, top=10000, device_substr="CPU")
+    assert abs(sum(r.fraction for r in allrows) - 1) < 1e-6
+    assert any(("fusion" in r.name or "dot" in r.name
+                or "custom" in r.name or "jit" in r.name)
+               for r in rows), [r.name for r in rows]
