@@ -1,1 +1,23 @@
 """Distributed input pipeline (SURVEY.md §2.3 input layer)."""
+
+from distributed_tensorflow_tpu.input.dataset import (
+    AutoShardPolicy,
+    Dataset,
+    DistributedDataset,
+    InputContext,
+    InputOptions,
+)
+from distributed_tensorflow_tpu.input.example_parser import (
+    FixedLenFeature,
+    VarLenFeature,
+    encode_example,
+    example_reader,
+    parse_example,
+    parse_single_example,
+)
+
+__all__ = [
+    "AutoShardPolicy", "Dataset", "DistributedDataset", "InputContext",
+    "InputOptions", "FixedLenFeature", "VarLenFeature", "encode_example",
+    "example_reader", "parse_example", "parse_single_example",
+]

@@ -79,7 +79,8 @@ class Dataset:
 
     Sources: ``from_tensor_slices``, ``from_files``, ``from_generator``,
     ``range``. Transforms are lazy and compose: map, filter, shuffle, batch,
-    repeat, take, skip, shard, prefetch. Iteration yields numpy pytrees.
+    repeat, take, skip, shard, interleave, cache, prefetch (+ Dataset.zip).
+    Iteration yields numpy pytrees.
     """
 
     def __init__(self, gen_fn: Callable[[], Iterator], *,
@@ -140,20 +141,28 @@ class Dataset:
         return cls(lambda: iter(r), element_count=len(r))
 
     # -- transforms -------------------------------------------------------
-    def _derive(self, gen_fn, element_count=None) -> "Dataset":
+    def _derive(self, gen_fn, element_count=None, op=None) -> "Dataset":
+        """Derived dataset. ``op`` (Callable[[Dataset], Dataset]) replays
+        this transform on a replacement source — shard_files uses the
+        recorded chain to re-apply every transform on top of the SHARDED
+        file source (tf.data's FILE auto-shard rewrites the source node
+        the same way, input_ops.py:28)."""
         ds = Dataset(gen_fn, files=self._files, element_count=element_count)
         if hasattr(self, "_reader"):
             ds._reader = self._reader
+        ds._parent = self
+        ds._op = op
         return ds
 
     def map(self, fn: Callable) -> "Dataset":
         src = self._gen_fn
         return self._derive(lambda: (fn(x) for x in src()),
-                            self._element_count)
+                            self._element_count, op=lambda d: d.map(fn))
 
     def filter(self, pred: Callable) -> "Dataset":
         src = self._gen_fn
-        return self._derive(lambda: (x for x in src() if pred(x)))
+        return self._derive(lambda: (x for x in src() if pred(x)),
+                            op=lambda d: d.filter(pred))
 
     def shuffle(self, buffer_size: int, seed: int | None = None) -> "Dataset":
         src = self._gen_fn
@@ -170,7 +179,8 @@ class Dataset:
             rng.shuffle(buf)
             yield from buf
 
-        return self._derive(gen, self._element_count)
+        return self._derive(gen, self._element_count,
+                            op=lambda d: d.shuffle(buffer_size, seed))
 
     def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
         src = self._gen_fn
@@ -190,7 +200,8 @@ class Dataset:
         if self._element_count is not None:
             count = (self._element_count // batch_size if drop_remainder
                      else -(-self._element_count // batch_size))
-        return self._derive(gen, count)
+        return self._derive(gen, count,
+                            op=lambda d: d.batch(batch_size, drop_remainder))
 
     def repeat(self, count: int | None = None) -> "Dataset":
         src = self._gen_fn
@@ -203,37 +214,132 @@ class Dataset:
 
         return self._derive(
             gen, None if count is None or self._element_count is None
-            else self._element_count * count)
+            else self._element_count * count,
+            op=lambda d: d.repeat(count))
 
     def take(self, n: int) -> "Dataset":
         src = self._gen_fn
-        return self._derive(lambda: itertools.islice(src(), n))
+        return self._derive(lambda: itertools.islice(src(), n),
+                            op=lambda d: d.take(n))
 
     def skip(self, n: int) -> "Dataset":
         src = self._gen_fn
-        return self._derive(lambda: itertools.islice(src(), n, None))
+        return self._derive(lambda: itertools.islice(src(), n, None),
+                            op=lambda d: d.skip(n))
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """DATA-policy sharding: every ``num_shards``-th element
         (≙ tf.data Dataset.shard used by auto_shard_dataset)."""
         src = self._gen_fn
         return self._derive(
-            lambda: itertools.islice(src(), index, None, num_shards))
+            lambda: itertools.islice(src(), index, None, num_shards),
+            op=lambda d: d.shard(num_shards, index))
 
     def shard_files(self, num_shards: int, index: int) -> "Dataset":
-        """FILE-policy sharding (≙ input_ops.py:28 FILE branch)."""
+        """FILE-policy sharding (≙ input_ops.py:28 FILE branch).
+
+        Like tf.data's FILE auto-shard — which rewrites the source node
+        of the input graph — this shards the ROOT file list and replays
+        every downstream transform on top of the sharded source, so
+        ``from_files(...).map(parse).batch(n)`` keeps its parsing and
+        batching per shard."""
         if not self._files:
             raise ValueError("Dataset has no file list; use DATA sharding")
-        files = self._files[index::num_shards]
-        reader = self._reader
+        chain = []
+        node = self
+        while getattr(node, "_parent", None) is not None:
+            if node._op is None:
+                raise ValueError(
+                    "FILE sharding cannot replay this pipeline (a "
+                    "transform without a recorded rebuild op, e.g. "
+                    "Dataset.zip/cache); use AutoShardPolicy.DATA")
+            chain.append(node._op)
+            node = node._parent
+        if not node._files or not hasattr(node, "_reader"):
+            raise ValueError(
+                "pipeline root has no file source (e.g. Dataset.zip or "
+                "a generator root); use AutoShardPolicy.DATA")
+        ds = Dataset.from_files(node._files[index::num_shards],
+                                node._reader)
+        for op in reversed(chain):
+            ds = op(ds)
+        return ds
+
+    def interleave(self, map_fn: Callable[..., "Dataset"],
+                   cycle_length: int = 4,
+                   block_length: int = 1) -> "Dataset":
+        """Round-robin interleave of ``cycle_length`` sub-datasets
+        (≙ tf.data Dataset.interleave): ``map_fn(element)`` yields a
+        Dataset per source element; ``block_length`` consecutive items
+        are pulled from each open sub-iterator before rotating. This is
+        the canonical many-files reading pattern together with
+        ``from_files``/``shard_files``."""
+        if cycle_length < 1:
+            raise ValueError(f"cycle_length must be >= 1, got "
+                             f"{cycle_length}")
+        src = self._gen_fn
 
         def gen():
-            for f in files:
-                yield from reader(f)
+            elements = src()
+            open_its: list = []
+            exhausted_src = False
+            while True:
+                while not exhausted_src and len(open_its) < cycle_length:
+                    try:
+                        open_its.append(iter(map_fn(next(elements))))
+                    except StopIteration:
+                        exhausted_src = True
+                if not open_its:
+                    return
+                keep = []
+                for it in open_its:
+                    alive = True
+                    for _ in range(block_length):
+                        try:
+                            yield next(it)
+                        except StopIteration:
+                            alive = False
+                            break
+                    if alive:
+                        keep.append(it)
+                open_its = keep
 
-        ds = Dataset(gen, files=files)
-        ds._reader = reader
-        return ds
+        return self._derive(
+            gen, None,
+            op=lambda d: d.interleave(map_fn, cycle_length, block_length))
+
+    @classmethod
+    def zip(cls, *datasets: "Dataset") -> "Dataset":
+        """Elementwise tuples across datasets, stopping at the shortest
+        (≙ tf.data.Dataset.zip)."""
+        gens = [d._gen_fn for d in datasets]
+
+        def gen():
+            yield from zip(*(g() for g in gens))
+
+        counts = [d._element_count for d in datasets]
+        n = None if any(c is None for c in counts) else min(counts)
+        return cls(gen, element_count=n)
+
+    def cache(self) -> "Dataset":
+        """Memoize elements on first full pass; later epochs replay the
+        cache without re-running upstream transforms (≙ tf.data
+        Dataset.cache, in-memory form)."""
+        src = self._gen_fn
+        store: dict = {"items": [], "complete": False}
+
+        def gen():
+            if store["complete"]:
+                yield from store["items"]
+                return
+            items = []
+            for x in src():
+                items.append(x)
+                yield x
+            store["items"], store["complete"] = items, True
+
+        return self._derive(gen, self._element_count,
+                            op=lambda d: d.cache())
 
     def prefetch(self, buffer_size: int = 2) -> "Dataset":
         src = self._gen_fn
@@ -241,7 +347,8 @@ class Dataset:
         def gen():
             yield from _BackgroundIterator(src(), buffer_size)
 
-        return self._derive(gen, self._element_count)
+        return self._derive(gen, self._element_count,
+                            op=lambda d: d.prefetch(buffer_size))
 
     def cardinality(self) -> int | None:
         return self._element_count

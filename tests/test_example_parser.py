@@ -1,0 +1,129 @@
+"""tf.Example wire-format parsing (≙ tf.io.parse_example).
+
+Interop is the point: examples ENCODED BY TENSORFLOW must parse with
+our decoder, and examples encoded by us must parse with TF's."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.input.example_parser import (
+    FixedLenFeature, VarLenFeature, encode_example, example_reader,
+    iter_tfrecords, parse_example, parse_single_example)
+
+SPEC = {
+    "dense": FixedLenFeature((3,), np.float32),
+    "label": FixedLenFeature((), np.int64),
+    "cats": VarLenFeature(np.int64),
+    "name": VarLenFeature(object),
+}
+
+
+def _sample(i):
+    return {
+        "dense": np.asarray([i, i + 0.5, i + 1], np.float32),
+        "label": np.asarray(i, np.int64),
+        "cats": np.arange(i % 3 + 1, dtype=np.int64) + 10 * i,
+        "name": [f"ex{i}".encode()],
+    }
+
+
+def test_roundtrip_own_encoder():
+    ex = _sample(2)
+    parsed = parse_single_example(encode_example(ex), SPEC)
+    np.testing.assert_allclose(parsed["dense"], ex["dense"])
+    assert parsed["label"] == 2 and parsed["label"].shape == ()
+    np.testing.assert_array_equal(parsed["cats"], ex["cats"])
+    assert parsed["name"] == [b"ex2"]
+
+
+def test_parse_batch_stacks_fixed_and_keeps_ragged():
+    serialized = [encode_example(_sample(i)) for i in range(4)]
+    out = parse_example(serialized, SPEC)
+    assert out["dense"].shape == (4, 3)
+    assert out["label"].tolist() == [0, 1, 2, 3]
+    assert [len(c) for c in out["cats"]] == [1, 2, 3, 1]
+
+
+def test_negative_int64_and_defaults():
+    ex = encode_example({"label": np.asarray(-7, np.int64)})
+    spec = {"label": FixedLenFeature((), np.int64),
+            "dense": FixedLenFeature((2,), np.float32,
+                                     default_value=0.25)}
+    parsed = parse_single_example(ex, spec)
+    assert parsed["label"] == -7
+    np.testing.assert_allclose(parsed["dense"], [0.25, 0.25])
+    with pytest.raises(ValueError, match="missing"):
+        parse_single_example(ex, {"absent": FixedLenFeature((1,))})
+
+
+def test_interop_with_tensorflow_protos():
+    """Bidirectional: TF-encoded -> our parser; our-encoded -> TF parser."""
+    try:
+        from tensorflow.core.example import example_pb2, feature_pb2
+    except Exception as e:
+        pytest.skip(f"tensorflow protos unavailable: {e}")
+
+    tf_ex = example_pb2.Example()
+    f = tf_ex.features.feature
+    f["dense"].float_list.value.extend([1.0, 2.0, 3.0])
+    f["label"].int64_list.value.append(-42)
+    f["cats"].int64_list.value.extend([5, 6])
+    f["name"].bytes_list.value.append(b"tfside")
+    parsed = parse_single_example(tf_ex.SerializeToString(), SPEC)
+    np.testing.assert_allclose(parsed["dense"], [1, 2, 3])
+    assert parsed["label"] == -42
+    np.testing.assert_array_equal(parsed["cats"], [5, 6])
+    assert parsed["name"] == [b"tfside"]
+
+    back = example_pb2.Example()
+    back.ParseFromString(encode_example(_sample(1)))
+    bf = back.features.feature
+    assert list(bf["dense"].float_list.value) == [1.0, 1.5, 2.0]
+    assert list(bf["label"].int64_list.value) == [1]
+    assert bf["name"].bytes_list.value[0] == b"ex1"
+
+
+def test_example_reader_over_tfrecord_file(tmp_path):
+    """End-to-end: write a TFRecord of Examples, read through
+    Dataset.from_files + example_reader, batch for training."""
+    from distributed_tensorflow_tpu.input.dataset import Dataset
+    from distributed_tensorflow_tpu.input.native_loader import (
+        write_tfrecords)
+    path = str(tmp_path / "data.tfrecord")
+    write_tfrecords(path, [encode_example(_sample(i)) for i in range(6)])
+    assert len(list(iter_tfrecords(path))) == 6
+
+    spec = {"dense": FixedLenFeature((3,), np.float32),
+            "label": FixedLenFeature((), np.int64)}
+    ds = Dataset.from_files([path], example_reader(spec)) \
+        .batch(3, drop_remainder=True)
+    batches = list(ds)
+    assert len(batches) == 2
+    assert batches[0]["dense"].shape == (3, 3)
+    assert batches[1]["label"].tolist() == [3, 4, 5]
+
+
+def test_corrupt_record_raises(tmp_path):
+    from distributed_tensorflow_tpu.input.native_loader import (
+        write_tfrecords)
+    path = str(tmp_path / "bad.tfrecord")
+    write_tfrecords(path, [encode_example(_sample(0))])
+    data = bytearray(open(path, "rb").read())
+    data[20] ^= 0xFF                       # flip a payload bit
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="crc"):
+        list(iter_tfrecords(path))
+
+
+def test_encode_numpy_bytes_and_negative_ints():
+    ex = encode_example({
+        "names": np.array([b"a", b"bb"]),
+        "neg": np.asarray([-1, -2], np.int64),
+    })
+    spec = {"names": VarLenFeature(object),
+            "neg": FixedLenFeature((2,), np.int64)}
+    parsed = parse_single_example(ex, spec)
+    assert parsed["names"] == [b"a", b"bb"]
+    assert parsed["neg"].tolist() == [-1, -2]
+    with pytest.raises(ValueError, match="ambiguous"):
+        encode_example({"empty": []})

@@ -137,3 +137,91 @@ def test_distribute_datasets_from_function(devices):
     dist = s.distribute_datasets_from_function(dataset_fn)
     b = next(iter(dist))
     assert b["x"].shape == (32, 1)
+
+
+def test_interleave_round_robin():
+    ds = Dataset.range(3).interleave(
+        lambda i: Dataset.from_iterable([i * 10, i * 10 + 1, i * 10 + 2]),
+        cycle_length=2, block_length=1)
+    got = list(ds)
+    # sources 0 and 1 open first, alternating; source 2 joins as one closes
+    assert sorted(got) == [0, 1, 2, 10, 11, 12, 20, 21, 22]
+    assert got[:4] == [0, 10, 1, 11]
+
+
+def test_interleave_block_length_and_files_pattern():
+    ds = Dataset.range(4).interleave(
+        lambda i: Dataset.from_iterable([(i, j) for j in range(2)]),
+        cycle_length=4, block_length=2)
+    got = list(ds)
+    assert got == [(0, 0), (0, 1), (1, 0), (1, 1),
+                   (2, 0), (2, 1), (3, 0), (3, 1)]
+
+
+def test_zip_stops_at_shortest():
+    a = Dataset.range(5)
+    b = Dataset.range(3).map(lambda x: x * 100)
+    z = Dataset.zip(a, b)
+    assert list(z) == [(0, 0), (1, 100), (2, 200)]
+    assert z.cardinality() == 3
+
+
+def test_cache_replays_without_upstream():
+    calls = []
+
+    def gen():
+        for i in range(4):
+            calls.append(i)
+            yield i
+
+    ds = Dataset.from_generator(gen).cache()
+    assert list(ds) == [0, 1, 2, 3]
+    assert list(ds) == [0, 1, 2, 3]
+    assert len(calls) == 4          # second epoch served from the cache
+
+
+def test_cache_partial_pass_does_not_poison():
+    def gen():
+        yield from range(10)
+
+    ds = Dataset.from_generator(gen).cache()
+    assert list(ds.take(3)) == [0, 1, 2]    # incomplete pass: not cached
+    assert list(ds) == list(range(10))      # full pass still correct
+
+
+def test_shard_files_replays_downstream_transforms(tmp_path):
+    """FILE sharding rewrites the SOURCE and keeps map/batch — the
+    pipeline shape tf.data's FILE auto-shard preserves by graph rewrite
+    (a raw re-read of the sharded files would drop the parsing)."""
+    files = []
+    for i in range(4):
+        f = tmp_path / f"f{i}.txt"
+        f.write_text("")
+        files.append(str(f))
+
+    def reader(path):
+        i = int(path[-5])
+        yield from range(i * 10, i * 10 + 3)
+
+    ds = (Dataset.from_files(files, reader)
+          .map(lambda x: x * 2)
+          .batch(3, drop_remainder=True))
+    shard0 = list(ds.shard_files(2, 0))       # files 0, 2
+    shard1 = list(ds.shard_files(2, 1))       # files 1, 3
+    assert [b.tolist() for b in shard0] == [[0, 2, 4], [40, 42, 44]]
+    assert [b.tolist() for b in shard1] == [[20, 22, 24], [60, 62, 64]]
+
+
+def test_shard_files_rejects_unreplayable_chain():
+    a = Dataset.range(3)
+    b = Dataset.range(3)
+    z = Dataset.zip(a, b)
+    z._files = ["fake"]          # pretend a file root exists downstream
+    with pytest.raises(ValueError, match="DATA"):
+        z.shard_files(2, 0)
+
+
+def test_interleave_rejects_bad_cycle_length():
+    with pytest.raises(ValueError, match="cycle_length"):
+        Dataset.range(3).interleave(lambda i: Dataset.range(1),
+                                    cycle_length=0)
