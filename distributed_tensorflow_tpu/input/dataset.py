@@ -251,8 +251,8 @@ class Dataset:
             if node._op is None:
                 raise ValueError(
                     "FILE sharding cannot replay this pipeline (a "
-                    "transform without a recorded rebuild op, e.g. "
-                    "Dataset.zip/cache); use AutoShardPolicy.DATA")
+                    "transform without a recorded rebuild op, e.g. a "
+                    "Dataset.zip branch); use AutoShardPolicy.DATA")
             chain.append(node._op)
             node = node._parent
         if not node._files or not hasattr(node, "_reader"):

@@ -140,8 +140,11 @@ def _decode_bytes_list(payload: bytes) -> list:
 
 
 def _decode_feature(payload: bytes):
-    """Feature { bytes_list=1, float_list=2, int64_list=3 }."""
-    for field, _wire, val in _fields(payload):
+    """Feature { bytes_list=1, float_list=2, int64_list=3 } — each a
+    wire-type-2 submessage; other wire types are malformed and skipped."""
+    for field, wire, val in _fields(payload):
+        if wire != 2:
+            continue
         if field == 1:
             return _decode_bytes_list(bytes(val))
         if field == 2:
@@ -155,14 +158,19 @@ def parse_single_example(serialized: bytes, features: dict) -> dict:
     """Parse ONE serialized tf.train.Example against a feature spec
     (≙ tf.io.parse_single_example)."""
     raw: dict = {}
-    for field, _wire, val in _fields(bytes(serialized)):
-        if field != 1:                      # Example.features
+    # Submessages are ALWAYS wire type 2; a matching field number with a
+    # different wire type is garbage input (e.g. a non-Example payload
+    # whose varint would otherwise be misread as a huge bytes length).
+    for field, wire, val in _fields(bytes(serialized)):
+        if field != 1 or wire != 2:         # Example.features
             continue
-        for f2, _w2, fval in _fields(bytes(val)):
-            if f2 != 1:                     # Features.feature (map entry)
+        for f2, w2, fval in _fields(bytes(val)):
+            if f2 != 1 or w2 != 2:          # Features.feature (map entry)
                 continue
             name = value = None
-            for f3, _w3, v3 in _fields(bytes(fval)):
+            for f3, w3, v3 in _fields(bytes(fval)):
+                if w3 != 2:
+                    continue
                 if f3 == 1:
                     name = bytes(v3).decode()
                 elif f3 == 2:
@@ -239,6 +247,11 @@ def iter_tfrecords(path: str) -> Iterator[bytes]:
             if len(header) < 12:
                 raise ValueError(f"truncated TFRecord header in {path}")
             (ln,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if _masked_crc(header[:8]) != len_crc:
+                raise ValueError(
+                    f"TFRecord length crc mismatch in {path} (corrupt "
+                    f"framing)")
             payload = f.read(ln)
             crc = f.read(4)
             if len(payload) < ln or len(crc) < 4:
