@@ -204,3 +204,31 @@ def test_unrolled_layers_match_scan(devices):
     out_u = TransformerLM(cfg_u).apply({"params": params}, toks)
     np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_loss_chunk_policies_agree(devices):
+    """'save' (keep bf16 chunk logits) and 'recompute' are the same
+    math — gradients included."""
+    from distributed_tensorflow_tpu.models.transformer import (
+        fused_next_token_loss)
+    rng = jax.random.PRNGKey(5)
+    k1, k2, k3 = jax.random.split(rng, 3)
+    B, S, D, V = 2, 16, 8, 32
+    hidden = jax.random.normal(k1, (B, S, D), jnp.float32)
+    embed = jax.random.normal(k2, (V, D), jnp.float32)
+    tokens = jax.random.randint(k3, (B, S), 0, V)
+    outs = {}
+    for pol in ("recompute", "save"):
+        loss, grads = jax.value_and_grad(
+            lambda h, e: fused_next_token_loss(
+                h, e, tokens, num_chunks=4, compute_dtype=jnp.float32,
+                chunk_policy=pol), argnums=(0, 1))(hidden, embed)
+        outs[pol] = (float(loss), grads)
+    np.testing.assert_allclose(outs["recompute"][0], outs["save"][0],
+                               rtol=1e-6)
+    for a, b in zip(outs["recompute"][1], outs["save"][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+    with pytest.raises(ValueError, match="chunk_policy"):
+        fused_next_token_loss(hidden, embed, tokens, num_chunks=4,
+                              chunk_policy="bogus")

@@ -40,6 +40,7 @@ def run_one(spec: dict, n_iters=10, reps=3):
         attn_block_q=int(spec.get("bq", 512)),
         attn_block_k=int(spec.get("bk", 1024)),
         loss_chunks=int(spec.get("lc", 0)),
+        loss_chunk_policy=spec.get("lcp", "recompute"),
     )
     if "attn" in spec:
         kw["attention_impl"] = spec["attn"]
