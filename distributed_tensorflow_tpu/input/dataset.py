@@ -79,7 +79,8 @@ class Dataset:
 
     Sources: ``from_tensor_slices``, ``from_files``, ``from_generator``,
     ``range``. Transforms are lazy and compose: map, filter, shuffle, batch,
-    repeat, take, skip, shard, interleave, cache, prefetch (+ Dataset.zip).
+    repeat, take, skip, shard, interleave, cache, padded_batch, prefetch
+    (+ Dataset.zip).
     Iteration yields numpy pytrees.
     """
 
@@ -202,6 +203,92 @@ class Dataset:
                      else -(-self._element_count // batch_size))
         return self._derive(gen, count,
                             op=lambda d: d.batch(batch_size, drop_remainder))
+
+    def padded_batch(self, batch_size: int, padded_shapes=None,
+                     padding_values=0, drop_remainder: bool = False
+                     ) -> "Dataset":
+        """Batch variable-length elements, padding each component to the
+        batch max (or to ``padded_shapes``) — ≙ tf.data
+        Dataset.padded_batch. Elements are numpy pytrees; ragged leaves
+        are padded on EVERY axis to the componentwise maximum."""
+        src = self._gen_fn
+
+        def is_shape(x):
+            """A per-component shape spec: tuple/list of int, -1, or
+            None (both meaning "pad to the batch max", as in tf.data).
+            Being the is_leaf predicate keeps inner Nones from being
+            dropped by tree flattening."""
+            return (isinstance(x, (tuple, list)) and
+                    all(i is None or isinstance(i, int) for i in x))
+
+        def resolve(spec, maxshape, ndim):
+            if spec is None:
+                return maxshape
+            spec = tuple(spec)
+            if len(spec) != ndim:
+                raise ValueError(
+                    f"padded_shapes rank {len(spec)} != element rank "
+                    f"{ndim}")
+            return tuple(m if t is None or t == -1 else t
+                         for t, m in zip(spec, maxshape))
+
+        def pad_stack(leaves, target_shape, fill):
+            out = []
+            for a in leaves:
+                pads = [(0, t - s) for s, t in zip(a.shape, target_shape)]
+                if any(p[1] < 0 for p in pads):
+                    raise ValueError(
+                        f"element shape {a.shape} exceeds padded_shapes "
+                        f"{target_shape}")
+                out.append(np.pad(a, pads, constant_values=fill)
+                           if pads else a)
+            return np.stack(out)
+
+        def gen():
+            it = src()
+            shapes_spec = fills = treedef = None    # set from first chunk
+            while True:
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk:
+                    return
+                if len(chunk) < batch_size and drop_remainder:
+                    return
+                leaves_t = [jax.tree_util.tree_leaves(c) for c in chunk]
+                if treedef is None:                 # loop-invariant setup
+                    treedef = jax.tree_util.tree_structure(chunk[0])
+                    n_leaves = len(leaves_t[0])
+                    shapes_spec = (jax.tree_util.tree_leaves(
+                                       padded_shapes, is_leaf=is_shape)
+                                   if padded_shapes is not None
+                                   else [None] * n_leaves)
+                    if len(shapes_spec) != n_leaves:
+                        raise ValueError(
+                            f"padded_shapes has {len(shapes_spec)} "
+                            f"components; elements have {n_leaves}")
+                    fills = (jax.tree_util.tree_leaves(padding_values)
+                             if isinstance(padding_values,
+                                           (list, tuple, dict))
+                             else [padding_values] * n_leaves)
+                cols = []
+                for li in range(len(leaves_t[0])):
+                    col = [np.asarray(leaves_t[ei][li])
+                           for ei in range(len(chunk))]
+                    maxshape = tuple(
+                        max(a.shape[d] for a in col)
+                        for d in range(col[0].ndim))
+                    target = resolve(shapes_spec[li], maxshape,
+                                     col[0].ndim)
+                    cols.append(pad_stack(col, target, fills[li]))
+                yield jax.tree_util.tree_unflatten(treedef, cols)
+
+        count = None
+        if self._element_count is not None:
+            count = (self._element_count // batch_size if drop_remainder
+                     else -(-self._element_count // batch_size))
+        return self._derive(
+            gen, count,
+            op=lambda d: d.padded_batch(batch_size, padded_shapes,
+                                        padding_values, drop_remainder))
 
     def repeat(self, count: int | None = None) -> "Dataset":
         src = self._gen_fn

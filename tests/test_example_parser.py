@@ -127,3 +127,51 @@ def test_encode_numpy_bytes_and_negative_ints():
     assert parsed["neg"].tolist() == [-1, -2]
     with pytest.raises(ValueError, match="ambiguous"):
         encode_example({"empty": []})
+
+
+def test_fuzz_interop_against_tf_encoder():
+    """200 random Examples encoded by TF must parse identically here:
+    random feature names, list types, lengths (incl. empty), extreme
+    int64s, and non-ASCII names."""
+    try:
+        from tensorflow.core.example import example_pb2
+    except Exception as e:
+        pytest.skip(f"tensorflow protos unavailable: {e}")
+    rng = np.random.default_rng(42)
+    for trial in range(200):
+        ex = example_pb2.Example()
+        expect = {}
+        for fi in range(rng.integers(1, 5)):
+            name = f"f{trial}_{fi}_é"
+            kind = rng.integers(3)
+            n = int(rng.integers(0, 6))
+            f = ex.features.feature[name]
+            if kind == 0:
+                vals = rng.normal(size=n).astype(np.float32)
+                f.float_list.value.extend([float(v) for v in vals])
+                expect[name] = ("float", vals)
+            elif kind == 1:
+                vals = rng.integers(-2**62, 2**62, size=n)
+                f.int64_list.value.extend([int(v) for v in vals])
+                expect[name] = ("int", vals.astype(np.int64))
+            else:
+                vals = [bytes(rng.integers(0, 256, size=rng.integers(0, 9),
+                                           dtype=np.uint8).tobytes())
+                        for _ in range(n)]
+                f.bytes_list.value.extend(vals)
+                expect[name] = ("bytes", vals)
+        spec = {name: VarLenFeature(object if k == "bytes" else
+                                    (np.int64 if k == "int" else np.float32))
+                for name, (k, _) in expect.items()}
+        parsed = parse_single_example(ex.SerializeToString(), spec)
+        for name, (k, vals) in expect.items():
+            got = parsed[name]
+            if k == "bytes":
+                assert got == vals or (vals == [] and len(got) == 0), \
+                    (trial, name, got, vals)
+            elif k == "int":
+                np.testing.assert_array_equal(np.asarray(got, np.int64),
+                                              vals, err_msg=f"{trial}/{name}")
+            else:
+                np.testing.assert_allclose(np.asarray(got, np.float32),
+                                           vals, err_msg=f"{trial}/{name}")

@@ -225,3 +225,40 @@ def test_interleave_rejects_bad_cycle_length():
     with pytest.raises(ValueError, match="cycle_length"):
         Dataset.range(3).interleave(lambda i: Dataset.range(1),
                                     cycle_length=0)
+
+
+def test_padded_batch_ragged_to_max():
+    ds = Dataset.from_iterable(
+        [{"ids": np.arange(n, dtype=np.int64), "n": np.int64(n)}
+         for n in (1, 3, 2, 4)]).padded_batch(2, padding_values=-1)
+    b1, b2 = list(ds)
+    assert b1["ids"].shape == (2, 3)
+    assert b1["ids"][0].tolist() == [0, -1, -1]
+    assert b2["ids"].shape == (2, 4)
+    assert b1["n"].tolist() == [1, 3]
+
+
+def test_padded_batch_explicit_shapes_and_overflow():
+    ds = Dataset.from_iterable([np.arange(2), np.arange(3)])
+    out = list(ds.padded_batch(2, padded_shapes=((5,),)))[0]
+    assert out.shape == (2, 5)
+    with pytest.raises(ValueError, match="exceeds"):
+        list(Dataset.from_iterable([np.arange(9)])
+             .padded_batch(1, padded_shapes=((5,),)))
+
+
+def test_padded_batch_none_and_list_specs():
+    """TF spellings: None / -1 dims mean pad-to-batch-max; lists work;
+    rank mismatch raises."""
+    ds = Dataset.from_iterable(
+        [{"ids": np.arange(n, dtype=np.int64), "n": np.int64(n)}
+         for n in (2, 3)])
+    out = list(ds.padded_batch(
+        2, padded_shapes={"ids": (None,), "n": ()}))[0]
+    assert out["ids"].shape == (2, 3)
+    out2 = list(Dataset.from_iterable([np.arange(2), np.arange(3)])
+                .padded_batch(2, padded_shapes=[[-1]]))[0]
+    assert out2.shape == (2, 3)
+    with pytest.raises(ValueError, match="rank"):
+        list(Dataset.from_iterable([np.arange(2)])
+             .padded_batch(1, padded_shapes=((5, 2),)))
