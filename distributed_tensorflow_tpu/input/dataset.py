@@ -26,6 +26,7 @@ import itertools
 import math
 import queue
 import threading
+import weakref
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -332,6 +333,21 @@ class Dataset:
         batching per shard."""
         if not self._files:
             raise ValueError("Dataset has no file list; use DATA sharding")
+        if not 0 <= index < num_shards:
+            raise ValueError(
+                f"shard index {index} out of range [0, {num_shards}); an "
+                f"out-of-range index would silently alias another shard's "
+                f"files (duplicate samples)")
+        if len(self._files) < num_shards:
+            # Deterministic on EVERY worker (≙ tf.data FILE auto-shard's
+            # 'not enough files' error) — erroring only on the
+            # empty-shard workers would leave the others deadlocked in
+            # collectives waiting for crashed peers.
+            raise ValueError(
+                f"FILE sharding needs >= num_shards files: "
+                f"{len(self._files)} file(s) cannot be sharded "
+                f"{num_shards} ways. Use more files or "
+                f"AutoShardPolicy.DATA.")
         chain = []
         node = self
         while getattr(node, "_parent", None) is not None:
@@ -373,9 +389,14 @@ class Dataset:
             while True:
                 while not exhausted_src and len(open_its) < cycle_length:
                     try:
-                        open_its.append(iter(map_fn(next(elements))))
+                        element = next(elements)
                     except StopIteration:
                         exhausted_src = True
+                        break
+                    # map_fn runs OUTSIDE the except: a StopIteration
+                    # leaked by user code must not masquerade as source
+                    # exhaustion (PEP 479 semantics).
+                    open_its.append(iter(map_fn(element)))
                 if not open_its:
                     return
                 keep = []
@@ -445,25 +466,44 @@ class Dataset:
 
 
 class _BackgroundIterator:
-    """Background-thread prefetch with a bounded queue."""
+    """Background-thread prefetch with a bounded queue.
+
+    Shuts down cleanly when abandoned: the worker parks on a bounded
+    ``put`` that also watches a stop flag, and a ``weakref.finalize``
+    (which the interpreter runs at exit for still-alive objects) stops
+    and joins the thread — a daemon thread killed mid-``device_put``
+    inside XLA aborts the whole process at teardown otherwise."""
 
     _SENTINEL = object()
 
     def __init__(self, it: Iterator, buffer_size: int):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, buffer_size))
-        self._err: BaseException | None = None
+        # One-element holder, NOT an attribute: the worker closure must
+        # hold no reference to self, or the finalizer's strong args
+        # (registry → thread → closure → self) would keep the iterator
+        # alive forever and the GC teardown path would never fire.
+        self._err_box: list[BaseException] = []
+        self._stop = threading.Event()
+        q, stop, sentinel = self._q, self._stop, self._SENTINEL
+        err_box = self._err_box
 
         def worker():
             try:
                 for x in it:
-                    self._q.put(x)
+                    if not _put_unless_stopped(q, stop, x):
+                        return
             except BaseException as e:  # propagate to consumer
-                self._err = e
+                err_box.append(e)
             finally:
-                self._q.put(self._SENTINEL)
+                _put_unless_stopped(q, stop, sentinel)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+        self._finalizer = weakref.finalize(
+            self, _stop_background_worker, stop, q, self._thread, sentinel)
+
+    def close(self):
+        self._finalizer()
 
     def __iter__(self):
         return self
@@ -471,10 +511,50 @@ class _BackgroundIterator:
     def __next__(self):
         x = self._q.get()
         if x is self._SENTINEL:
-            if self._err is not None:
-                raise self._err
+            if self._err_box:
+                raise self._err_box[0]
             raise StopIteration
         return x
+
+
+def _put_unless_stopped(q: "queue.Queue", stop: "threading.Event",
+                        item) -> bool:
+    """Bounded put that also watches the stop flag; False once stopped."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _stop_background_worker(stop: "threading.Event", q: "queue.Queue",
+                            thread: "threading.Thread",
+                            sentinel) -> None:
+    """Module-level so the finalizer holds no reference to the iterator."""
+    stop.set()
+    # Drain to unblock a worker parked on a full queue, then re-arm the
+    # sentinel so a consumer parked in __next__'s blocking get() raises
+    # StopIteration instead of hanging. Loop because a worker put
+    # already in flight when stop was set can refill the slot between
+    # our drain and our put (narrow race at buffer_size==1); after stop
+    # is observed the worker puts nothing more, so this terminates.
+    while True:
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            q.put_nowait(sentinel)
+            break
+        except queue.Full:
+            continue
+    # GC can run the finalizer on the worker thread itself (any
+    # allocation there can trigger collection); joining yourself raises.
+    if thread is not threading.current_thread():
+        thread.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,8 @@ class VarLenFeature:
 def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
     result = shift = 0
     while True:
+        if pos >= len(buf):
+            raise ValueError("truncated message")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -59,21 +61,6 @@ def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
         shift += 7
         if shift > 63:
             raise ValueError("malformed varint")
-
-
-def _skip_field(buf: bytes, pos: int, wire: int) -> int:
-    if wire == 0:
-        _, pos = _read_varint(buf, pos)
-    elif wire == 1:
-        pos += 8
-    elif wire == 2:
-        ln, pos = _read_varint(buf, pos)
-        pos += ln
-    elif wire == 5:
-        pos += 4
-    else:
-        raise ValueError(f"unsupported wire type {wire}")
-    return pos
 
 
 def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
@@ -86,13 +73,22 @@ def _fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
         if wire == 0:
             val, pos = _read_varint(buf, pos)
         elif wire == 1:
+            if pos + 8 > n:
+                raise ValueError("truncated message")
             val = buf[pos:pos + 8]
             pos += 8
         elif wire == 2:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                # A declared length running past the buffer end means a
+                # truncated/corrupt proto; silently clipping the slice
+                # would yield WRONG feature values downstream.
+                raise ValueError("truncated message")
             val = buf[pos:pos + ln]
             pos += ln
         elif wire == 5:
+            if pos + 4 > n:
+                raise ValueError("truncated message")
             val = buf[pos:pos + 4]
             pos += 4
         else:
@@ -300,6 +296,11 @@ def encode_example(feature_dict: dict) -> bytes:
             feat = _len_delim(1, payload)           # bytes_list = 1
         else:
             arr = np.asarray(value).ravel()
+            if arr.dtype == bool:
+                # np.bool_ is not a np.integer subtype; without this a
+                # bool feature lands in float_list and then fails the
+                # int64 FixedLenFeature spec a migrating user writes.
+                arr = arr.astype(np.int64)
             mask = (1 << 64) - 1
             if np.issubdtype(arr.dtype, np.integer):
                 packed = b"".join(_varint(int(v) & mask) for v in arr)

@@ -175,3 +175,26 @@ def test_fuzz_interop_against_tf_encoder():
             else:
                 np.testing.assert_allclose(np.asarray(got, np.float32),
                                            vals, err_msg=f"{trial}/{name}")
+
+def test_truncated_proto_raises_not_truncates():
+    """A length-delimited field whose declared length runs past the
+    buffer end must raise, not silently clip (ADVICE r3): a corrupt
+    proto fed directly to parse_single_example (bypassing TFRecord crc
+    framing) must not yield wrong feature values."""
+    good = encode_example({"x": np.arange(64, dtype=np.int64)})
+    spec = {"x": FixedLenFeature((64,), np.int64)}
+    assert parse_single_example(good, spec)["x"][5] == 5
+    # every possible truncation point raises ValueError — including cuts
+    # landing mid-varint (exercises the _read_varint bounds check)
+    for cut in range(len(good)):
+        with pytest.raises(ValueError):
+            parse_single_example(good[:cut], spec)
+
+
+def test_encode_bool_array_as_int64():
+    """np.bool_ is not np.integer; bools must land in int64_list so the
+    int64 FixedLenFeature spec a migrating user writes parses."""
+    msg = encode_example({"flags": np.array([True, False, True])})
+    out = parse_single_example(
+        msg, {"flags": FixedLenFeature((3,), np.int64)})
+    assert out["flags"].tolist() == [1, 0, 1]

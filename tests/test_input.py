@@ -262,3 +262,94 @@ def test_padded_batch_none_and_list_specs():
     with pytest.raises(ValueError, match="rank"):
         list(Dataset.from_iterable([np.arange(2)])
              .padded_batch(1, padded_shapes=((5, 2),)))
+
+def test_shard_files_not_enough_files_raises_on_every_worker(tmp_path):
+    """num_shards > len(files) must error loudly ON EVERY worker (≙
+    tf.data FILE auto-shard 'not enough files'), not only on the
+    empty-shard workers — otherwise the non-empty-shard workers enter
+    collectives and deadlock waiting for crashed peers."""
+    f = tmp_path / "only.txt"
+    f.write_text("")
+
+    def reader(path):
+        yield from range(3)
+
+    ds = Dataset.from_files([str(f)], reader).repeat()
+    assert list(ds.shard_files(1, 0).take(3)) == [0, 1, 2]
+    for index in range(2):       # both workers, incl. the non-empty one
+        with pytest.raises(ValueError, match="num_shards"):
+            ds.shard_files(2, index)
+
+
+def test_shard_files_out_of_range_index_raises(tmp_path):
+    """index >= num_shards (or negative) would silently alias another
+    shard's files — duplicate samples — so it must raise."""
+    files = []
+    for i in range(4):
+        f = tmp_path / f"f{i}.txt"
+        f.write_text("")
+        files.append(str(f))
+
+    def reader(path):
+        yield 0
+
+    ds = Dataset.from_files(files, reader)
+    for bad in (2, -1):
+        with pytest.raises(ValueError, match="out of range"):
+            ds.shard_files(2, bad)
+
+
+def test_interleave_leaked_stopiteration_not_exhaustion():
+    """A StopIteration raised INSIDE user map_fn must surface as an
+    error (PEP 479 converts it to RuntimeError inside the generator),
+    not silently truncate the dataset."""
+    def bad_map_fn(i):
+        if i == 1:
+            raise StopIteration
+        return Dataset.from_iterable([i])
+
+    with pytest.raises(RuntimeError):
+        list(Dataset.range(3).interleave(bad_map_fn, cycle_length=1))
+
+def test_background_iterator_close_then_next_stops():
+    """close() must leave a parked/subsequent next() with StopIteration,
+    not a forever-blocking get, and must not self-join (finalizer can
+    run on the worker thread under GC)."""
+    from distributed_tensorflow_tpu.input.dataset import _BackgroundIterator
+
+    bi = _BackgroundIterator(iter(range(1000)), 2)
+    assert next(bi) == 0
+    bi.close()
+    with pytest.raises(StopIteration):
+        while True:          # drain whatever was buffered, then sentinel
+            next(bi)
+
+
+def test_prefetch_abandoned_iterator_collected_and_thread_stopped():
+    """Abandoning a prefetch iterator mid-consumption must let GC
+    collect it (the worker closure must NOT capture self — the
+    finalizer holds its args strongly) and stop+join the worker thread;
+    guards both the leak and the interpreter-exit abort seen with a
+    half-consumed distributed iterator."""
+    import gc
+    import itertools
+    import threading
+    import weakref
+    from distributed_tensorflow_tpu.input.dataset import _BackgroundIterator
+
+    bi = _BackgroundIterator(iter(itertools.count()), 2)
+    assert next(bi) == 0
+    thread = bi._thread
+    ref = weakref.ref(bi)
+    del bi
+    gc.collect()
+    assert ref() is None, "worker closure keeps the iterator alive"
+    thread.join(timeout=5.0)
+    assert not thread.is_alive(), "worker thread leaked after GC"
+
+    # the generator-wrapped path (Dataset.prefetch) tears down too
+    ds = Dataset.range(10_000).prefetch(2)
+    it = iter(ds)
+    assert next(it) == 0
+    del it, ds
+    gc.collect()
