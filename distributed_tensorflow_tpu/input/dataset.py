@@ -483,6 +483,7 @@ class _BackgroundIterator:
         # (registry → thread → closure → self) would keep the iterator
         # alive forever and the GC teardown path would never fire.
         self._err_box: list[BaseException] = []
+        self._done = False
         self._stop = threading.Event()
         q, stop, sentinel = self._q, self._stop, self._SENTINEL
         err_box = self._err_box
@@ -509,8 +510,17 @@ class _BackgroundIterator:
         return self
 
     def __next__(self):
+        # Exhaustion is sticky: the single sentinel is consumed on first
+        # hit, and the dead worker will never put again — without the
+        # flag a second next()/get_next_as_optional() would block
+        # forever on the empty queue.
+        if self._done:
+            if self._err_box:
+                raise self._err_box[0]
+            raise StopIteration
         x = self._q.get()
         if x is self._SENTINEL:
+            self._done = True
             if self._err_box:
                 raise self._err_box[0]
             raise StopIteration
@@ -641,15 +651,18 @@ class DistributedIterator:
         self._fetch = options.experimental_fetch_to_device
         src = iter(dataset)
         if self._fetch:
+            # Capture the strategy method, NOT self: a bound self._place
+            # inside the worker's map() would make the worker thread (a
+            # GC root) keep this iterator reachable, so an abandoned
+            # half-consumed iterator would never be collected and its
+            # prefetch thread would park forever holding device batches.
+            place = self._strategy.shard_batch
             buffered = _BackgroundIterator(
-                map(self._place, src),
+                map(place, src),
                 options.experimental_per_replica_buffer_size)
             self._it = iter(buffered)
         else:
             self._it = src
-
-    def _place(self, batch):
-        return self._strategy.shard_batch(batch)
 
     def __iter__(self):
         return self

@@ -353,3 +353,39 @@ def test_prefetch_abandoned_iterator_collected_and_thread_stopped():
     assert next(it) == 0
     del it, ds
     gc.collect()
+
+def test_background_iterator_exhaustion_is_sticky():
+    """After normal exhaustion, subsequent next()/get_next_as_optional()
+    must keep raising StopIteration / returning None, not block forever
+    on the empty queue (the dead worker never puts again)."""
+    from distributed_tensorflow_tpu.input.dataset import _BackgroundIterator
+
+    bi = _BackgroundIterator(iter(range(3)), 2)
+    assert list(bi) == [0, 1, 2]
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(bi)
+
+
+def test_distributed_iterator_abandoned_is_collected(devices):
+    """The production path: a half-consumed DistributedIterator with
+    fetch_to_device=True must be GC-collectable (the prefetch worker
+    must hold no reference back through the iterator) and its worker
+    thread must stop."""
+    import gc
+    import weakref
+    import distributed_tensorflow_tpu as dtx
+
+    strategy = dtx.MirroredStrategy()
+    ds = Dataset.from_tensor_slices(
+        np.arange(1024, dtype=np.float32)).batch(16).repeat()
+    it = iter(strategy.experimental_distribute_dataset(ds))
+    next(it)
+    inner = it._it                     # the _BackgroundIterator
+    thread = inner._thread
+    ref = weakref.ref(inner)
+    del it, inner
+    gc.collect()
+    assert ref() is None, "prefetch worker keeps DistributedIterator alive"
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
