@@ -1,0 +1,398 @@
+"""Numerics parity against the ACTUAL reference stack (tf_keras 2.21 +
+tf.distribute on CPU, installed on this machine).
+
+This is BASELINE.json's north-star metric ("matched step accuracy vs
+reference") tested directly rather than framework-vs-itself: the same
+model with the SAME initial weights, SAME data order, and SAME SGD
+hyperparameters runs once with the reference stack
+(``tf_keras`` + ``tf.distribute.MirroredStrategy`` on CPU — the
+reference's config #1 path, TFK/src/distribute/
+keras_correctness_test_base.py pattern per SURVEY.md §4) and once with
+this framework (``dtx.MirroredStrategy`` over the virtual 8-device CPU
+mesh).
+
+Assertion design (mirrors how the reference's own correctness tests
+handle fp32 chaos): the SINGLE-step quantities — forward loss, the full
+gradient pytree, and the post-SGD-update weights — must match to float
+round-off (~1e-5), because one step has no chaotic amplification. The
+50-step loss CURVE matches with a drift bound: identical fp32 math
+compiled by two different compilers (XLA vs TF's grappler) differs in
+summation order by ~1 ulp per op, and ReLU/pooling boundaries amplify
+that discretely over steps; the curves here agree to ~1e-6 for the
+first steps and stay within ~1e-2 relative through step 50 (seeded, so
+deterministic on this box; bounds carry ~10x margin). Final eval
+accuracy must match to 1%.
+
+Layer-level checks pin the transformer building blocks (multi-head
+attention, the full encoder block, dense + softmax-CE) forward AND
+backward against their tf_keras equivalents with mapped weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu.models import mnist_cnn
+
+tf = pytest.importorskip("tensorflow")
+tf_keras = pytest.importorskip("tf_keras")
+
+STEPS = 50
+BATCH = 64
+LR = 0.05
+
+
+def _build_keras_cnn() -> "tf_keras.Model":
+    """The exact architecture of models/mnist_cnn.MNISTCNN, in tf_keras.
+    flax nn.Conv defaults to padding='SAME'; keras Conv2D to 'valid' —
+    set explicitly. flax nn.max_pool((2,2),(2,2)) == MaxPooling2D(2)."""
+    return tf_keras.Sequential([
+        tf_keras.layers.Input((28, 28, 1)),
+        tf_keras.layers.Conv2D(32, 3, padding="same", activation="relu"),
+        tf_keras.layers.Conv2D(64, 3, padding="same", activation="relu"),
+        tf_keras.layers.MaxPooling2D(2),
+        tf_keras.layers.Flatten(),
+        tf_keras.layers.Dense(128, activation="relu"),
+        tf_keras.layers.Dense(10),
+    ])
+
+
+def _keras_weights_to_flax(weights: list) -> dict:
+    """keras get_weights() order (conv1 k,b, conv2 k,b, dense1 k,b,
+    dense2 k,b) → flax param tree. Kernel layouts already agree:
+    Conv (H, W, Cin, Cout), Dense (in, out)."""
+    w = [np.asarray(x) for x in weights]
+    return {
+        "Conv_0": {"kernel": w[0], "bias": w[1]},
+        "Conv_1": {"kernel": w[2], "bias": w[3]},
+        "Dense_0": {"kernel": w[4], "bias": w[5]},
+        "Dense_1": {"kernel": w[6], "bias": w[7]},
+    }
+
+
+def _flax_to_keras_weights(params: dict) -> list:
+    return [np.asarray(params[k][p]) for k in
+            ("Conv_0", "Conv_1", "Dense_0", "Dense_1")
+            for p in ("kernel", "bias")]
+
+
+def _train_reference(batches) -> tuple[list, list, list, "tf_keras.Model"]:
+    """Train with the installed reference stack: tf_keras model under
+    tf.distribute.MirroredStrategy on CPU, plain SGD, mean softmax-CE
+    (≙ the reference's config #1 script shape, SURVEY.md §3.1).
+    Returns (losses, init_weights, final_weights, model)."""
+    strategy = tf.distribute.MirroredStrategy(["/cpu:0"])
+    with strategy.scope():
+        model = _build_keras_cnn()
+        opt = tf_keras.optimizers.SGD(LR)
+    init_weights = [np.copy(w) for w in model.get_weights()]
+
+    @tf.function
+    def step(images, labels):
+        def replica_step(im, lb):
+            with tf.GradientTape() as tape:
+                logits = model(im, training=True)
+                loss = tf.reduce_mean(
+                    tf.nn.sparse_softmax_cross_entropy_with_logits(
+                        labels=lb, logits=logits))
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            return loss
+
+        per_replica = strategy.run(replica_step, args=(images, labels))
+        return strategy.reduce(tf.distribute.ReduceOp.MEAN, per_replica,
+                               axis=None)
+
+    losses = [float(step(tf.constant(b["image"]),
+                         tf.constant(b["label"])))
+              for b in batches]
+    return losses, init_weights, model.get_weights(), model
+
+
+def _train_ours(init_params: dict, batches) -> tuple[list, dict]:
+    """Train the same model/weights with THIS framework: flax MNISTCNN
+    under dtx.MirroredStrategy on the 8-device mesh, optax SGD."""
+    model = mnist_cnn.MNISTCNN()
+    params = jax.tree_util.tree_map(jnp.asarray, init_params)
+    tx = optax.sgd(LR)
+    state = {"params": params, "opt_state": tx.init(params), "step": 0}
+
+    strategy = dtx.MirroredStrategy()
+    state = strategy.replicate(state)
+    step_fn = strategy.compile_step(mnist_cnn.make_train_step(model, tx))
+
+    ds = dtx.Dataset.from_iterable(batches)
+    dist = strategy.experimental_distribute_dataset(ds)
+    losses = []
+    for sharded in dist:
+        state, metrics = step_fn(state, sharded)
+        losses.append(float(metrics["loss"]))
+    return losses, jax.tree_util.tree_map(np.asarray, state["params"])
+
+
+@pytest.fixture(scope="module")
+def mnist_batches():
+    data = mnist_cnn.synthetic_data(n=STEPS * BATCH, seed=7)
+    return [
+        {"image": data["image"][i * BATCH:(i + 1) * BATCH],
+         "label": data["label"][i * BATCH:(i + 1) * BATCH].astype("int32")}
+        for i in range(STEPS)
+    ]
+
+
+@pytest.fixture(scope="module")
+def mnist_runs(mnist_batches):
+    """One seeded 50-step training run through EACH stack, shared by the
+    curve/metric tests (two full runs are the expensive part)."""
+    tf_keras.utils.set_random_seed(0)
+    ref_losses, init_w, ref_final, keras_model = _train_reference(
+        mnist_batches)
+    our_losses, our_params = _train_ours(
+        _keras_weights_to_flax(init_w), mnist_batches)
+    return {"ref_losses": np.asarray(ref_losses),
+            "our_losses": np.asarray(our_losses),
+            "init_w": init_w, "ref_final": ref_final,
+            "our_params": our_params, "keras_model": keras_model}
+
+
+# ---------------------------------------------------------------------------
+# Config #1 (MNIST CNN): matched-step numerics vs the reference stack
+# ---------------------------------------------------------------------------
+
+def test_mnist_single_step_loss_grads_update_match_reference(mnist_batches):
+    """THE matched-step claim, tight: same weights + same batch →
+    reference and this framework produce the same loss, the same
+    gradient for every parameter, and the same post-SGD weights, to
+    float32 round-off. No chaotic accumulation in one step."""
+    tf_keras.utils.set_random_seed(1)
+    model = _build_keras_cnn()
+    init_w = [np.copy(w) for w in model.get_weights()]
+    batch = mnist_batches[0]
+
+    with tf.GradientTape() as tape:
+        logits = model(tf.constant(batch["image"]), training=True)
+        ref_loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=tf.constant(batch["label"]), logits=logits))
+    ref_grads = tape.gradient(ref_loss, model.trainable_variables)
+    ref_grads = [np.asarray(g) for g in ref_grads]
+
+    params = jax.tree_util.tree_map(jnp.asarray,
+                                    _keras_weights_to_flax(init_w))
+    flax_model = mnist_cnn.MNISTCNN()
+
+    def loss_fn(p):
+        lg = flax_model.apply({"params": p}, jnp.asarray(batch["image"]))
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg, jnp.asarray(batch["label"])).mean()
+
+    our_loss, our_grads = jax.value_and_grad(loss_fn)(params)
+
+    assert float(our_loss) == pytest.approx(float(ref_loss), rel=1e-6)
+    ref_grad_tree = _keras_weights_to_flax(ref_grads)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), b, rtol=1e-4, atol=1e-6),
+        our_grads, ref_grad_tree)
+
+    # one SGD step → identical new weights
+    new_ref = [w - LR * g for w, g in zip(init_w, ref_grads)]
+    new_ours = jax.tree_util.tree_map(lambda p, g: p - LR * g,
+                                      params, our_grads)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), b, rtol=1e-5, atol=1e-7),
+        new_ours, _keras_weights_to_flax(new_ref))
+
+
+def test_mnist_50_step_loss_curve_parity(devices, mnist_runs):
+    """The 50-step loss curves: float-exact early, bounded drift late
+    (compiler-level summation-order differences amplified through
+    ReLU/pool boundaries — see module docstring)."""
+    ref, ours = mnist_runs["ref_losses"], mnist_runs["our_losses"]
+    assert ref[-1] < ref[0] and ours[-1] < ours[0]   # both actually train
+    rel = np.abs(ours - ref) / np.abs(ref)
+    assert rel[:5].max() < 1e-4, f"early-step drift {rel[:5].max()}"
+    assert rel.max() < 5e-2, f"curve drift {rel.max()}"
+    assert rel.mean() < 1e-2, f"mean curve drift {rel.mean()}"
+
+
+def test_mnist_final_metric_parity(mnist_runs):
+    """Matched step ACCURACY: after 50 identical steps, eval accuracy on
+    held-out data agrees to 1% between the stacks."""
+    held = mnist_cnn.synthetic_data(n=1024, seed=99)
+    ref_logits = mnist_runs["keras_model"](
+        tf.constant(held["image"]), training=False).numpy()
+    our_logits = np.asarray(mnist_cnn.MNISTCNN().apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray,
+                                          mnist_runs["our_params"])},
+        jnp.asarray(held["image"])))
+    ref_acc = float(np.mean(ref_logits.argmax(-1) == held["label"]))
+    our_acc = float(np.mean(our_logits.argmax(-1) == held["label"]))
+    assert abs(ref_acc - our_acc) <= 0.01, (ref_acc, our_acc)
+
+
+def test_mnist_weights_into_reference_model_reproduce_loss(mnist_runs,
+                                                           mnist_batches):
+    """Cross-load: OUR final weights pushed back into the reference
+    model reproduce our final training loss in the reference stack —
+    the strongest form of 'a reference user can switch'."""
+    model = _build_keras_cnn()
+    model.set_weights(_flax_to_keras_weights(mnist_runs["our_params"]))
+    b = mnist_batches[-1]
+    logits = model(tf.constant(b["image"]), training=False)
+    ref_loss = float(tf.reduce_mean(
+        tf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=tf.constant(b["label"]), logits=logits)))
+
+    def our_loss_fn():
+        lg = mnist_cnn.MNISTCNN().apply(
+            {"params": jax.tree_util.tree_map(
+                jnp.asarray, mnist_runs["our_params"])},
+            jnp.asarray(b["image"]))
+        return float(optax.softmax_cross_entropy_with_integer_labels(
+            lg, jnp.asarray(b["label"])).mean())
+
+    assert ref_loss == pytest.approx(our_loss_fn(), rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Layer-level: transformer building blocks vs tf_keras equivalents
+# ---------------------------------------------------------------------------
+
+def test_multi_head_attention_fwd_bwd_matches_tf_keras():
+    """Our attention op (flash_attention reference impl) with keras
+    MultiHeadAttention's weights reproduces its forward output AND
+    input gradient (TFK/src/layers/attention/multi_head_attention.py)."""
+    B, S, D, H = 2, 8, 32, 4
+    hd = D // H
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, S, D)).astype(np.float32)
+
+    layer = tf_keras.layers.MultiHeadAttention(num_heads=H, key_dim=hd)
+    _ = layer(x, x)                                   # build
+    (wq, bq, wk, bk, wv, bv, wo, bo) = [np.asarray(w)
+                                        for w in layer.get_weights()]
+
+    xt = tf.constant(x)
+    with tf.GradientTape() as tape:
+        tape.watch(xt)
+        ref_out = layer(xt, xt, training=False)
+        ref_sum = tf.reduce_sum(ref_out)
+    ref_grad = tape.gradient(ref_sum, xt).numpy()
+
+    from distributed_tensorflow_tpu.ops.attention import flash_attention
+
+    def ours(xj):
+        q = jnp.einsum("bsd,dhk->bshk", xj, wq) + bq
+        k = jnp.einsum("bsd,dhk->bshk", xj, wk) + bk
+        v = jnp.einsum("bsd,dhk->bshk", xj, wv) + bv
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        o = flash_attention(q, k, v, causal=False,
+                            implementation="reference")
+        o = o.transpose(0, 2, 1, 3)
+        return jnp.einsum("bshk,hkd->bsd", o, wo) + bo
+
+    our_out = np.asarray(ours(jnp.asarray(x)))
+    np.testing.assert_allclose(our_out, ref_out.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    our_grad = np.asarray(jax.grad(lambda xj: ours(xj).sum())(
+        jnp.asarray(x)))
+    np.testing.assert_allclose(our_grad, ref_grad, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_block_fwd_bwd_matches_tf_keras():
+    """A full post-LN encoder block (MHA + residual + LayerNorm + relu
+    MLP + residual + LayerNorm) — the reference's BERT block shape —
+    composed from our ops with keras weights matches tf_keras forward
+    and backward."""
+    B, S, D, H, F = 2, 8, 32, 4, 64
+    hd = D // H
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, S, D)).astype(np.float32)
+
+    mha = tf_keras.layers.MultiHeadAttention(num_heads=H, key_dim=hd)
+    ln1 = tf_keras.layers.LayerNormalization(epsilon=1e-6)
+    ln2 = tf_keras.layers.LayerNormalization(epsilon=1e-6)
+    d1 = tf_keras.layers.Dense(F, activation="relu")
+    d2 = tf_keras.layers.Dense(D)
+
+    def keras_block(t):
+        h = ln1(t + mha(t, t, training=False))
+        return ln2(h + d2(d1(h)))
+
+    xt = tf.constant(x)
+    with tf.GradientTape() as tape:
+        tape.watch(xt)
+        ref_out = keras_block(xt)
+        ref_sum = tf.reduce_sum(ref_out * ref_out)
+    ref_grad = tape.gradient(ref_sum, xt).numpy()
+
+    (wq, bq, wk, bk, wv, bv, wo, bo) = [np.asarray(w)
+                                        for w in mha.get_weights()]
+    g1, be1 = [np.asarray(w) for w in ln1.get_weights()]
+    g2, be2 = [np.asarray(w) for w in ln2.get_weights()]
+    k1, bd1 = [np.asarray(w) for w in d1.get_weights()]
+    k2, bd2 = [np.asarray(w) for w in d2.get_weights()]
+
+    from distributed_tensorflow_tpu.ops.attention import flash_attention
+
+    def layer_norm(t, gamma, beta, eps=1e-6):
+        mu = t.mean(-1, keepdims=True)
+        var = ((t - mu) ** 2).mean(-1, keepdims=True)
+        return (t - mu) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+    def ours_block(xj):
+        q = jnp.einsum("bsd,dhk->bshk", xj, wq) + bq
+        k = jnp.einsum("bsd,dhk->bshk", xj, wk) + bk
+        v = jnp.einsum("bsd,dhk->bshk", xj, wv) + bv
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        o = flash_attention(q, k, v, causal=False,
+                            implementation="reference")
+        att = jnp.einsum("bshk,hkd->bsd", o.transpose(0, 2, 1, 3),
+                         wo) + bo
+        h = layer_norm(xj + att, g1, be1)
+        mlp = jnp.maximum(h @ k1 + bd1, 0.0) @ k2 + bd2
+        return layer_norm(h + mlp, g2, be2)
+
+    our_out = np.asarray(ours_block(jnp.asarray(x)))
+    np.testing.assert_allclose(our_out, ref_out.numpy(), rtol=1e-5,
+                               atol=1e-5)
+    our_grad = np.asarray(jax.grad(
+        lambda xj: (ours_block(xj) ** 2).sum())(jnp.asarray(x)))
+    np.testing.assert_allclose(our_grad, ref_grad, rtol=1e-4, atol=1e-4)
+
+
+def test_dense_softmax_ce_grads_match_tf():
+    """Weight-gradient parity for the classifier head: dense + mean
+    softmax-CE (≙ TF/python/ops/nn_ops.py fused softmax-CE lowering)."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 20)).astype(np.float32)
+    w = rng.normal(size=(20, 10)).astype(np.float32) * 0.1
+    b = np.zeros(10, np.float32)
+    y = rng.integers(0, 10, size=16).astype(np.int32)
+
+    wt, bt = tf.Variable(w), tf.Variable(b)
+    with tf.GradientTape() as tape:
+        logits = tf.constant(x) @ wt + bt
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=tf.constant(y), logits=logits))
+    gw_ref, gb_ref = [g.numpy() for g in tape.gradient(loss, [wt, bt])]
+
+    def loss_fn(params):
+        logits = jnp.asarray(x) @ params["w"] + params["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(y)).mean()
+
+    grads = jax.grad(loss_fn)({"w": jnp.asarray(w), "b": jnp.asarray(b)})
+    np.testing.assert_allclose(np.asarray(grads["w"]), gw_ref,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["b"]), gb_ref,
+                               rtol=1e-5, atol=1e-6)
