@@ -78,24 +78,29 @@ def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     if on_tpu:
-        # Best single-chip config from tools/perf_sweep.py (v5e), round 3:
+        # Best single-chip config (v5e), round 4:
         # - scan_layers=False: unrolling the 12 blocks lets XLA schedule
         #   and fuse ACROSS layer boundaries (scan pins one conservative
-        #   loop body) — +0.05 MFU over the scanned stack;
-        # - remat=False: with the layer stack unrolled and the fused
-        #   chunked cross-entropy (loss_chunks=8) keeping the (B,S,vocab)
-        #   logits out of HBM, the full activation set fits at batch 4 —
-        #   the backward recomputes NOTHING (+0.07 over remat="dots");
-        # - full-sequence Pallas tiles (1024/1024 — one block per (b,h)).
-        # Measured 0.577 MFU sustained at 20-step loops (round 2: 0.4642);
-        # lc=4 wins short bursts but lc=8 sustains better.
+        #   loop body);
+        # - remat=False: the backward recomputes NOTHING — the full
+        #   activation set fits at batch 8 because the fused CE keeps
+        #   the (B,S,vocab) logits out of HBM (remat="dots" at batch 16
+        #   measured 0.515, strictly worse);
+        # - loss_impl="kernel": the Pallas vocab-tiled CE
+        #   (ops/fused_ce.py) — interleaved A/B at batch 8 measured
+        #   +0.008..0.016 MFU over the lax.scan chunk path, and the CE
+        #   block profiles at ~90% of its 4·N·V·D matmul ideal;
+        # - batch 8 > batch 4 by ~0.03 MFU interleaved (amortizes the
+        #   adamw update's ~6 GB of optimizer-state HBM traffic);
+        # - full-sequence Pallas attention tiles (1024/1024).
         cfg = TransformerConfig.transformer_big(max_seq_len=1024,
                                                 remat=False,
                                                 scan_layers=False,
                                                 loss_chunks=8,
+                                                loss_impl="kernel",
                                                 attn_block_q=1024,
                                                 attn_block_k=1024)
-        batch, n_iters, reps = 4, 20, 5
+        batch, n_iters, reps = 8, 12, 5
     else:  # local smoke run
         cfg = TransformerConfig.tiny()
         batch, n_iters, reps = 8, 5, 2

@@ -112,6 +112,16 @@ class TransformerConfig:
     # matmul); kept as a knob for shapes where the recompute dominates
     # (bigger vocab, shorter chunks, bandwidth-rich parts).
     loss_chunk_policy: str = "recompute"
+    # Fused-CE implementation: "scan" = the lax.scan chunk path above;
+    # "kernel" = the Pallas vocab-tiled online-logsumexp kernels
+    # (ops/fused_ce.py) — logits tiles never leave VMEM. Pallas custom
+    # calls cannot be GSPMD-partitioned, so the kernel runs single-chip
+    # only (mesh None or size 1); sharded meshes fall back to the scan
+    # path, whose einsums GSPMD partitions natively. "kernel" implies
+    # the fused loss even when loss_chunks == 0.
+    loss_impl: str = "scan"
+    loss_block_n: int = 512
+    loss_block_v: int = 1024
 
     @property
     def head_dim(self) -> int:
@@ -389,13 +399,7 @@ def fused_next_token_loss(hidden, embed, tokens, *, num_chunks,
         raise ValueError(f"seq len {S} not divisible by "
                          f"loss num_chunks={num_chunks}")
     C = S // num_chunks
-    # Position t predicts token t+1; the final position has no target and
-    # is masked out — identical semantics to next_token_loss.
-    targets = jnp.concatenate(
-        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
-    mask = jnp.concatenate(
-        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
-        axis=1)
+    targets, mask = _shifted_targets_and_mask(tokens)
     emb = embed.astype(compute_dtype)
     xs = (hidden.reshape(B, num_chunks, C, D).swapaxes(0, 1),
           targets.reshape(B, num_chunks, C).swapaxes(0, 1),
@@ -424,6 +428,37 @@ def fused_next_token_loss(hidden, embed, tokens, *, num_chunks,
     return total / (B * (S - 1))
 
 
+def _shifted_targets_and_mask(tokens):
+    """Next-token shift shared by every fused-loss path: position t
+    predicts token t+1; the final position has no target (pad target 0,
+    mask 0) — identical semantics to ``next_token_loss``."""
+    B, S = tokens.shape
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32), jnp.zeros((B, 1), jnp.float32)],
+        axis=1)
+    return targets, mask
+
+
+def kernel_next_token_loss(hidden, embed, tokens, *,
+                           compute_dtype=jnp.bfloat16,
+                           block_n: int = 512, block_v: int = 1024,
+                           implementation: str | None = None):
+    """Shifted next-token CE via the Pallas fused-CE kernels
+    (ops/fused_ce.py) — same semantics as ``fused_next_token_loss`` /
+    ``next_token_loss`` but the (B, S, vocab) logits tensor never exists
+    even per-chunk: vocab tiles stream through VMEM."""
+    from distributed_tensorflow_tpu.ops.fused_ce import fused_cross_entropy
+    B, S, D = hidden.shape
+    targets, mask = _shifted_targets_and_mask(tokens)
+    losses = fused_cross_entropy(
+        hidden.reshape(B * S, D).astype(compute_dtype),
+        embed.astype(compute_dtype), targets.reshape(B * S),
+        block_n=block_n, block_v=block_v, implementation=implementation)
+    return jnp.sum(losses * mask.reshape(B * S)) / (B * (S - 1))
+
+
 def make_optimizer(cfg: TransformerConfig):
     return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
 
@@ -433,9 +468,21 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
     the per-layer load-balancing aux losses (flax "losses" collection)
     are summed into the objective (≙ Switch Transformer training)."""
 
-    fused = cfg.loss_chunks > 0
+    # Pallas custom calls cannot be GSPMD-partitioned (same constraint
+    # as the attention kernel): the kernel CE path runs single-chip
+    # only; sharded meshes keep the scan path, whose einsums GSPMD
+    # partitions natively (incl. vocab-sharded tp embeddings).
+    # loss_impl="kernel" implies a fused loss even with loss_chunks=0 —
+    # silently materializing full logits would defeat its purpose.
+    use_kernel = (cfg.loss_impl == "kernel"
+                  and (cfg.mesh is None or cfg.mesh.size == 1))
+    fused = cfg.loss_chunks > 0 or use_kernel
 
     def objective(out, params, tokens):
+        if use_kernel:
+            return kernel_next_token_loss(
+                out, params["embed"], tokens, compute_dtype=cfg.dtype,
+                block_n=cfg.loss_block_n, block_v=cfg.loss_block_v)
         if fused:
             return fused_next_token_loss(
                 out, params["embed"], tokens,
