@@ -107,6 +107,7 @@ from distributed_tensorflow_tpu.input.dataset import (
 from distributed_tensorflow_tpu import models
 from distributed_tensorflow_tpu import ops
 from distributed_tensorflow_tpu import training
+from distributed_tensorflow_tpu import keras
 from distributed_tensorflow_tpu import embedding
 from distributed_tensorflow_tpu.cluster.coordination import (
     coordination_service,

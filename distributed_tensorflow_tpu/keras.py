@@ -1,0 +1,63 @@
+"""tf.keras-shaped namespace — the source-compat façade.
+
+≙ the tf_keras package surface the reference's training scripts import
+(TFK/src/engine/training.py Model, TFK/src/layers/, TFK/src/optimizers/,
+TFK/src/losses.py, TFK/src/callbacks.py). A reference script migrates by
+swapping its import line::
+
+    # reference:                      # this framework:
+    import tf_keras as keras          from distributed_tensorflow_tpu \
+                                          import keras
+
+and keeping everything else — Sequential/layers construction inside
+``strategy.scope()``, ``model.compile(optimizer=..., loss=...,
+metrics=[...])``, ``model.fit/evaluate/predict`` — verbatim
+(examples/train_mnist_keras_script.py is the proof script).
+
+Weight layouts equal tf_keras's (tests/test_reference_parity pins the
+conv/dense layouts), so ``get_weights``/``set_weights`` round-trip with
+real tf_keras models.
+"""
+
+from __future__ import annotations
+
+import optax as _optax
+
+from distributed_tensorflow_tpu.training import callbacks
+from distributed_tensorflow_tpu.training import layers
+from distributed_tensorflow_tpu.training import losses
+from distributed_tensorflow_tpu.training import metrics
+from distributed_tensorflow_tpu.training.layers import Input, Sequential
+from distributed_tensorflow_tpu.training.model import Model
+
+
+class _Optimizers:
+    """≙ tf_keras.optimizers — constructors returning optax transforms
+    (wrapped in inject_hyperparams so LearningRateScheduler works)."""
+
+    @staticmethod
+    def SGD(learning_rate: float = 0.01, momentum: float = 0.0):
+        return _optax.inject_hyperparams(_optax.sgd)(
+            learning_rate=learning_rate, momentum=momentum)
+
+    @staticmethod
+    def Adam(learning_rate: float = 1e-3, b1: float = 0.9,
+             b2: float = 0.999):
+        return _optax.inject_hyperparams(_optax.adam)(
+            learning_rate=learning_rate, b1=b1, b2=b2)
+
+    @staticmethod
+    def AdamW(learning_rate: float = 1e-3, weight_decay: float = 1e-4):
+        return _optax.inject_hyperparams(_optax.adamw)(
+            learning_rate=learning_rate, weight_decay=weight_decay)
+
+    @staticmethod
+    def RMSprop(learning_rate: float = 1e-3):
+        return _optax.inject_hyperparams(_optax.rmsprop)(
+            learning_rate=learning_rate)
+
+
+optimizers = _Optimizers()
+
+__all__ = ["layers", "losses", "metrics", "callbacks", "optimizers",
+           "Model", "Sequential", "Input"]

@@ -1,0 +1,291 @@
+"""tf.keras.layers-shaped layer shim backed by flax.
+
+≙ TFK/src/engine/base_layer.py + TFK/src/layers/ (Dense:
+TFK/src/layers/core/dense.py, Conv2D: convolutional/base_conv.py,
+BatchNormalization: normalization/batch_normalization.py, …) — the
+minimal surface that lets a verbatim reference-style script (configs
+#1-#3: MNIST CNN / CNN classifiers / embedding+dense stacks) run
+against this framework with only its import line changed
+(``from distributed_tensorflow_tpu import keras``).
+
+Every layer keeps the KERAS constructor signature and the KERAS weight
+layout (Conv kernels (H, W, Cin, Cout); Dense kernels (in, out)) — the
+layouts flax already shares, as pinned by tests/test_reference_parity —
+so ``get_weights``/``set_weights`` interoperate with real tf_keras
+models. ``Sequential`` composes the layers into one flax module and IS
+a ``training.Model``: compile/fit/evaluate/predict come from the
+SPMD training loop (training/model.py), not a port of the Keras one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.training.model import Model
+
+_ACTIVATIONS = {
+    None: lambda x: x,
+    "linear": lambda x: x,
+    "relu": nn.relu,
+    "gelu": nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid,
+    "softmax": lambda x: nn.softmax(x, axis=-1),
+    "silu": nn.silu,
+    "swish": nn.silu,
+}
+
+
+def _activation(identifier) -> Callable:
+    if callable(identifier):
+        return identifier
+    try:
+        return _ACTIVATIONS[identifier]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {identifier!r}; known: "
+            f"{sorted(k for k in _ACTIVATIONS if k)}") from None
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class Layer:
+    """Base shim layer: a configuration object whose ``apply`` runs
+    inside the Sequential flax module's compact scope (so flax handles
+    parameter creation/naming). ``module`` is the enclosing flax module
+    (for layers that need rngs, e.g. Dropout)."""
+
+    #: set on layers like Dropout/BatchNormalization that behave
+    #: differently in training
+    has_train_behavior = False
+
+    def apply(self, x, *, train: bool, module=None):
+        raise NotImplementedError
+
+    def compute_input_shape(self):
+        """(sample-less) input shape if the layer pins one, else None."""
+        return getattr(self, "input_shape", None)
+
+
+@dataclasses.dataclass
+class Input(Layer):
+    """≙ keras.Input / InputLayer — records the per-sample input shape
+    so Sequential can build eagerly."""
+    shape: Sequence[int]
+
+    def __post_init__(self):
+        self.input_shape = tuple(self.shape)
+
+    def apply(self, x, *, train, module=None):
+        return x
+
+
+class InputLayer(Input):
+    def __init__(self, input_shape):
+        super().__init__(shape=input_shape)
+
+
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 input_shape=None, name: str | None = None):
+        self.units = int(units)
+        self.activation = _activation(activation)
+        self.use_bias = use_bias
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        x = nn.Dense(self.units, use_bias=self.use_bias,
+                     name=self.name)(x)
+        return self.activation(x)
+
+
+class Conv2D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True, input_shape=None,
+                 name: str | None = None):
+        self.filters = int(filters)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.upper()
+        self.activation = _activation(activation)
+        self.use_bias = use_bias
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        x = nn.Conv(self.filters, self.kernel_size, strides=self.strides,
+                    padding=self.padding, use_bias=self.use_bias,
+                    name=self.name)(x)
+        return self.activation(x)
+
+
+class MaxPooling2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding: str = "valid"):
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None \
+            else self.pool_size
+        self.padding = padding.upper()
+
+    def apply(self, x, *, train, module=None):
+        return nn.max_pool(x, self.pool_size, strides=self.strides,
+                           padding=self.padding)
+
+
+class AveragePooling2D(MaxPooling2D):
+    def apply(self, x, *, train, module=None):
+        return nn.avg_pool(x, self.pool_size, strides=self.strides,
+                           padding=self.padding)
+
+
+class GlobalAveragePooling2D(Layer):
+    def apply(self, x, *, train, module=None):
+        return jnp.mean(x, axis=(1, 2))
+
+
+class Flatten(Layer):
+    def apply(self, x, *, train, module=None):
+        return x.reshape((x.shape[0], -1))
+
+
+class Dropout(Layer):
+    has_train_behavior = True
+
+    def __init__(self, rate: float, seed: int | None = None):
+        self.rate = float(rate)
+        self.seed = seed
+
+    def apply(self, x, *, train, module=None):
+        if not train or self.rate == 0.0:
+            return x
+        rng = module.make_rng("dropout")
+        if self.seed is not None:       # keras per-layer seed honored
+            rng = jax.random.fold_in(rng, self.seed)
+        return nn.Dropout(self.rate, deterministic=False)(x, rng=rng)
+
+
+class BatchNormalization(Layer):
+    """≙ keras BatchNormalization: running averages live in the flax
+    ``batch_stats`` collection, which training.Model carries as
+    model_state (the Keras non-trainable-weights analogue)."""
+    has_train_behavior = True
+
+    def __init__(self, momentum: float = 0.99, epsilon: float = 1e-3,
+                 name: str | None = None):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        return nn.BatchNorm(use_running_average=not train,
+                            momentum=self.momentum, epsilon=self.epsilon,
+                            name=self.name)(x)
+
+
+class LayerNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-3, name: str | None = None):
+        self.epsilon = float(epsilon)
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        return nn.LayerNorm(epsilon=self.epsilon, name=self.name)(x)
+
+
+class Embedding(Layer):
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None,
+                 name: str | None = None):
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        return nn.Embed(self.input_dim, self.output_dim,
+                        name=self.name)(x.astype(jnp.int32))
+
+
+class ReLU(Layer):
+    def apply(self, x, *, train, module=None):
+        return nn.relu(x)
+
+
+class Softmax(Layer):
+    def apply(self, x, *, train, module=None):
+        return nn.softmax(x, axis=-1)
+
+
+class Activation(Layer):
+    def __init__(self, activation):
+        self.activation = _activation(activation)
+
+    def apply(self, x, *, train, module=None):
+        return self.activation(x)
+
+
+class _SequentialModule(nn.Module):
+    """One flax module applying the shim layers in order."""
+    layer_stack: tuple
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        for layer in self.layer_stack:
+            x = layer.apply(x, train=self.train, module=self)
+        return x
+
+
+class Sequential(Model):
+    """≙ tf_keras.Sequential — a Model built from a layer list.
+
+    Builds eagerly when any layer pins an input shape (keras Input /
+    ``input_shape=`` kwarg), otherwise lazily on the first fit/call.
+    """
+
+    def __init__(self, layers: Sequence[Layer] | None = None, *,
+                 seed: int = 0):
+        stack = tuple(layers or ())
+        for lyr in stack:
+            if not isinstance(lyr, Layer):
+                raise TypeError(
+                    f"Sequential expects shim layers "
+                    f"(distributed_tensorflow_tpu.keras.layers), got "
+                    f"{type(lyr).__name__}")
+        super().__init__(
+            _SequentialModule(layer_stack=stack, train=True),
+            eval_module=_SequentialModule(layer_stack=stack, train=False),
+            seed=seed)
+        self.layers = list(stack)
+        shape = next((lyr.compute_input_shape() for lyr in stack
+                      if lyr.compute_input_shape()), None)
+        if shape is not None:
+            self.build(jnp.zeros((1, *shape), jnp.float32))
+
+    def add(self, layer: Layer):
+        """≙ keras Sequential.add: incremental construction. Adding to
+        an already-built stack re-initializes the parameters (the keras
+        incremental-build pattern adds layers BEFORE training, so fresh
+        init is indistinguishable there)."""
+        if not isinstance(layer, Layer):
+            raise TypeError(
+                f"Sequential expects shim layers "
+                f"(distributed_tensorflow_tpu.keras.layers), got "
+                f"{type(layer).__name__}")
+        self.layers.append(layer)
+        stack = tuple(self.layers)
+        self.module = _SequentialModule(layer_stack=stack, train=True)
+        self.eval_module = _SequentialModule(layer_stack=stack,
+                                             train=False)
+        self._built = False
+        self._train_fn = self._eval_fn = self._predict_fn = None
+        shape = next((lyr.compute_input_shape() for lyr in stack
+                      if lyr.compute_input_shape()), None)
+        if shape is not None:
+            self.build(jnp.zeros((1, *shape), jnp.float32))

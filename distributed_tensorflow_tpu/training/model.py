@@ -114,7 +114,7 @@ class Model:
             return dict(self.module.init(rng, sample))
 
         variables = self.strategy.init_state(init_vars)
-        params = variables.pop("params")
+        params = variables.pop("params", {})   # parameter-less models OK
         self._state = {"params": params, "step": jnp.zeros((), jnp.int32),
                        "model_state": variables}
         if self._compiled:
@@ -203,18 +203,26 @@ class Model:
         metrics, loss_metric = self._metrics, self._loss_metric
         tx = self._tx
 
+        base_rng = jax.random.PRNGKey(self.seed ^ 0x5eed)
+
         def step(state, mstate, batch, full):
             x, y, sw = batch
             model_state = state.get("model_state", {})
             collections = list(model_state)
+            # per-step stochastic-layer rng (≙ Keras Dropout seeds);
+            # harmless for modules that never request the "dropout"
+            # stream
+            rngs = {"dropout": jax.random.fold_in(base_rng,
+                                                  state["step"])}
 
             def compute_loss(params):
                 if collections:
                     preds, mutated = module.apply(
                         {"params": params, **model_state}, x,
-                        mutable=collections)
+                        mutable=collections, rngs=rngs)
                 else:
-                    preds, mutated = module.apply({"params": params}, x), {}
+                    preds, mutated = module.apply({"params": params}, x,
+                                                  rngs=rngs), {}
                 per = loss_obj.call(y, preds).astype(jnp.float32)
                 w = sw.astype(jnp.float32)
                 loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
@@ -389,7 +397,8 @@ class Model:
             logs = self._metric_results(mstate)
             if validation_data is not None:
                 val = self.evaluate(*validation_data,
-                                    batch_size=batch_size, verbose=0)
+                                    batch_size=batch_size, verbose=0,
+                                    return_dict=True)
                 logs.update({f"val_{k}": v for k, v in val.items()})
             cb_list.on_epoch_end(epoch, logs)
             if self.stop_training:
@@ -400,9 +409,11 @@ class Model:
 
     def evaluate(self, x, y=None, *, batch_size: int = 32,
                  verbose: int = 0, steps: int | None = None,
-                 sample_weight=None) -> dict:
-        """≙ Model.evaluate; returns {"loss": ..., metric: ...}. Exact on
-        partial final batches (mask-padded)."""
+                 sample_weight=None, return_dict: bool = False):
+        """≙ Model.evaluate. Keras return convention: scalar loss with
+        no compiled metrics, ``[loss, metric...]`` otherwise,
+        ``{"loss": ..., metric: ...}`` with ``return_dict=True``. Exact
+        on partial final batches (mask-padded)."""
         if not self._compiled or not self._built:
             raise RuntimeError("build+compile the model before evaluate()")
         eval_fn = self._make_eval_function()
@@ -420,7 +431,12 @@ class Model:
         if verbose:
             print("  ".join(f"{k}={v:.4f}" for k, v in results.items()),
                   flush=True)
-        return results
+        if return_dict:
+            return results
+        if len(results) == 1:
+            return results["loss"]
+        return [results["loss"]] + [results[m.name]
+                                    for m in self._metrics]
 
     def predict(self, x, *, batch_size: int = 32) -> Any:
         if not self._built:

@@ -1,0 +1,59 @@
+"""Config #1 (MNIST CNN) as a VERBATIM reference-style Keras script.
+
+This file is written exactly the way the reference's MNIST training
+script is (SURVEY.md §3.1: Sequential under strategy.scope, compile,
+fit) — the ONLY line that differs from the tf_keras original is the
+import below. Everything after it is untouched reference style: same
+layer constructors, same compile arguments, same fit/evaluate calls.
+
+    reference:  import tensorflow as tf; keras = tf.keras
+    here:       from distributed_tensorflow_tpu import keras
+"""
+
+import numpy as np
+
+import distributed_tensorflow_tpu as tf_distribute
+from distributed_tensorflow_tpu import keras
+
+
+def load_data(n=4096, seed=0):
+    """Synthetic MNIST-shaped data (zero-egress environment); labels
+    derived from image statistics so the model can actually fit."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 28, 28, 1)).astype("float32")
+    y = (np.abs(x.mean(axis=(1, 2, 3))) * 40).astype("int32") % 10
+    return (x[: n - 512], y[: n - 512]), (x[n - 512:], y[n - 512:])
+
+
+def main():
+    (x_train, y_train), (x_test, y_test) = load_data()
+
+    strategy = tf_distribute.MirroredStrategy()
+    with strategy.scope():
+        model = keras.Sequential([
+            keras.Input((28, 28, 1)),
+            keras.layers.Conv2D(32, 3, padding="same", activation="relu"),
+            keras.layers.Conv2D(64, 3, padding="same", activation="relu"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dropout(0.25),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(10),
+        ])
+        model.compile(
+            optimizer=keras.optimizers.Adam(1e-3),
+            loss=keras.losses.SparseCategoricalCrossentropy(
+                from_logits=True),
+            metrics=["accuracy"],
+        )
+
+    model.fit(x_train, y_train, batch_size=256, epochs=3,
+              validation_data=(x_test, y_test))
+    loss, acc = model.evaluate(x_test, y_test, batch_size=256)
+    print(f"eval loss {loss:.4f}  accuracy {acc:.4f}")
+    preds = model.predict(x_test[:8], batch_size=8)
+    print("predicted classes:", preds.argmax(-1).tolist())
+
+
+if __name__ == "__main__":
+    main()

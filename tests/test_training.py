@@ -78,7 +78,7 @@ def test_evaluate_exact_on_partial_batch(data, devices):
     model = compiled_model(MirroredStrategy())
     model.fit(x, y, epochs=2, batch_size=64, verbose=0)
     xs, ys = x[:37], y[:37]
-    res = model.evaluate(xs, ys, batch_size=16)
+    res = model.evaluate(xs, ys, batch_size=16, return_dict=True)
     preds = model.predict(xs, batch_size=16)
     assert preds.shape == (37, 4)
     acc = float((np.argmax(preds, -1) == ys).mean())
@@ -103,7 +103,7 @@ def test_early_stopping_restores_best(data, devices):
                      callbacks=[es])
     assert len(hist.epoch) < 10, "early stopping never triggered"
     best = min(hist.history["loss"])
-    res = model.evaluate(x, y, batch_size=64)
+    res = model.evaluate(x, y, batch_size=64, return_dict=True)
     assert res["loss"] <= best * 1.5
 
 
@@ -115,13 +115,13 @@ def test_model_checkpoint_and_weights_roundtrip(data, devices, tmp_path):
     model.fit(x, y, epochs=2, batch_size=64, verbose=0, callbacks=[cb])
     assert (tmp_path / "ck-1").exists() and (tmp_path / "ck-2").exists()
 
-    ref = model.evaluate(x, y, batch_size=64)
+    ref = model.evaluate(x, y, batch_size=64, return_dict=True)
     # clobber weights, restore from the epoch-2 checkpoint
     import jax
     model.set_weights(jax.tree_util.tree_map(np.zeros_like,
                                              model.get_weights()))
     model.load_weights(str(tmp_path / "ck-2"))
-    res = model.evaluate(x, y, batch_size=64)
+    res = model.evaluate(x, y, batch_size=64, return_dict=True)
     np.testing.assert_allclose(res["loss"], ref["loss"], rtol=1e-6)
 
 
@@ -239,7 +239,7 @@ def test_resnet_via_fit_under_tpu_strategy(devices):
     assert stats and any(not np.allclose(a, b)
                          for a, b in zip(initial_stats, stats))
     # eval path consumes the running averages without error
-    res = model.evaluate(x[:64], y[:64], batch_size=32)
+    res = model.evaluate(x[:64], y[:64], batch_size=32, return_dict=True)
     assert "loss" in res and np.isfinite(res["loss"])
     preds = model.predict(x[:40], batch_size=32)
     assert preds.shape == (40, cfg.num_classes)
