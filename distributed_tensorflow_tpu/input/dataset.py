@@ -416,6 +416,148 @@ class Dataset:
             gen, None,
             op=lambda d: d.interleave(map_fn, cycle_length, block_length))
 
+    def flat_map(self, map_fn: Callable[..., "Dataset"]) -> "Dataset":
+        """Map each element to a Dataset and concatenate them in order
+        (≙ tf.data Dataset.flat_map — interleave with cycle_length=1)."""
+        src = self._gen_fn
+
+        def gen():
+            for el in src():
+                yield from map_fn(el)
+
+        return self._derive(gen, None, op=lambda d: d.flat_map(map_fn))
+
+    def unbatch(self) -> "Dataset":
+        """Split each element along its leading axis back into
+        individual elements (≙ tf.data Dataset.unbatch)."""
+        src = self._gen_fn
+
+        def gen():
+            for el in src():
+                leaves = jax.tree_util.tree_leaves(el)
+                if not leaves:
+                    continue
+                n = np.shape(leaves[0])[0]
+                for i in range(n):
+                    yield jax.tree_util.tree_map(
+                        lambda a: np.asarray(a)[i], el)
+
+        return self._derive(gen, None, op=lambda d: d.unbatch())
+
+    def window(self, size: int, shift: int | None = None,
+               stride: int = 1, drop_remainder: bool = False
+               ) -> "Dataset":
+        """Sliding windows of elements, each yielded as a Dataset
+        (≙ tf.data Dataset.window; combine with flat_map/batch to
+        flatten)."""
+        if size < 1:
+            raise ValueError(f"window size must be >= 1, got {size}")
+        shift = shift or size
+        src = self._gen_fn
+        span = (size - 1) * stride + 1
+
+        def gen():
+            # tf.data semantics: window k covers stream positions
+            # [k*shift, k*shift + span) sampled every `stride`; tail
+            # windows (start < n but short) are kept unless
+            # drop_remainder. Track absolute positions so shift > span
+            # skips elements instead of silently reusing them.
+            buf = collections.deque()
+            pos0 = 0                    # stream index of buf[0]
+            next_start = 0
+            n = 0
+            for el in src():
+                buf.append(el)
+                n += 1
+                while next_start + span <= n:
+                    lo = next_start - pos0
+                    yield Dataset.from_iterable(
+                        list(buf)[lo:lo + span:stride])
+                    next_start += shift
+                    while pos0 < next_start and buf:
+                        buf.popleft()
+                        pos0 += 1
+            while next_start < n:
+                lo = next_start - pos0
+                win = list(buf)[lo:lo + span:stride][:size]
+                if win and not (drop_remainder and len(win) < size):
+                    yield Dataset.from_iterable(win)
+                next_start += shift
+                while pos0 < next_start and buf:
+                    buf.popleft()
+                    pos0 += 1
+
+        return self._derive(
+            gen, None,
+            op=lambda d: d.window(size, shift, stride, drop_remainder))
+
+    def bucket_by_sequence_length(
+            self, element_length_func: Callable[[Any], int],
+            bucket_boundaries: Sequence[int],
+            bucket_batch_sizes: Sequence[int], *,
+            pad_to_bucket_boundary: bool = False,
+            drop_remainder: bool = False) -> "Dataset":
+        """Group elements into length buckets and emit padded batches
+        per bucket (≙ tf.data.Dataset.bucket_by_sequence_length,
+        TF/python/data/experimental/ops/grouping.py) — the BERT-style
+        variable-length text batching pattern. ``bucket_batch_sizes``
+        needs len(bucket_boundaries)+1 entries; variable-length leading
+        axes are zero-padded to the longest element in the batch (or to
+        boundary-1 with ``pad_to_bucket_boundary``)."""
+        boundaries = list(bucket_boundaries)
+        batch_sizes = list(bucket_batch_sizes)
+        if len(batch_sizes) != len(boundaries) + 1:
+            raise ValueError(
+                f"bucket_batch_sizes needs {len(boundaries) + 1} "
+                f"entries (len(bucket_boundaries)+1), got "
+                f"{len(batch_sizes)}")
+        src = self._gen_fn
+
+        def bucket_of(length: int) -> int:
+            for b, bound in enumerate(boundaries):
+                if length < bound:
+                    return b
+            return len(boundaries)
+
+        def pad_stack(elements, bucket_idx):
+            def pad_leaf(*leaves):
+                arrs = [np.asarray(a) for a in leaves]
+                if arrs[0].ndim == 0:
+                    return np.stack(arrs)
+                if pad_to_bucket_boundary:
+                    if bucket_idx >= len(boundaries):
+                        raise ValueError(
+                            "pad_to_bucket_boundary needs a final "
+                            "boundary covering the longest element")
+                    target = boundaries[bucket_idx] - 1
+                else:
+                    target = max(a.shape[0] for a in arrs)
+                out = []
+                for a in arrs:
+                    pad = [(0, target - a.shape[0])] + \
+                        [(0, 0)] * (a.ndim - 1)
+                    out.append(np.pad(a, pad))
+                return np.stack(out)
+            return jax.tree_util.tree_map(pad_leaf, *elements)
+
+        def gen():
+            buckets: dict[int, list] = collections.defaultdict(list)
+            for el in src():
+                b = bucket_of(int(element_length_func(el)))
+                buckets[b].append(el)
+                if len(buckets[b]) >= batch_sizes[b]:
+                    yield pad_stack(buckets.pop(b), b)
+            if not drop_remainder:
+                for b in sorted(buckets):
+                    yield pad_stack(buckets[b], b)
+
+        return self._derive(
+            gen, None,
+            op=lambda d: d.bucket_by_sequence_length(
+                element_length_func, boundaries, batch_sizes,
+                pad_to_bucket_boundary=pad_to_bucket_boundary,
+                drop_remainder=drop_remainder))
+
     @classmethod
     def zip(cls, *datasets: "Dataset") -> "Dataset":
         """Elementwise tuples across datasets, stopping at the shortest

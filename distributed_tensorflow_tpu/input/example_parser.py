@@ -44,6 +44,51 @@ class VarLenFeature:
     dtype: Any = np.float32
 
 
+@dataclasses.dataclass(frozen=True)
+class FixedLenSequenceFeature:
+    """Per-step dense feature of a SequenceExample FeatureList
+    (≙ tf.io.FixedLenSequenceFeature, TF/python/ops/parsing_config.py):
+    parses to (num_steps, *shape)."""
+    shape: tuple = ()
+    dtype: Any = np.float32
+    allow_missing: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseFeature:
+    """≙ tf.io.SparseFeature: a sparse value assembled from an
+    index-carrying feature and a value-carrying feature of the SAME
+    Example. Parses to a :class:`SparseValue`."""
+    index_key: str
+    value_key: str
+    dtype: Any = np.float32
+    size: int = 0
+    already_sorted: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RaggedFeature:
+    """≙ tf.io.RaggedFeature (basic value-only form): variable-length
+    values parsed to a 1-D array per example — the host-side stand-in
+    for RaggedTensor (the embedding layer's combiners consume ragged
+    rows directly)."""
+    dtype: Any = np.float32
+    value_key: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseValue:
+    """Host-side sparse triplet (≙ tf.SparseTensor restricted to 1-D)."""
+    indices: np.ndarray
+    values: np.ndarray
+    dense_shape: tuple
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_shape, self.values.dtype)
+        np.add.at(out, self.indices.astype(np.int64), self.values)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Proto wire decoding
 # ---------------------------------------------------------------------------
@@ -150,9 +195,56 @@ def _decode_feature(payload: bytes):
     return np.asarray([], np.float32)      # empty Feature
 
 
+def _parse_features_map(buf: bytes) -> dict:
+    """Features { feature = 1 map<string, Feature> } payload → raw
+    {name: decoded values}."""
+    raw: dict = {}
+    for f2, w2, fval in _fields(buf):
+        if f2 != 1 or w2 != 2:              # Features.feature (map entry)
+            continue
+        name = value = None
+        for f3, w3, v3 in _fields(bytes(fval)):
+            if w3 != 2:
+                continue
+            if f3 == 1:
+                name = bytes(v3).decode()
+            elif f3 == 2:
+                value = _decode_feature(bytes(v3))
+        if name is not None:
+            raw[name] = value
+    return raw
+
+
+def _dense_from_raw(name, spec, value):
+    """Resolve one FixedLenFeature against a raw decoded value."""
+    if value is None or (hasattr(value, "__len__") and len(value) == 0):
+        if spec.default_value is None:
+            raise ValueError(
+                f"feature {name!r} missing and no default_value")
+        value = np.broadcast_to(
+            np.asarray(spec.default_value, spec.dtype),
+            spec.shape).copy()
+    n_expect = int(np.prod(spec.shape)) if spec.shape else 1
+    arr = np.asarray(value)
+    if arr.size != n_expect:
+        raise ValueError(
+            f"feature {name!r}: got {arr.size} values, spec shape "
+            f"{spec.shape} needs {n_expect}")
+    return arr.reshape(spec.shape).astype(spec.dtype) \
+        if spec.shape else arr.reshape(()).astype(spec.dtype)
+
+
+def _ragged_from_raw(spec, value):
+    if value is None:
+        value = np.asarray([], spec.dtype)
+    return np.asarray(value).astype(spec.dtype) \
+        if not isinstance(value, list) else value
+
+
 def parse_single_example(serialized: bytes, features: dict) -> dict:
     """Parse ONE serialized tf.train.Example against a feature spec
-    (≙ tf.io.parse_single_example)."""
+    (≙ tf.io.parse_single_example). Specs: FixedLenFeature,
+    VarLenFeature, SparseFeature, RaggedFeature."""
     raw: dict = {}
     # Submessages are ALWAYS wire type 2; a matching field number with a
     # different wire type is garbage input (e.g. a non-Example payload
@@ -160,46 +252,134 @@ def parse_single_example(serialized: bytes, features: dict) -> dict:
     for field, wire, val in _fields(bytes(serialized)):
         if field != 1 or wire != 2:         # Example.features
             continue
-        for f2, w2, fval in _fields(bytes(val)):
-            if f2 != 1 or w2 != 2:          # Features.feature (map entry)
-                continue
-            name = value = None
-            for f3, w3, v3 in _fields(bytes(fval)):
-                if w3 != 2:
-                    continue
-                if f3 == 1:
-                    name = bytes(v3).decode()
-                elif f3 == 2:
-                    value = _decode_feature(bytes(v3))
-            if name is not None:
-                raw[name] = value
+        raw.update(_parse_features_map(bytes(val)))
 
-    out = {}
-    for name, spec in features.items():
-        value = raw.get(name)
-        if isinstance(spec, VarLenFeature):
-            if value is None:
-                value = np.asarray([], spec.dtype)
-            out[name] = np.asarray(value).astype(spec.dtype) \
-                if not isinstance(value, list) else value
-            continue
-        if value is None or (hasattr(value, "__len__")
-                             and len(value) == 0):
-            if spec.default_value is None:
-                raise ValueError(
-                    f"feature {name!r} missing and no default_value")
-            value = np.broadcast_to(
-                np.asarray(spec.default_value, spec.dtype),
-                spec.shape).copy()
-        n_expect = int(np.prod(spec.shape)) if spec.shape else 1
-        arr = np.asarray(value)
-        if arr.size != n_expect:
+    return {name: _resolve_example_spec(name, spec, raw)
+            for name, spec in features.items()}
+
+
+def _resolve_example_spec(name, spec, raw: dict):
+    """Resolve one Example-level spec (FixedLen/VarLen/Sparse/Ragged)
+    against the raw decoded feature map — shared by Example parsing and
+    SequenceExample context parsing."""
+    if isinstance(spec, SparseFeature):
+        idx = np.asarray(raw.get(spec.index_key, []), np.int64)
+        vals = np.asarray(raw.get(spec.value_key, []), spec.dtype)
+        if idx.shape != vals.shape:
             raise ValueError(
-                f"feature {name!r}: got {arr.size} values, spec shape "
-                f"{spec.shape} needs {n_expect}")
-        out[name] = arr.reshape(spec.shape).astype(spec.dtype) \
-            if spec.shape else arr.reshape(()).astype(spec.dtype)
-    return out
+                f"SparseFeature {name!r}: index feature "
+                f"{spec.index_key!r} has {idx.size} entries but value "
+                f"feature {spec.value_key!r} has {vals.size}")
+        if not spec.already_sorted and idx.size:
+            order = np.argsort(idx, kind="stable")
+            idx, vals = idx[order], vals[order]
+        return SparseValue(idx, vals, (spec.size,))
+    if isinstance(spec, RaggedFeature):
+        return _ragged_from_raw(spec, raw.get(spec.value_key or name))
+    if isinstance(spec, VarLenFeature):
+        return _ragged_from_raw(spec, raw.get(name))
+    if isinstance(spec, FixedLenFeature):
+        return _dense_from_raw(name, spec, raw.get(name))
+    raise TypeError(f"feature {name!r}: unsupported spec "
+                    f"{type(spec).__name__}")
+
+
+def parse_single_sequence_example(serialized: bytes,
+                                  context_features: dict | None = None,
+                                  sequence_features: dict | None = None
+                                  ) -> tuple[dict, dict]:
+    """Parse ONE tf.train.SequenceExample (≙
+    tf.io.parse_single_sequence_example, TF/python/ops/parsing_ops.py).
+
+    Wire: SequenceExample { context = 1 (Features),
+    feature_lists = 2 (FeatureLists { feature_list = 1
+    map<string, FeatureList { feature = 1 repeated Feature }> }) }.
+
+    context_features: FixedLen/VarLen/Sparse/Ragged specs over the
+    context. sequence_features: FixedLenSequenceFeature → (T, *shape)
+    dense; VarLenFeature / RaggedFeature → list of per-step 1-D arrays.
+    """
+    context_raw: dict = {}
+    lists_raw: dict = {}
+    for field, wire, val in _fields(bytes(serialized)):
+        if wire != 2:
+            continue
+        if field == 1:                      # context Features
+            context_raw.update(_parse_features_map(bytes(val)))
+        elif field == 2:                    # FeatureLists
+            for f2, w2, fval in _fields(bytes(val)):
+                if f2 != 1 or w2 != 2:      # feature_list map entry
+                    continue
+                name, steps = None, []
+                for f3, w3, v3 in _fields(bytes(fval)):
+                    if w3 != 2:
+                        continue
+                    if f3 == 1:
+                        name = bytes(v3).decode()
+                    elif f3 == 2:           # FeatureList
+                        steps = [_decode_feature(bytes(v4))
+                                 for f4, w4, v4 in _fields(bytes(v3))
+                                 if f4 == 1 and w4 == 2]
+                if name is not None:
+                    lists_raw[name] = steps
+
+    context = {name: _resolve_example_spec(name, spec, context_raw)
+               for name, spec in (context_features or {}).items()}
+
+    sequences = {}
+    for name, spec in (sequence_features or {}).items():
+        steps = lists_raw.get(name)
+        if isinstance(spec, FixedLenSequenceFeature):
+            if steps is None:
+                if not spec.allow_missing:
+                    raise ValueError(
+                        f"sequence feature {name!r} missing and "
+                        f"allow_missing=False")
+                steps = []
+            n_expect = int(np.prod(spec.shape)) if spec.shape else 1
+            rows = []
+            for t, step in enumerate(steps):
+                arr = np.asarray(step)
+                if arr.size != n_expect:
+                    raise ValueError(
+                        f"sequence feature {name!r} step {t}: got "
+                        f"{arr.size} values, spec shape {spec.shape} "
+                        f"needs {n_expect}")
+                rows.append(arr.reshape(spec.shape)
+                            if spec.shape else arr.reshape(()))
+            out_shape = (len(rows), *spec.shape)
+            sequences[name] = (np.stack(rows).astype(spec.dtype)
+                               if rows else
+                               np.zeros(out_shape, spec.dtype))
+        elif isinstance(spec, (VarLenFeature, RaggedFeature)):
+            steps = steps or []
+            sequences[name] = [
+                np.asarray(s).astype(spec.dtype)
+                if not isinstance(s, list) else s for s in steps]
+        else:
+            raise TypeError(f"sequence feature {name!r}: unsupported "
+                            f"spec {type(spec).__name__}")
+    return context, sequences
+
+
+def parse_sequence_example(serialized_batch,
+                           context_features: dict | None = None,
+                           sequence_features: dict | None = None
+                           ) -> tuple[dict, dict]:
+    """Batched SequenceExample parsing (≙ tf.io.parse_sequence_example):
+    context FixedLen features stack densely; everything else comes back
+    as per-example lists (sequence lengths differ across examples)."""
+    parsed = [parse_single_sequence_example(s, context_features,
+                                            sequence_features)
+              for s in serialized_batch]
+    ctx_out: dict = {}
+    for name, spec in (context_features or {}).items():
+        vals = [p[0][name] for p in parsed]
+        ctx_out[name] = np.stack(vals) \
+            if isinstance(spec, FixedLenFeature) else vals
+    seq_out = {name: [p[1][name] for p in parsed]
+               for name in (sequence_features or {})}
+    return ctx_out, seq_out
 
 
 def parse_example(serialized_batch, features: dict) -> dict:
@@ -229,13 +409,71 @@ def example_reader(features: dict):
     return read
 
 
+class _ZlibStream:
+    """Streaming decompressor with a file-like read() — keeps
+    iter_tfrecords' O(one record) memory contract for ZLIB files."""
+
+    _CHUNK = 1 << 16
+
+    def __init__(self, f):
+        import zlib
+        self._f = f
+        self._d = zlib.decompressobj()
+        self._buf = b""
+
+    def read(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            raw = self._f.read(self._CHUNK)
+            if not raw:
+                self._buf += self._d.flush()
+                break
+            self._buf += self._d.decompress(raw)
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _open_maybe_compressed(path: str):
+    """Open a TFRecord file, transparently decompressing GZIP/ZLIB
+    streams (≙ TFRecordOptions compression_type,
+    TF/python/lib/io/tf_record.py — real corpora are very often gzip
+    TFRecords).
+
+    Detection order matters: a VALID plain TFRecord header (length
+    crc32c at offset 8 matches) wins over any magic-byte coincidence —
+    an uncompressed file whose first record length encodes to
+    0x78 0x01/0x5e/0x9c/0xda would otherwise be misread as ZLIB."""
+    from distributed_tensorflow_tpu.utils.summary import _masked_crc
+    with open(path, "rb") as probe:
+        head = probe.read(12)
+    if len(head) == 12 and _masked_crc(head[:8]) == struct.unpack(
+            "<I", head[8:12])[0]:
+        return open(path, "rb")              # valid plain framing
+    if head[:2] == b"\x1f\x8b":
+        import gzip
+        return gzip.open(path, "rb")
+    if len(head) >= 2 and head[0] == 0x78 and head[1] in (
+            0x01, 0x5e, 0x9c, 0xda):
+        return _ZlibStream(open(path, "rb"))
+    return open(path, "rb")
+
+
 def iter_tfrecords(path: str) -> Iterator[bytes]:
     """Stream TFRecord framing (length + masked-crc + payload + crc),
     verifying the payload crc32c — a bit-flipped record raises instead
     of silently parsing into wrong feature values (same contract as the
-    native scanner and TF's reader). Memory stays O(one record)."""
+    native scanner and TF's reader). Memory stays O(one record);
+    GZIP/ZLIB files are decompressed transparently."""
     from distributed_tensorflow_tpu.utils.summary import _masked_crc
-    with open(path, "rb") as f:
+    with _open_maybe_compressed(path) as f:
         while True:
             header = f.read(12)
             if not header:
@@ -269,46 +507,67 @@ from distributed_tensorflow_tpu.utils.summary import (  # noqa: E402
     _len_delim, _varint)
 
 
+def _encode_feature(name, value) -> bytes:
+    """One Feature message body: floats → float_list (packed), ints →
+    int64_list (packed), bytes/str → bytes_list."""
+    if isinstance(value, (bytes, str)):
+        value = [value]
+    if isinstance(value, tuple):
+        value = list(value)
+    if isinstance(value, np.ndarray) and value.dtype.kind in "SUO":
+        value = list(value.ravel())
+    if isinstance(value, list) and not value:
+        raise ValueError(
+            f"feature {name!r}: empty list is ambiguous (bytes/"
+            f"float/int64); pass a typed empty numpy array")
+    if isinstance(value, list) \
+            and isinstance(value[0], (bytes, str, np.bytes_, np.str_)):
+        payload = b"".join(
+            _len_delim(1, v.encode() if isinstance(v, str)
+                       else bytes(v))
+            for v in value)
+        return _len_delim(1, payload)           # bytes_list = 1
+    arr = np.asarray(value).ravel()
+    if arr.dtype == bool:
+        # np.bool_ is not a np.integer subtype; without this a
+        # bool feature lands in float_list and then fails the
+        # int64 FixedLenFeature spec a migrating user writes.
+        arr = arr.astype(np.int64)
+    mask = (1 << 64) - 1
+    if np.issubdtype(arr.dtype, np.integer):
+        packed = b"".join(_varint(int(v) & mask) for v in arr)
+        return _len_delim(3, _len_delim(1, packed))      # int64_list
+    packed = b"".join(struct.pack("<f", float(v)) for v in arr)
+    return _len_delim(2, _len_delim(1, packed))          # float_list
+
+
+def _encode_features_map(feature_dict: dict) -> bytes:
+    entries = b""
+    for name, value in feature_dict.items():
+        feat = _encode_feature(name, value)
+        entry = _len_delim(1, name.encode()) + _len_delim(2, feat)
+        entries += _len_delim(1, entry)
+    return entries
+
+
 def encode_example(feature_dict: dict) -> bytes:
     """Serialize {name: value} into a tf.train.Example wire message.
     floats → float_list (packed), ints → int64_list (packed),
     bytes/str (scalar, list/tuple, or numpy S/U/O array) → bytes_list.
     Empty values must come as a typed empty numpy array — a bare ``[]``
     is ambiguous between the three list types and raises."""
-    entries = b""
-    for name, value in feature_dict.items():
-        if isinstance(value, (bytes, str)):
-            value = [value]
-        if isinstance(value, tuple):
-            value = list(value)
-        if isinstance(value, np.ndarray) and value.dtype.kind in "SUO":
-            value = list(value.ravel())
-        if isinstance(value, list) and not value:
-            raise ValueError(
-                f"feature {name!r}: empty list is ambiguous (bytes/"
-                f"float/int64); pass a typed empty numpy array")
-        if isinstance(value, list) \
-                and isinstance(value[0], (bytes, str, np.bytes_, np.str_)):
-            payload = b"".join(
-                _len_delim(1, v.encode() if isinstance(v, str)
-                           else bytes(v))
-                for v in value)
-            feat = _len_delim(1, payload)           # bytes_list = 1
-        else:
-            arr = np.asarray(value).ravel()
-            if arr.dtype == bool:
-                # np.bool_ is not a np.integer subtype; without this a
-                # bool feature lands in float_list and then fails the
-                # int64 FixedLenFeature spec a migrating user writes.
-                arr = arr.astype(np.int64)
-            mask = (1 << 64) - 1
-            if np.issubdtype(arr.dtype, np.integer):
-                packed = b"".join(_varint(int(v) & mask) for v in arr)
-                feat = _len_delim(3, _len_delim(1, packed))  # int64_list
-            else:
-                packed = b"".join(struct.pack("<f", float(v))
-                                  for v in arr)
-                feat = _len_delim(2, _len_delim(1, packed))  # float_list
-        entry = _len_delim(1, name.encode()) + _len_delim(2, feat)
-        entries += _len_delim(1, entry)
-    return _len_delim(1, entries)           # Example { features = 1 }
+    return _len_delim(1, _encode_features_map(feature_dict))
+
+
+def encode_sequence_example(context: dict, feature_lists: dict) -> bytes:
+    """Serialize a tf.train.SequenceExample: ``context`` is an Example-
+    style {name: value} dict; ``feature_lists`` maps name → list of
+    per-step values (each encoded as one Feature)."""
+    lists = b""
+    for name, steps in feature_lists.items():
+        flist = b"".join(_len_delim(1, _encode_feature(name, s))
+                         for s in steps)
+        entry = _len_delim(1, name.encode()) + _len_delim(2, flist)
+        lists += _len_delim(1, entry)
+    return (_len_delim(1, _encode_features_map(context))
+            + _len_delim(2, lists))

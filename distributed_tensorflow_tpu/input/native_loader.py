@@ -31,7 +31,7 @@ _lib_lock = threading.Lock()
 def _build_so():
     subprocess.run(
         ["g++", "-O3", "-fPIC", "-shared", "-pthread", "-std=c++17",
-         "-o", _SO_PATH, _SRC_PATH],
+         "-o", _SO_PATH, _SRC_PATH, "-lz"],
         check=True, capture_output=True)
 
 
@@ -83,13 +83,34 @@ def write_records(path: str, array: np.ndarray) -> None:
         f.write(np.ascontiguousarray(array).tobytes())
 
 
-def write_tfrecords(path: str, payloads) -> None:
+def write_tfrecords(path: str, payloads, compression: str | None = None
+                    ) -> None:
     """Write byte payloads in TFRecord framing (length + masked crc32c),
-    readable by :class:`NativeTFRecordDataset` and by TensorFlow."""
+    readable by :class:`NativeTFRecordDataset` and by TensorFlow.
+    ``compression``: None | "GZIP" | "ZLIB" (≙ TFRecordOptions
+    compression_type, TF/python/lib/io/tf_record.py)."""
     from distributed_tensorflow_tpu.utils.summary import tfrecord_frame
-    with open(path, "wb") as f:
-        for p in payloads:
-            f.write(tfrecord_frame(bytes(p)))
+    if compression is None:
+        with open(path, "wb") as f:          # streaming: O(one record)
+            for p in payloads:
+                f.write(tfrecord_frame(bytes(p)))
+        return
+    if compression == "GZIP":
+        import gzip
+        with gzip.open(path, "wb") as f:     # streaming
+            for p in payloads:
+                f.write(tfrecord_frame(bytes(p)))
+        return
+    if compression == "ZLIB":
+        import zlib
+        comp = zlib.compressobj()
+        with open(path, "wb") as f:
+            for p in payloads:
+                f.write(comp.compress(tfrecord_frame(bytes(p))))
+            f.write(comp.flush())
+        return
+    raise ValueError(f"compression={compression!r}; expected "
+                     f"None, 'GZIP' or 'ZLIB'")
 
 
 class _NativePipelineBase:

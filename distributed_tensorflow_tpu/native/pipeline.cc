@@ -19,6 +19,8 @@
 //
 // C ABI only — consumed with ctypes; no pybind11 dependency.
 
+#include <zlib.h>
+
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
@@ -34,6 +36,56 @@
 #include <vector>
 
 namespace {
+
+// GZIP (1f 8b) / ZLIB (78 xx) compressed files (≙ TFRecordOptions
+// compression_type, tensorflow/python/lib/io/tf_record.py): compressed
+// streams cannot be seek-indexed, so such files are inflated ONCE into
+// memory at open and both the scan and the worker reads run against
+// the buffer. Plain files keep the zero-copy seek/read path.
+enum class FileCompression { kNone, kGzip, kZlib };
+
+FileCompression SniffCompression(FILE* f) {
+  uint8_t magic[2];
+  size_t got = std::fread(magic, 1, 2, f);
+  std::fseek(f, 0, SEEK_SET);
+  if (got == 2 && magic[0] == 0x1f && magic[1] == 0x8b)
+    return FileCompression::kGzip;
+  if (got == 2 && magic[0] == 0x78 &&
+      (magic[1] == 0x01 || magic[1] == 0x5e || magic[1] == 0x9c ||
+       magic[1] == 0xda))
+    return FileCompression::kZlib;
+  return FileCompression::kNone;
+}
+
+bool InflateFile(FILE* f, FileCompression comp, std::vector<uint8_t>* out) {
+  std::fseek(f, 0, SEEK_END);
+  int64_t csize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> comp_buf(csize);
+  if (std::fread(comp_buf.data(), 1, csize, f) !=
+      static_cast<size_t>(csize))
+    return false;
+  z_stream strm{};
+  int window = comp == FileCompression::kGzip ? 16 + MAX_WBITS : MAX_WBITS;
+  if (inflateInit2(&strm, window) != Z_OK) return false;
+  strm.next_in = comp_buf.data();
+  strm.avail_in = static_cast<uInt>(csize);
+  out->clear();
+  out->resize(std::max<int64_t>(csize * 4, 1 << 16));
+  int ret = Z_OK;
+  for (;;) {
+    strm.next_out = out->data() + strm.total_out;
+    strm.avail_out = static_cast<uInt>(out->size() - strm.total_out);
+    ret = inflate(&strm, Z_NO_FLUSH);
+    if (ret == Z_STREAM_END) break;
+    if (ret != Z_OK && ret != Z_BUF_ERROR) { inflateEnd(&strm); return false; }
+    if (strm.avail_out == 0) out->resize(out->size() * 2);
+    else if (ret == Z_BUF_ERROR) { inflateEnd(&strm); return false; }
+  }
+  out->resize(strm.total_out);
+  inflateEnd(&strm);
+  return true;
+}
 
 struct Batch {
   std::vector<uint8_t> data;
@@ -88,18 +140,51 @@ class Pipeline {
     for (int i = 0; i < num_paths; ++i) {
       FILE* f = std::fopen(paths[i], "rb");
       if (!f) { ok_ = false; return; }
-      if (tfrecord_) {
-        if (!ScanTFRecord(f, i, verify_crc, &max_len)) {
-          std::fclose(f);
-          ok_ = false;
-          return;
+      FileCompression comp = SniffCompression(f);
+      // A VALID plain TFRecord header (length crc32c matches at offset
+      // 8) beats any magic-byte coincidence: an uncompressed file
+      // whose first record length encodes to 78 01 / 1f 8b would
+      // otherwise be misdetected as compressed.
+      if (comp != FileCompression::kNone && tfrecord_ &&
+          HasValidPlainHeader(f))
+        comp = FileCompression::kNone;
+      if (comp != FileCompression::kNone) {
+        std::vector<uint8_t> raw;
+        if (InflateFile(f, comp, &raw)) {
+          if (tfrecord_) {
+            if (!ScanTFRecordMem(raw, i, verify_crc, &max_len)) {
+              std::fclose(f);
+              ok_ = false;
+              return;
+            }
+          } else {
+            int64_t n = static_cast<int64_t>(raw.size()) / record_bytes_;
+            for (int64_t r = 0; r < n; ++r)
+              index_.push_back({i, r * record_bytes_, record_bytes_});
+          }
+          mem_files_[i] = std::move(raw);
+        } else {
+          // magic-byte false positive on a non-compressed file: fall
+          // back to the plain path rather than rejecting a valid file
+          std::fseek(f, 0, SEEK_SET);
+          comp = FileCompression::kNone;
         }
-      } else {
-        std::fseek(f, 0, SEEK_END);
-        int64_t bytes = std::ftell(f);
-        int64_t n = bytes / record_bytes_;
-        for (int64_t r = 0; r < n; ++r)
-          index_.push_back({i, r * record_bytes_, record_bytes_});
+      }
+      if (comp == FileCompression::kNone &&
+          mem_files_.find(i) == mem_files_.end()) {
+        if (tfrecord_) {
+          if (!ScanTFRecord(f, i, verify_crc, &max_len)) {
+            std::fclose(f);
+            ok_ = false;
+            return;
+          }
+        } else {
+          std::fseek(f, 0, SEEK_END);
+          int64_t bytes = std::ftell(f);
+          int64_t n = bytes / record_bytes_;
+          for (int64_t r = 0; r < n; ++r)
+            index_.push_back({i, r * record_bytes_, record_bytes_});
+        }
       }
       std::fclose(f);
       files_.emplace_back(paths[i]);
@@ -126,6 +211,18 @@ class Pipeline {
     int64_t nt = num_threads < 1 ? 1 : num_threads;
     for (int64_t t = 0; t < nt; ++t)
       workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  // True iff the file starts with a crc-valid plain TFRecord header.
+  static bool HasValidPlainHeader(FILE* f) {
+    static const Crc32c crc;
+    uint8_t header[12];
+    size_t got = std::fread(header, 1, 12, f);
+    std::fseek(f, 0, SEEK_SET);
+    if (got != 12) return false;
+    uint32_t len_crc;
+    std::memcpy(&len_crc, header + 8, 4);
+    return crc.Masked(header, 8) == len_crc;
   }
 
   // TFRecord framing: u64le length, u32le masked-crc(length), payload,
@@ -156,6 +253,30 @@ class Pipeline {
       if (std::fseek(f, slen + 4, SEEK_CUR) != 0) return false;
       index_.push_back({file_idx, payload_off, slen});
       if (slen > *max_len) *max_len = slen;
+    }
+  }
+
+  // Same framing walk over an inflated in-memory file.
+  bool ScanTFRecordMem(const std::vector<uint8_t>& buf, int file_idx,
+                       int verify_crc, int64_t* max_len) {
+    static const Crc32c crc;
+    const int64_t fsize = static_cast<int64_t>(buf.size());
+    int64_t pos = 0;
+    for (;;) {
+      if (pos == fsize) return true;      // clean EOF
+      if (pos + 12 > fsize) return false;  // truncated header
+      uint64_t len;
+      uint32_t len_crc;
+      std::memcpy(&len, buf.data() + pos, 8);
+      std::memcpy(&len_crc, buf.data() + pos + 8, 4);
+      if (verify_crc && crc.Masked(buf.data() + pos, 8) != len_crc)
+        return false;
+      int64_t payload_off = pos + 12;
+      int64_t slen = static_cast<int64_t>(len);
+      if (slen < 0 || payload_off + slen + 4 > fsize) return false;
+      index_.push_back({file_idx, payload_off, slen});
+      if (slen > *max_len) *max_len = slen;
+      pos = payload_off + slen + 4;
     }
   }
 
@@ -242,18 +363,31 @@ class Pipeline {
       static const Crc32c crc;
       bool bad = false;
       for (int64_t i = 0; i < count; ++i) {
-        FILE* f = fps[picks[i].file];
-        std::fseek(f, picks[i].offset, SEEK_SET);
         uint8_t* row = buf->data.data() + i * record_bytes_;
-        size_t got = std::fread(row, 1, picks[i].length, f);
-        if (static_cast<int64_t>(got) != picks[i].length) { bad = true; }
-        if (tfrecord_ && verify_crc_ && !bad) {
-          // payload crc sits right after the payload; data's in hand —
-          // verify here so dataset bytes are read exactly once
-          uint32_t data_crc;
-          if (std::fread(&data_crc, 1, 4, f) != 4 ||
-              crc.Masked(row, picks[i].length) != data_crc)
-            bad = true;
+        auto mem = mem_files_.find(picks[i].file);
+        if (mem != mem_files_.end()) {
+          // inflated (gzip/zlib) file: copy from the in-memory buffer
+          const std::vector<uint8_t>& src = mem->second;
+          std::memcpy(row, src.data() + picks[i].offset, picks[i].length);
+          if (tfrecord_ && verify_crc_) {
+            uint32_t data_crc;
+            std::memcpy(&data_crc,
+                        src.data() + picks[i].offset + picks[i].length, 4);
+            if (crc.Masked(row, picks[i].length) != data_crc) bad = true;
+          }
+        } else {
+          FILE* f = fps[picks[i].file];
+          std::fseek(f, picks[i].offset, SEEK_SET);
+          size_t got = std::fread(row, 1, picks[i].length, f);
+          if (static_cast<int64_t>(got) != picks[i].length) { bad = true; }
+          if (tfrecord_ && verify_crc_ && !bad) {
+            // payload crc sits right after the payload; data's in hand —
+            // verify here so dataset bytes are read exactly once
+            uint32_t data_crc;
+            if (std::fread(&data_crc, 1, 4, f) != 4 ||
+                crc.Masked(row, picks[i].length) != data_crc)
+              bad = true;
+          }
         }
         if (picks[i].length < record_bytes_)
           std::memset(row + picks[i].length, 0,
@@ -298,6 +432,7 @@ class Pipeline {
   }
 
   std::vector<std::string> files_;
+  std::map<int, std::vector<uint8_t>> mem_files_;  // inflated gzip/zlib
   std::vector<Entry> index_;
   std::vector<size_t> epoch_order_;
   int64_t shuffled_epoch_ = -1;

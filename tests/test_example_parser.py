@@ -198,3 +198,185 @@ def test_encode_bool_array_as_int64():
     out = parse_single_example(
         msg, {"flags": FixedLenFeature((3,), np.int64)})
     assert out["flags"].tolist() == [1, 0, 1]
+
+def test_sequence_example_roundtrip_and_tf_interop():
+    """SequenceExample parsing (VERDICT r4 item 4b): our encoder's bytes
+    parse identically through tf.io.parse_single_sequence_example, and
+    TF-written SequenceExamples parse identically through ours."""
+    tf = pytest.importorskip("tensorflow")
+    from distributed_tensorflow_tpu.input.example_parser import (
+        FixedLenSequenceFeature, encode_sequence_example,
+        parse_single_sequence_example)
+
+    rng = np.random.default_rng(0)
+    ctx = {"id": np.array([7], np.int64),
+           "weight": np.array([0.5, 1.5], np.float32)}
+    seq = {"tokens": [rng.integers(0, 100, 5).astype(np.int64)
+                      for _ in range(4)],
+           "scores": [rng.normal(size=3).astype(np.float32)
+                      for _ in range(4)]}
+    msg = encode_sequence_example(ctx, seq)
+
+    # ours
+    c, s = parse_single_sequence_example(
+        msg,
+        context_features={"id": FixedLenFeature((1,), np.int64),
+                          "weight": FixedLenFeature((2,), np.float32)},
+        sequence_features={
+            "tokens": FixedLenSequenceFeature((5,), np.int64),
+            "scores": FixedLenSequenceFeature((3,), np.float32)})
+    assert c["id"][0] == 7
+    assert s["tokens"].shape == (4, 5)
+    np.testing.assert_array_equal(s["tokens"][2], seq["tokens"][2])
+
+    # TF parses OUR bytes
+    tfc, tfs = tf.io.parse_single_sequence_example(
+        msg,
+        context_features={
+            "id": tf.io.FixedLenFeature((1,), tf.int64),
+            "weight": tf.io.FixedLenFeature((2,), tf.float32)},
+        sequence_features={
+            "tokens": tf.io.FixedLenSequenceFeature((5,), tf.int64),
+            "scores": tf.io.FixedLenSequenceFeature((3,), tf.float32)})
+    np.testing.assert_array_equal(tfs["tokens"].numpy(), s["tokens"])
+    np.testing.assert_allclose(tfs["scores"].numpy(), s["scores"])
+    np.testing.assert_array_equal(tfc["id"].numpy(), c["id"])
+
+    # we parse TF-WRITTEN bytes
+    tf_msg = tf.train.SequenceExample(
+        context=tf.train.Features(feature={
+            "id": tf.train.Feature(int64_list=tf.train.Int64List(
+                value=[7]))}),
+        feature_lists=tf.train.FeatureLists(feature_list={
+            "tokens": tf.train.FeatureList(feature=[
+                tf.train.Feature(int64_list=tf.train.Int64List(
+                    value=list(row))) for row in seq["tokens"]])}),
+    ).SerializeToString()
+    c2, s2 = parse_single_sequence_example(
+        tf_msg,
+        context_features={"id": FixedLenFeature((1,), np.int64)},
+        sequence_features={
+            "tokens": FixedLenSequenceFeature((5,), np.int64)})
+    assert c2["id"][0] == 7
+    np.testing.assert_array_equal(s2["tokens"],
+                                  np.stack(seq["tokens"]))
+
+
+def test_sparse_and_ragged_features_match_tf():
+    """SparseFeature/RaggedFeature parsing matches tf.io on the same
+    bytes."""
+    tf = pytest.importorskip("tensorflow")
+    from distributed_tensorflow_tpu.input.example_parser import (
+        RaggedFeature, SparseFeature)
+
+    msg = encode_example({
+        "idx": np.array([5, 1, 3], np.int64),
+        "val": np.array([50.0, 10.0, 30.0], np.float32),
+        "rag": np.array([9, 8, 7, 6], np.int64),
+    })
+    ours = parse_single_example(msg, {
+        "sp": SparseFeature("idx", "val", np.float32, size=8),
+        "rag": RaggedFeature(np.int64),
+    })
+    # sorted by index, matching tf.io.SparseFeature semantics
+    np.testing.assert_array_equal(ours["sp"].indices, [1, 3, 5])
+    np.testing.assert_allclose(ours["sp"].values, [10.0, 30.0, 50.0])
+    dense = ours["sp"].to_dense()
+    assert dense.shape == (8,) and dense[5] == 50.0
+
+    tf_out = tf.io.parse_single_example(msg, {
+        "sp": tf.io.SparseFeature("idx", "val", tf.float32, size=8)})
+    np.testing.assert_array_equal(
+        tf.sparse.to_dense(tf_out["sp"]).numpy(), dense)
+
+    tf_rag = tf.io.parse_single_example(
+        msg, {"rag": tf.io.RaggedFeature(tf.int64)})
+    np.testing.assert_array_equal(tf_rag["rag"].numpy(), ours["rag"])
+
+
+def test_sequence_example_fuzz_interop_with_tf():
+    """Fuzz: random context+sequence SequenceExamples written with TF
+    protos parse byte-identically through our parser."""
+    tf = pytest.importorskip("tensorflow")
+    from distributed_tensorflow_tpu.input.example_parser import (
+        FixedLenSequenceFeature, parse_single_sequence_example)
+
+    rng = np.random.default_rng(42)
+    for trial in range(20):
+        T = int(rng.integers(0, 6))
+        width = int(rng.integers(1, 4))
+        ctx_vals = rng.normal(size=int(rng.integers(1, 5))).astype(
+            np.float32)
+        rows = [rng.integers(-5, 100, width).astype(np.int64)
+                for _ in range(T)]
+        msg = tf.train.SequenceExample(
+            context=tf.train.Features(feature={
+                "c": tf.train.Feature(float_list=tf.train.FloatList(
+                    value=list(map(float, ctx_vals))))}),
+            feature_lists=tf.train.FeatureLists(feature_list={
+                "s": tf.train.FeatureList(feature=[
+                    tf.train.Feature(int64_list=tf.train.Int64List(
+                        value=list(map(int, row)))) for row in rows])}),
+        ).SerializeToString()
+        c, s = parse_single_sequence_example(
+            msg,
+            context_features={
+                "c": FixedLenFeature((len(ctx_vals),), np.float32)},
+            sequence_features={
+                "s": FixedLenSequenceFeature((width,), np.int64,
+                                             allow_missing=True)})
+        np.testing.assert_allclose(c["c"], ctx_vals, err_msg=str(trial))
+        expect = (np.stack(rows) if rows
+                  else np.zeros((0, width), np.int64))
+        np.testing.assert_array_equal(s["s"], expect, err_msg=str(trial))
+
+
+def test_gzip_zlib_tfrecord_interop(tmp_path):
+    """GZIP/ZLIB TFRecords (VERDICT r4 item 4a): we read TF-written
+    compressed files byte-identically and TF reads ours."""
+    tf = pytest.importorskip("tensorflow")
+    from distributed_tensorflow_tpu.input.example_parser import (
+        iter_tfrecords)
+    from distributed_tensorflow_tpu.input.native_loader import (
+        write_tfrecords)
+
+    payloads = [bytes([i]) * (5 + i) for i in range(12)]
+    for comp in ("GZIP", "ZLIB"):
+        theirs = str(tmp_path / f"tf.{comp}")
+        with tf.io.TFRecordWriter(
+                theirs, options=tf.io.TFRecordOptions(
+                    compression_type=comp)) as w:
+            for p in payloads:
+                w.write(p)
+        assert list(iter_tfrecords(theirs)) == payloads
+
+        ours = str(tmp_path / f"ours.{comp}")
+        write_tfrecords(ours, payloads, compression=comp)
+        got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(
+            ours, compression_type=comp)]
+        assert got == payloads
+
+def test_plain_tfrecord_with_compression_magic_prefix(tmp_path):
+    """An UNCOMPRESSED TFRecord whose first record length encodes to a
+    ZLIB/GZIP magic byte pair (length 376 -> 78 01; length 35615 ->
+    1f 8b) must still read as plain — the crc-validated header beats
+    the magic sniff (review finding r4)."""
+    from distributed_tensorflow_tpu.input.example_parser import (
+        iter_tfrecords)
+    from distributed_tensorflow_tpu.input.native_loader import (
+        NativeTFRecordDataset, write_tfrecords)
+
+    for length in (376, 35615):
+        payloads = [bytes(length), b"tail-record"]
+        p = str(tmp_path / f"plain_{length}.tfrecord")
+        write_tfrecords(p, payloads)
+        with open(p, "rb") as f:
+            magic = f.read(2)
+        assert magic in (b"\x78\x01", b"\x1f\x8b")   # the trap exists
+        assert list(iter_tfrecords(p)) == payloads
+        ds = NativeTFRecordDataset([p], batch_size=2, shuffle=False,
+                                   drop_remainder=False,
+                                   verify_crc=True)
+        recs, _ = ds.next_records()
+        ds.close()
+        assert recs == payloads

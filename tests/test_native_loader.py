@@ -177,3 +177,66 @@ def test_native_tfrecord_shard_and_crc_rejection(tmp_path):
     with pytest.raises(FileNotFoundError):
         NativeTFRecordDataset([str(tmp_path / "nope.tfrecord")],
                               batch_size=2)
+
+def test_native_tfrecord_gzip_zlib(tmp_path):
+    """The C++ reader inflates GZIP/ZLIB TFRecord files transparently
+    (VERDICT r4 item 4a) with crc verification intact."""
+    from distributed_tensorflow_tpu.input.native_loader import (
+        NativeTFRecordDataset, write_tfrecords)
+
+    payloads = [bytes([i]) * (10 + i) for i in range(20)]
+    for comp in ("GZIP", "ZLIB"):
+        path = str(tmp_path / f"f.{comp.lower()}")
+        write_tfrecords(path, payloads, compression=comp)
+        ds = NativeTFRecordDataset([path], batch_size=5, shuffle=False,
+                                   drop_remainder=False, verify_crc=True)
+        got = []
+        for _ in range(4):
+            recs, _epoch = ds.next_records()
+            got.extend(recs)
+        ds.close()
+        assert got == payloads, comp
+
+
+def test_native_tfrecord_gzip_corruption_detected(tmp_path):
+    import gzip
+
+    from distributed_tensorflow_tpu.input.native_loader import (
+        NativeTFRecordDataset)
+    from distributed_tensorflow_tpu.utils.summary import tfrecord_frame
+
+    payloads = [bytes([i]) * 16 for i in range(8)]
+    framed = bytearray(b"".join(tfrecord_frame(p) for p in payloads))
+    framed[20] ^= 0xFF                       # flip one payload byte
+    path = str(tmp_path / "bad.gz")
+    path_obj = open(path, "wb")
+    path_obj.write(gzip.compress(bytes(framed)))
+    path_obj.close()
+
+    ds = NativeTFRecordDataset([path], batch_size=4, shuffle=False,
+                               verify_crc=True)
+    with pytest.raises(Exception):
+        for _ in range(3):
+            ds.next_records()
+    ds.close()
+
+
+def test_native_fixed_records_gzip(tmp_path):
+    import gzip
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.input.native_loader import (
+        NativeRecordDataset)
+
+    arr = np.arange(60, dtype=np.float32).reshape(20, 3)
+    path = str(tmp_path / "fixed.gz")
+    with open(path, "wb") as f:
+        f.write(gzip.compress(arr.tobytes()))
+    ds = NativeRecordDataset([path], np.dtype(np.float32), (3,),
+                             batch_size=5, shuffle=False)
+    batch = ds.next_batch()
+    first = batch[0] if isinstance(batch, tuple) else batch
+    np.testing.assert_array_equal(np.asarray(first).reshape(5, 3),
+                                  arr[:5])
+    ds.close()
