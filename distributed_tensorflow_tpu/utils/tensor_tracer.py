@@ -67,16 +67,24 @@ class _Collector:
 _COLLECTOR = _Collector()
 
 
-def trace_point(name: str, x, *, enabled: bool | None = None):
+def trace_point(name: str, x, *, enabled: bool | None = None,
+                iteration=None):
     """Record numerics stats for ``x`` under ``name``; returns ``x``
     unchanged (insert anywhere in jitted code, like the reference's
     per-op instrumentation but opt-in). No-op unless inside a
-    :class:`TensorTracer` context (or ``enabled=True``)."""
+    :class:`TensorTracer` context (or ``enabled=True``).
+
+    ``iteration``: optional traced loop counter — entries from
+    instrumented scan/while bodies carry it as an ``iteration`` stat so
+    one body rewrite reports every trip (≙ the reference tagging trace
+    events with the training step)."""
     if enabled is None:
         enabled = _COLLECTOR.active
     if not enabled:
         return x
     stats = _stats(x)
+    if iteration is not None:
+        stats["iteration"] = jnp.asarray(iteration, jnp.int32)
 
     def record(**host_stats):
         # instrumentation is baked at TRACE time; collection is gated at
@@ -102,7 +110,12 @@ class TraceReport:
 
     def first_nan(self) -> "str | None":
         bad = self.nan_entries()
-        return bad[0][0] if bad else None
+        if not bad:
+            return None
+        name, stats = bad[0]
+        if "iteration" in stats:
+            return f"{name} [iteration {int(stats['iteration'])}]"
+        return name
 
     def __str__(self):
         lines = [f"{'tensor':50s} {'norm':>12s} {'max':>12s} "
@@ -198,11 +211,12 @@ def find_first_nan(module, variables, *args, **kwargs) -> "str | None":
 # ---------------------------------------------------------------------------
 
 # Call-like primitives whose sub-jaxpr is inlined and instrumented too.
-# scan/while/cond are deliberately NOT entered: re-binding their bodies
-# per-equation would change trip semantics; their OUTPUTS are traced.
-# For per-op coverage INSIDE scanned transformer layers, trace with
-# cfg.scan_layers=False — the unrolled graph is exactly what the
-# reference instruments (its TF graphs are always layer-unrolled).
+# scan/while/cond get dedicated handling below: their BODIES are
+# rewritten once into instrumented Python functions and re-staged
+# through lax.scan/while_loop/switch, so every iteration reports per-
+# equation stats tagged with a carried iteration counter (≙ the
+# reference instrumenting the compiled program as-is — its TF graphs
+# keep the while-loop and the instrumentation rides inside it).
 _CALL_PRIMITIVES = {"jit", "pjit", "closed_call", "core_call",
                     "remat", "remat2", "checkpoint",
                     "custom_jvp_call", "custom_vjp_call",
@@ -227,17 +241,22 @@ def instrument(fn: Callable, *, op_regex: "str | None" = None,
 
     The wrapper stages ``fn`` to a jaxpr, then re-traces it equation by
     equation, attaching the on-device stats bundle (via
-    :func:`trace_point`) to each numeric output; jit/remat/custom-grad
-    sub-jaxprs are entered recursively, so scan-layers models still get
-    per-op coverage of the layer body. The result is itself jittable;
-    run it under a :class:`TensorTracer` context to collect.
+    :func:`trace_point`) to each numeric output. jit/remat/custom-grad
+    sub-jaxprs are entered recursively, and scan/while/cond bodies are
+    rewritten ONCE and re-staged through lax.scan/while_loop/switch —
+    every loop trip reports per-equation stats tagged with a carried
+    ``iteration`` counter, so a ``scan_layers=True`` model gets per-op,
+    per-LAYER coverage with no reconfiguration (the layer index IS the
+    scan iteration). The result is itself jittable; run it under a
+    :class:`TensorTracer` context to collect.
 
     ``op_regex`` filters by primitive name (≙ --included_ops),
     ``name_regex`` by the full entry name incl. source file:line,
     ``max_traced`` caps the number of instrumented equations.
-    Forward-pass instrumentation: differentiating the wrapper re-derives
-    gradients through the INLINED sub-jaxprs (custom_vjp rules are not
-    re-attached), so use it for inference/loss numerics, not training.
+    A train step CONTAINING ``jax.grad``/``value_and_grad`` instruments
+    fine (the grad is resolved before staging, custom_vjp rules and
+    all); what remains unsupported is differentiating the instrumented
+    wrapper itself — instrument the whole train step instead.
     """
     import re as _re
     from jax._src import source_info_util
@@ -258,7 +277,103 @@ def instrument(fn: Callable, *, op_regex: "str | None" = None,
         def read(env, v):
             return v.val if isinstance(v, jexc.Literal) else env[id(v)]
 
-        def eval_jaxpr(jaxpr, consts, args, prefix):
+        def maybe_trace(eqn, prefix, outs, iteration):
+            """Attach trace points to an equation's numeric outputs."""
+            prim = eqn.primitive
+            src = source_info_util.summarize(eqn.source_info)
+            for j, (var, val) in enumerate(zip(eqn.outvars, outs)):
+                if not _numeric_aval(var.aval):
+                    continue
+                idx = counter["n"]
+                counter["n"] += 1
+                tag = "" if len(eqn.outvars) == 1 else f".{j}"
+                name = f"{idx:04d} {prefix}{prim.name}{tag} {src}"
+                if op_re and not op_re.search(prim.name):
+                    continue
+                if name_re and not name_re.search(name):
+                    continue
+                if (max_traced is not None
+                        and counter["traced"] >= max_traced):
+                    continue
+                counter["traced"] += 1
+                outs[j] = trace_point(name, val, enabled=True,
+                                      iteration=iteration)
+            return outs
+
+        def closed_parts(sub):
+            if hasattr(sub, "jaxpr"):          # ClosedJaxpr
+                return sub.jaxpr, sub.consts
+            return sub, []
+
+        def eval_scan(eqn, invals, prefix, iteration):
+            """Re-stage a scan with its body instrumented ONCE; the
+            carried counter tags every trip's stats."""
+            p = eqn.params
+            body_jaxpr, body_consts = closed_parts(p["jaxpr"])
+            nc, ncarry = p["num_consts"], p["num_carry"]
+            consts_in = invals[:nc]
+            carry_in = invals[nc:nc + ncarry]
+            xs = invals[nc + ncarry:]
+
+            def body_fn(carry_it, x):
+                carry, it = carry_it
+                outs = eval_jaxpr(body_jaxpr, body_consts,
+                                  [*consts_in, *carry, *x],
+                                  f"{prefix}scan/", iteration=it)
+                return (outs[:ncarry], it + 1), outs[ncarry:]
+
+            (carry_out, _), ys = jax.lax.scan(
+                body_fn, (list(carry_in), jnp.int32(0)), list(xs),
+                length=p["length"], reverse=p["reverse"],
+                unroll=p.get("unroll", 1))
+            return [*carry_out, *ys]
+
+        def eval_while(eqn, invals, prefix, iteration):
+            """Re-stage a while_loop: the body is instrumented (with a
+            trip counter smuggled into the carry); the COND stays
+            uninstrumented — it must remain effect-free."""
+            p = eqn.params
+            cond_jaxpr, cond_consts = closed_parts(p["cond_jaxpr"])
+            body_jaxpr, body_consts = closed_parts(p["body_jaxpr"])
+            cn, bn = p["cond_nconsts"], p["body_nconsts"]
+            cconsts = invals[:cn]
+            bconsts = invals[cn:cn + bn]
+            init = list(invals[cn + bn:])
+
+            def cond_fn(state):
+                carry, _it = state
+                from jax.extend.core import jaxpr_as_fun
+                from jax.extend import core as _jexc
+                closed = _jexc.ClosedJaxpr(cond_jaxpr, cond_consts)
+                return jaxpr_as_fun(closed)(*cconsts, *carry)[0]
+
+            def body_fn(state):
+                carry, it = state
+                outs = eval_jaxpr(body_jaxpr, body_consts,
+                                  [*bconsts, *carry],
+                                  f"{prefix}while/", iteration=it)
+                return (outs, it + 1)
+
+            carry_out, _ = jax.lax.while_loop(
+                cond_fn, body_fn, (init, jnp.int32(0)))
+            return list(carry_out)
+
+        def eval_cond(eqn, invals, prefix, iteration):
+            """Re-stage lax.cond/switch with every branch
+            instrumented."""
+            index, *ops = invals
+            branches = [closed_parts(b) for b in eqn.params["branches"]]
+
+            def make_branch(k, bj, bc):
+                return lambda *a: eval_jaxpr(
+                    bj, bc, list(a), f"{prefix}branch{k}/",
+                    iteration=iteration)
+
+            return jax.lax.switch(
+                index, [make_branch(k, bj, bc)
+                        for k, (bj, bc) in enumerate(branches)], *ops)
+
+        def eval_jaxpr(jaxpr, consts, args, prefix, iteration=None):
             env: dict = {}
             for v, c in zip(jaxpr.constvars, consts):
                 env[id(v)] = c
@@ -272,39 +387,24 @@ def instrument(fn: Callable, *, op_regex: "str | None" = None,
                     sub = (eqn.params.get("jaxpr")
                            or eqn.params.get("call_jaxpr")
                            or eqn.params.get("fun_jaxpr"))
-                if sub is not None:
-                    if hasattr(sub, "jaxpr"):      # ClosedJaxpr
-                        sub_jaxpr, sub_consts = sub.jaxpr, sub.consts
-                    else:
-                        sub_jaxpr, sub_consts = sub, []
+                if prim.name == "scan":
+                    outs = eval_scan(eqn, invals, prefix, iteration)
+                elif prim.name == "while":
+                    outs = eval_while(eqn, invals, prefix, iteration)
+                elif prim.name == "cond":
+                    outs = eval_cond(eqn, invals, prefix, iteration)
+                elif sub is not None:
+                    sub_jaxpr, sub_consts = closed_parts(sub)
                     sub_name = eqn.params.get("name", prim.name)
                     outs = eval_jaxpr(sub_jaxpr, sub_consts, invals,
-                                      f"{prefix}{sub_name}/")
+                                      f"{prefix}{sub_name}/",
+                                      iteration=iteration)
                 else:
                     outs = prim.bind(*invals, **eqn.params)
                     if not prim.multiple_results:
                         outs = [outs]
                     if prim.name not in _SKIP_PRIMITIVES:
-                        src = source_info_util.summarize(eqn.source_info)
-                        for j, (var, val) in enumerate(
-                                zip(eqn.outvars, outs)):
-                            if not _numeric_aval(var.aval):
-                                continue
-                            idx = counter["n"]
-                            counter["n"] += 1
-                            tag = ("" if len(eqn.outvars) == 1
-                                   else f".{j}")
-                            name = (f"{idx:04d} {prefix}{prim.name}{tag} "
-                                    f"{src}")
-                            if op_re and not op_re.search(prim.name):
-                                continue
-                            if name_re and not name_re.search(name):
-                                continue
-                            if (max_traced is not None
-                                    and counter["traced"] >= max_traced):
-                                continue
-                            counter["traced"] += 1
-                            outs[j] = trace_point(name, val, enabled=True)
+                        outs = maybe_trace(eqn, prefix, outs, iteration)
                 for var, val in zip(eqn.outvars, outs):
                     env[id(var)] = val
             return [read(env, v) for v in jaxpr.outvars]
