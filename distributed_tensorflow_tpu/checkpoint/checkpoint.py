@@ -309,6 +309,55 @@ class Checkpoint:
     def read(self, path: str) -> dict:
         return self.restore(path)
 
+    def restore_into(self, path: str) -> dict:
+        """Restore from ``path`` AND update the tracked objects in
+        place: DistributedVariables are assigned (as in
+        :meth:`restore`), and plain-array leaves are replaced inside the
+        tracked pytrees, so code holding this ``Checkpoint`` (e.g. a
+        SidecarEvaluator's eval_fn) sees the restored state without
+        private-attribute surgery. Returns the flat restored mapping."""
+        flat_restored = self.restore(path)
+
+        def rebuild(obj, prefix):
+            if isinstance(obj, DistributedVariable) or hasattr(obj,
+                                                               "assign"):
+                return obj                 # assigned in place already
+            if isinstance(obj, Mapping):
+                return type(obj)(
+                    {k: rebuild(obj[k],
+                                f"{prefix}/{k}" if prefix else str(k))
+                     for k in obj})
+            if isinstance(obj, (list, tuple)):
+                vals = [rebuild(v, f"{prefix}/{i}" if prefix else str(i))
+                        for i, v in enumerate(obj)]
+                return type(obj)(vals) if not hasattr(obj, "_fields") \
+                    else type(obj)(*vals)
+            if (hasattr(obj, "__dict__")
+                    and hasattr(obj, "_checkpoint_children")):
+                for k, child in obj._checkpoint_children().items():
+                    newc = rebuild(child,
+                                   f"{prefix}/{k}" if prefix else k)
+                    if newc is not child:
+                        if k in vars(obj):
+                            setattr(obj, k, newc)
+                        else:
+                            raise ValueError(
+                                f"restore_into cannot write restored "
+                                f"child {k!r} back into "
+                                f"{type(obj).__name__}: "
+                                f"_checkpoint_children keys must be "
+                                f"attributes (or use .assign leaves)")
+                return obj
+            return flat_restored.get(prefix or "value", obj)
+
+        for name in list(self._objects):
+            self._objects[name] = rebuild(self._objects[name], name)
+        return flat_restored
+
+    def get(self, name: str):
+        """Public access to a tracked object by constructor kwarg name."""
+        return self._objects[name]
+
 
 class CheckpointManager:
     """Rotation + latest-tracking (≙ checkpoint_management.py:519).

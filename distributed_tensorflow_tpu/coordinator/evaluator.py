@@ -17,6 +17,7 @@ it can only observe complete checkpoints.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import time
@@ -26,6 +27,8 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
     latest_checkpoint,
 )
+
+_log = logging.getLogger(__name__)
 
 
 class SidecarEvaluator:
@@ -38,6 +41,10 @@ class SidecarEvaluator:
     Stops when a checkpoint with number >= ``final_step`` has been
     evaluated (≙ the reference stopping at the final checkpoint), or
     after ``idle_timeout_s`` with nothing new (trainer died).
+
+    ``evaluate_every_checkpoint=True`` walks EVERY unseen checkpoint in
+    step order instead of only the latest — for evaluators slower than
+    the trainer's rotation cadence that must not skip steps.
     """
 
     def __init__(self, checkpoint: Checkpoint, directory: str,
@@ -46,7 +53,8 @@ class SidecarEvaluator:
                  summary_dir: str | None = None,
                  poll_interval_s: float = 0.5,
                  final_step: int | None = None,
-                 idle_timeout_s: float = 120.0):
+                 idle_timeout_s: float = 120.0,
+                 evaluate_every_checkpoint: bool = False):
         self._checkpoint = checkpoint
         self._directory = directory
         self._eval_fn = eval_fn
@@ -55,11 +63,45 @@ class SidecarEvaluator:
         self._poll_s = poll_interval_s
         self._final_step = final_step
         self._idle_timeout_s = idle_timeout_s
+        self._eval_all = evaluate_every_checkpoint
 
     @staticmethod
     def _step_of(path: str) -> int:
+        """Checkpoint number from a ``<name>-<number>`` path; raises on
+        an unparseable name — a silent -1 would quietly disable the
+        ``final_step`` stop condition and leave the loop exiting only
+        via idle timeout."""
         m = re.search(r"-(\d+)$", path)
-        return int(m.group(1)) if m else -1
+        if not m:
+            raise ValueError(
+                f"checkpoint path {path!r} does not end in "
+                f"'-<number>'; cannot order it / match final_step")
+        return int(m.group(1))
+
+    def _pending_paths(self, seen: set) -> list:
+        """Unseen checkpoints to evaluate, oldest first (or just the
+        latest when evaluate_every_checkpoint=False)."""
+        if not self._eval_all:
+            path = latest_checkpoint(self._directory, self._name)
+            return [path] if path is not None and path not in seen else []
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            _INDEX_FILE)
+        pat = re.compile(re.escape(self._name) + r"-(\d+)$")
+        found = []
+        try:
+            names = os.listdir(self._directory)
+        except OSError:
+            return []
+        for n in names:
+            m = pat.match(n)
+            full = os.path.join(self._directory, n)
+            # the index file is the COMMIT MARKER (written last by
+            # _commit): a dir without it is a checkpoint mid-write —
+            # listing it would mark it seen and permanently skip it
+            if (m and full not in seen
+                    and os.path.exists(os.path.join(full, _INDEX_FILE))):
+                found.append((int(m.group(1)), full))
+        return [p for _, p in sorted(found)]
 
     def run(self) -> list[tuple[int, dict]]:
         """The evaluator loop; returns [(step, metrics), ...] evaluated."""
@@ -74,25 +116,26 @@ class SidecarEvaluator:
         deadline = time.monotonic() + self._idle_timeout_s
         try:
             while True:
-                path = latest_checkpoint(self._directory, self._name)
-                if path is not None and path not in seen:
+                progressed = False
+                for path in self._pending_paths(seen):
                     seen.add(path)
+                    # paths come from the name-(\d+) pattern, so this
+                    # cannot fail here; _step_of stays strict for any
+                    # external caller (a silent -1 would disable the
+                    # final_step stop)
                     step = self._step_of(path)
                     try:
-                        restored = self._checkpoint.restore(path)
+                        self._checkpoint.restore_into(path)
                     except (OSError, KeyError, ValueError):
                         # rotation race: the trainer swept this
                         # checkpoint mid-restore — skip it, the next
                         # poll sees a newer one (tf_keras
                         # SidecarEvaluator tolerates this the same way)
+                        _log.info(
+                            "SidecarEvaluator: checkpoint %r vanished "
+                            "mid-restore (rotation race); skipping",
+                            path)
                         continue
-                    # restore() assigns variables in place but returns
-                    # plain leaves; fold top-level ones back into the
-                    # checkpoint so eval_fn sees the restored state
-                    for name, val in restored.items():
-                        obj = self._checkpoint._objects.get(name)
-                        if obj is not None and not hasattr(obj, "assign"):
-                            self._checkpoint._objects[name] = val
                     metrics = self._eval_fn(self._checkpoint, step) or {}
                     if writer is not None:
                         writer.scalars(
@@ -100,13 +143,14 @@ class SidecarEvaluator:
                              for k, v in metrics.items()}, step)
                         writer.flush()
                     evaluated.append((step, metrics))
+                    progressed = True
                     deadline = time.monotonic() + self._idle_timeout_s
                     if (self._final_step is not None
                             and step >= self._final_step):
                         return evaluated
-                elif time.monotonic() > deadline:
-                    return evaluated          # trainer gone quiet: stop
-                else:
+                if not progressed:
+                    if time.monotonic() > deadline:
+                        return evaluated      # trainer gone quiet: stop
                     time.sleep(self._poll_s)
         finally:
             if writer is not None:

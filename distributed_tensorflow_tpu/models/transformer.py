@@ -468,15 +468,29 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
     the per-layer load-balancing aux losses (flax "losses" collection)
     are summed into the objective (≙ Switch Transformer training)."""
 
+    if cfg.loss_impl not in ("scan", "kernel"):
+        raise ValueError(f"loss_impl={cfg.loss_impl!r}; expected "
+                         f"'scan' or 'kernel'")
     # Pallas custom calls cannot be GSPMD-partitioned (same constraint
     # as the attention kernel): the kernel CE path runs single-chip
     # only; sharded meshes keep the scan path, whose einsums GSPMD
     # partitions natively (incl. vocab-sharded tp embeddings).
-    # loss_impl="kernel" implies a fused loss even with loss_chunks=0 —
-    # silently materializing full logits would defeat its purpose.
+    # loss_impl="kernel" implies a FUSED loss in every case: on a
+    # sharded mesh it falls back to the scan path with a default chunk
+    # count rather than ever materializing full (B, S, vocab) logits.
     use_kernel = (cfg.loss_impl == "kernel"
                   and (cfg.mesh is None or cfg.mesh.size == 1))
-    fused = cfg.loss_chunks > 0 or use_kernel
+    fused = cfg.loss_chunks > 0 or cfg.loss_impl == "kernel"
+    if cfg.loss_chunks > 0:
+        scan_chunks = cfg.loss_chunks
+    else:
+        # kernel→scan fallback default: the largest power of two that
+        # divides the sequence length, capped at 8 (a blind 8 would
+        # crash at trace time on seq lens not divisible by 8)
+        scan_chunks = 1
+        while (scan_chunks < 8
+               and cfg.max_seq_len % (scan_chunks * 2) == 0):
+            scan_chunks *= 2
 
     def objective(out, params, tokens):
         if use_kernel:
@@ -486,7 +500,7 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
         if fused:
             return fused_next_token_loss(
                 out, params["embed"], tokens,
-                num_chunks=cfg.loss_chunks, compute_dtype=cfg.dtype,
+                num_chunks=scan_chunks, compute_dtype=cfg.dtype,
                 chunk_policy=cfg.loss_chunk_policy)
         return next_token_loss(out, tokens)
 

@@ -179,3 +179,143 @@ def test_preemption_grace_period_keeps_training(tmp_path):
         _time.sleep(0.01)
     assert exited                  # window closed -> exit at boundary
     assert steps_after_save > 5    # genuinely kept training
+
+# -- SidecarEvaluator hardening (VERDICT r4 item 6) -------------------------
+
+def _make_ckpt_dir(tmp_path, steps, value_fn=lambda s: s):
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, CheckpointManager)
+    ck = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    mgr = CheckpointManager(ck, str(tmp_path), max_to_keep=50)
+    for s in steps:
+        ck._objects["state"]["w"] = np.full(3, float(value_fn(s)),
+                                            np.float32)
+        mgr.save(checkpoint_number=s)
+    return ck
+
+
+def test_restore_into_updates_nested_plain_leaves(tmp_path):
+    """The public restore-into API (replaces the evaluator's private
+    _objects poke): nested plain-array leaves update in place."""
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, latest_checkpoint)
+    _make_ckpt_dir(tmp_path, [5], value_fn=lambda s: 42.0)
+    ck2 = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    path = latest_checkpoint(str(tmp_path))
+    ck2.restore_into(path)
+    np.testing.assert_array_equal(ck2.get("state")["w"],
+                                  np.full(3, 42.0, np.float32))
+
+
+def test_sidecar_evaluates_every_checkpoint_in_order(tmp_path):
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import Checkpoint
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator)
+    _make_ckpt_dir(tmp_path, [1, 2, 3, 4])
+    ck = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    got = []
+
+    def eval_fn(ckpt, step):
+        got.append((step, float(ckpt.get("state")["w"][0])))
+        return {"v": float(ckpt.get("state")["w"][0])}
+
+    ev = SidecarEvaluator(ck, str(tmp_path), eval_fn, final_step=4,
+                          evaluate_every_checkpoint=True,
+                          idle_timeout_s=10)
+    results = ev.run()
+    assert [s for s, _ in got] == [1, 2, 3, 4]          # ALL, in order
+    assert got == [(s, float(s)) for s in (1, 2, 3, 4)]  # restored state
+    assert results[-1][0] == 4                           # final-step stop
+
+
+def test_sidecar_latest_only_skips_intermediate(tmp_path):
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import Checkpoint
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator)
+    _make_ckpt_dir(tmp_path, [1, 2, 3])
+    ck = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    steps = []
+    ev = SidecarEvaluator(ck, str(tmp_path),
+                          lambda c, s: steps.append(s) or {},
+                          final_step=3, idle_timeout_s=10)
+    ev.run()
+    assert steps == [3]                # latest only
+
+
+def test_sidecar_malformed_names_raise_not_minus_one(tmp_path):
+    """_step_of is strict: an unparseable name raises instead of the
+    old silent -1 (which quietly disabled the final_step stop)."""
+    import numpy as np
+    import pytest
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import Checkpoint
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator)
+    _make_ckpt_dir(tmp_path, [7])
+    ev = SidecarEvaluator(Checkpoint(state={"w": np.zeros(3)}),
+                          str(tmp_path), lambda c, s: {},
+                          final_step=7, idle_timeout_s=10,
+                          evaluate_every_checkpoint=True)
+    with pytest.raises(ValueError, match="-<number>"):
+        ev._step_of("ckpt-weird")
+    results = ev.run()
+    assert [s for s, _ in results] == [7]
+
+
+def test_sidecar_torn_checkpoint_not_marked_seen(tmp_path):
+    """A checkpoint dir WITHOUT its index commit marker (mid-write) is
+    invisible to the evaluator until the index lands — listing it early
+    would mark it seen and skip it forever (review finding r4)."""
+    import os
+
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        _INDEX_FILE, Checkpoint)
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator)
+    _make_ckpt_dir(tmp_path, [1, 2])
+    # tear checkpoint 2: hide its commit marker (as during _commit)
+    idx = tmp_path / "ckpt-2" / _INDEX_FILE
+    hidden = tmp_path / "idx.bak"
+    os.rename(idx, hidden)
+    ck = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    ev = SidecarEvaluator(ck, str(tmp_path), lambda c, s: {},
+                          final_step=2, idle_timeout_s=10,
+                          poll_interval_s=0.05,
+                          evaluate_every_checkpoint=True)
+    seen: set = set()
+    assert [os.path.basename(p) for p in ev._pending_paths(seen)] ==         ["ckpt-1"]
+    os.rename(hidden, idx)              # commit lands
+    assert [os.path.basename(p) for p in ev._pending_paths({
+        str(tmp_path / "ckpt-1")})] == ["ckpt-2"]
+    results = ev.run()
+    assert [s for s, _ in results] == [1, 2]
+
+
+def test_sidecar_rotation_race_skips_and_continues(tmp_path):
+    """A checkpoint directory that vanishes mid-restore (trainer swept
+    it) is skipped; the evaluator proceeds to the next one."""
+    import shutil
+
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import Checkpoint
+    from distributed_tensorflow_tpu.coordinator.evaluator import (
+        SidecarEvaluator)
+    _make_ckpt_dir(tmp_path, [1, 2])
+    # gut checkpoint 1: index present, shards missing -> restore raises
+    victim = tmp_path / "ckpt-1"
+    for f in victim.iterdir():
+        if f.name.endswith(".npz"):
+            f.unlink()
+    ck = Checkpoint(state={"w": np.zeros(3, np.float32)})
+    steps = []
+    ev = SidecarEvaluator(ck, str(tmp_path),
+                          lambda c, s: steps.append(s) or {},
+                          final_step=2, idle_timeout_s=10,
+                          evaluate_every_checkpoint=True)
+    results = ev.run()
+    assert steps == [2]               # 1 skipped, 2 evaluated, stop
+    assert [s for s, _ in results] == [2]
