@@ -19,8 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Set via env BEFORE importing jax (config defaults read env at import)
 # and not via jax.config, so multi_process_runner children inherit it.
 # (≙ the reference's bazel-level test result caching — same role.)
+# Location: DTX_TEST_CACHE_DIR if set, else a REPO-LOCAL .cache dir —
+# the repo survives across driver rounds while ~/.cache may be wiped,
+# so repeat runs stay warm wherever the checkout lives.
+_repo_cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".cache", "dtx_jax_cache")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.expanduser("~/.cache/dtx_jax_cache"))
+                      os.environ.get("DTX_TEST_CACHE_DIR", _repo_cache))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
