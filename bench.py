@@ -38,6 +38,16 @@ def param_count(params):
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 
 
+def step_flops(cfg, batch: int, n_params: int) -> float:
+    """Model FLOPs per train step: 6*N per token (fwd+bwd matmuls) +
+    the causal attention term. Single source of truth — tools/ce_ab.py
+    imports this so A/B MFU numbers stay comparable to the headline."""
+    tokens_per_step = batch * cfg.max_seq_len
+    attn = (cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2
+            * cfg.d_model * 0.5)
+    return 6 * n_params * tokens_per_step + attn
+
+
 def sp_kernel_smoke() -> str:
     """Run the REAL (Mosaic) SP per-step kernels inside shard_map on the
     attached chip — a shard_map(sp=1) mesh, so one chip exercises the
@@ -145,11 +155,8 @@ def main():
     tokens_per_step = batch * cfg.max_seq_len
     tokens_per_sec = tokens_per_step / dt
 
-    # Model FLOPs: 6*N per token (fwd+bwd matmuls) + causal attention term.
-    attn_flops = (cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2
-                  * cfg.d_model * 0.5)
-    step_flops = 6 * n_params * tokens_per_step + attn_flops
-    mfu = (step_flops / dt) / (PEAK_TFLOPS.get(backend, 1.0) * 1e12)
+    mfu = (step_flops(cfg, batch, n_params) / dt) \
+        / (PEAK_TFLOPS.get(backend, 1.0) * 1e12)
 
     result = {
         "metric": "transformer_big_train_tokens_per_sec",

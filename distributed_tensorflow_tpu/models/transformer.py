@@ -122,6 +122,9 @@ class TransformerConfig:
     loss_impl: str = "scan"
     loss_block_n: int = 512
     loss_block_v: int = 1024
+    # adamw first-moment dtype: bfloat16 halves the mu read+write HBM
+    # traffic of the (bandwidth-bound) optimizer update; None = fp32.
+    adam_mu_dtype: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -460,7 +463,8 @@ def kernel_next_token_loss(hidden, embed, tokens, *,
 
 
 def make_optimizer(cfg: TransformerConfig):
-    return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+    return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay,
+                       mu_dtype=cfg.adam_mu_dtype)
 
 
 def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):

@@ -347,13 +347,9 @@ def _bwd_merged_call(h, emb, targets, lse, g, block_n, block_v,
     n, d = h.shape
     v = emb.shape[0]
     nb, vb = pl.cdiv(n, block_n), pl.cdiv(v, block_v)
-    # The aliased dh buffer is read back one grid step after it is
-    # written on the next vocab sweep; keep >= 4 inner steps between a
-    # block's write and its next read so the write-back DMA always
-    # lands before the prefetch (see grid note in the kernel).
-    while nb < 4 and block_n > 128:
-        block_n //= 2
-        nb = pl.cdiv(n, block_n)
+    # Caller (_fused_ce_bwd) guarantees nb >= 4: the aliased dh buffer
+    # is read back one vocab sweep after its write, and fewer inner
+    # steps between them would race the write-back DMA.
     dh_init = jnp.zeros((nb * block_n, d), jnp.float32)
     dh, de = pl.pallas_call(
         functools.partial(_bwd_merged_kernel, block_n=block_n,

@@ -18,16 +18,17 @@ sys.path.insert(0, ".")
 from distributed_tensorflow_tpu.models.transformer import (  # noqa: E402
     TransformerConfig, TransformerLM, make_optimizer, make_train_step,
     synthetic_tokens)
-from bench import PEAK_TFLOPS  # noqa: E402  (single source of truth)
+from bench import PEAK_TFLOPS, step_flops  # noqa: E402  (shared cost model)
 
 PEAK = PEAK_TFLOPS["tpu"] * 1e12
 
 
 def build(loss_impl: str, batch: int, **cfg_kw):
-    cfg = TransformerConfig.transformer_big(
-        max_seq_len=1024, remat=False, scan_layers=False,
-        loss_chunks=8, attn_block_q=1024, attn_block_k=1024,
-        loss_impl=loss_impl, **cfg_kw)
+    base = dict(max_seq_len=1024, remat=False, scan_layers=False,
+                loss_chunks=8, attn_block_q=1024, attn_block_k=1024,
+                loss_impl=loss_impl)
+    base.update(cfg_kw)
+    cfg = TransformerConfig.transformer_big(**base)
     model = TransformerLM(cfg)
     tx = make_optimizer(cfg)
     tokens = synthetic_tokens(batch, cfg.max_seq_len, cfg.vocab_size)
@@ -129,9 +130,7 @@ def main():
     for name, (loop, state, tokens, n_params, cfg) in arms.items():
         dt = (best[name][1] - best[name][0]) / n_iters
         tps = batch * cfg.max_seq_len
-        attn = cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2 \
-            * cfg.d_model * 0.5
-        mfu = ((6 * n_params * tps + attn) / dt) / PEAK
+        mfu = (step_flops(cfg, batch, n_params) / dt) / PEAK
         print(f"{name}: step {dt*1e3:.2f} ms  mfu {mfu:.4f}  "
               f"tokens/s {tps/dt:,.0f}")
 
