@@ -84,6 +84,52 @@ def sp_kernel_smoke() -> str:
         return f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def ce_grad_parity_smoke() -> str:
+    """Compiled-mode fused-CE value+grad parity vs the naive CE, ON THE
+    CHIP, plus a determinism double-run — every driver-captured bench
+    re-verifies the merged backward's input→output-aliased fp32
+    accumulation (its stale-read margin is exactly the kind of invariant
+    a Mosaic scheduling change could silently break; CI's interpret
+    tests deliberately take the race-free split kernels, so this is the
+    only automated gate on the compiled path). ~seconds at this shape.
+    Returns "ok" or a failure summary."""
+    import numpy as np
+    from distributed_tensorflow_tpu.ops.fused_ce import (
+        ce_reference, fused_cross_entropy)
+
+    try:
+        N, V, D = 2048, 32768, 1024
+        h = jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.bfloat16)
+        E = jax.random.normal(jax.random.PRNGKey(1), (V, D),
+                              jnp.bfloat16) * 0.02
+        t = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V,
+                               jnp.int32)
+
+        def vg(impl):
+            def f(h, E):
+                l = (fused_cross_entropy(h, E, t, implementation=impl)
+                     if impl else ce_reference(h, E, t))
+                return l.mean()
+            return jax.jit(jax.value_and_grad(f, argnums=(0, 1)))
+
+        lk1, gk1 = jax.block_until_ready(vg("pallas")(h, E))
+        lk2, gk2 = jax.block_until_ready(vg("pallas")(h, E))
+        lr, gr = jax.block_until_ready(vg(None)(h, E))
+        if abs(float(lk1) - float(lr)) > 2e-3 * abs(float(lr)):
+            return f"loss mismatch {float(lk1):.5f} vs {float(lr):.5f}"
+        for a, b in zip(gk1, gk2):     # determinism across runs
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                return "nondeterministic gradients across runs"
+        for a, b in zip(gk1, gr):      # bf16-resolution parity
+            a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+            err = np.max(np.abs(a32 - b32) / (np.abs(b32) + 2e-4))
+            if not err < 0.1:
+                return f"grad mismatch rel err {err:.3e}"
+        return "ok"
+    except Exception as e:                      # noqa: BLE001
+        return f"{type(e).__name__}: {str(e)[:200]}"
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -174,6 +220,7 @@ def main():
     }
     if on_tpu:
         result["extra"]["sp_mosaic_smoke"] = sp_kernel_smoke()
+        result["extra"]["ce_grad_parity"] = ce_grad_parity_smoke()
     print(json.dumps(result))
 
 

@@ -27,8 +27,8 @@ from distributed_tensorflow_tpu.training import callbacks
 from distributed_tensorflow_tpu.training import layers
 from distributed_tensorflow_tpu.training import losses
 from distributed_tensorflow_tpu.training import metrics
-from distributed_tensorflow_tpu.training.layers import Input, Sequential
-from distributed_tensorflow_tpu.training.model import Model
+from distributed_tensorflow_tpu.training.functional import Input, Model
+from distributed_tensorflow_tpu.training.layers import Sequential
 
 
 class _Optimizers:

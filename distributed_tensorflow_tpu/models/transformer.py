@@ -39,7 +39,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_tensorflow_tpu.ops.attention import flash_attention
 
 param_with_axes = nn_partitioning.param_with_axes
-with_sharding_constraint = nn_partitioning.with_sharding_constraint
+def with_sharding_constraint(x, logical_axes, mesh=None):
+    """flax's logical-axis sharding constraint with the mesh passed
+    EXPLICITLY. In this jax/flax pairing ``with mesh:`` does not set the
+    abstract-mesh context flax checks (``jax.sharding.get_abstract_mesh``
+    — only ``jax.sharding.set_mesh`` does), so without the ``mesh``
+    kwarg every logical constraint silently no-ops and GSPMD sharding
+    propagation is free to pick mixed activation layouts (dp on batch +
+    fsdp on d_model) whose transitions force involuntary full
+    rematerialization. Duplicate mesh axes within one spec (batch over
+    (dp, fsdp) plus embed over fsdp) resolve to unsharded for the later
+    logical axis, matching the old intended semantics."""
+    return nn_partitioning.with_sharding_constraint(x, logical_axes,
+                                                    mesh=mesh)
 
 # Logical axis name -> mesh axes. "sp" shards the sequence axis of
 # activations when the mesh has it (ring attention path); "expert" axes
@@ -114,17 +126,31 @@ class TransformerConfig:
     loss_chunk_policy: str = "recompute"
     # Fused-CE implementation: "scan" = the lax.scan chunk path above;
     # "kernel" = the Pallas vocab-tiled online-logsumexp kernels
-    # (ops/fused_ce.py) — logits tiles never leave VMEM. Pallas custom
-    # calls cannot be GSPMD-partitioned, so the kernel runs single-chip
-    # only (mesh None or size 1); sharded meshes fall back to the scan
-    # path, whose einsums GSPMD partitions natively. "kernel" implies
-    # the fused loss even when loss_chunks == 0.
+    # (ops/fused_ce.py) — logits tiles never leave VMEM. On sharded
+    # meshes the kernels run per-shard under shard_map with a cross-
+    # shard logsumexp merge for tp-sharded vocabs
+    # (ops/fused_ce.py sharded_fused_cross_entropy); meshes whose
+    # shapes don't divide fall back to the scan path, whose einsums
+    # GSPMD partitions natively. "kernel" implies the fused loss even
+    # when loss_chunks == 0.
     loss_impl: str = "scan"
     loss_block_n: int = 512
     loss_block_v: int = 1024
+    # Kernel-CE lowering: "pallas" | "interpret" | "reference" | None
+    # (auto: pallas on TPU, reference elsewhere). "interpret" lets CPU
+    # meshes (tests, dryrun) exercise the real kernel code paths.
+    loss_kernel_impl: str | None = None
     # adamw first-moment dtype: bfloat16 halves the mu read+write HBM
     # traffic of the (bandwidth-bound) optimizer update; None = fp32.
     adam_mu_dtype: Any = None
+    # Fused optimizer update: one Pallas pass per parameter leaf with
+    # outputs aliased onto inputs (ops/fused_adamw.py) instead of the
+    # optax update→apply chain. Elementwise, so it runs per-shard under
+    # shard_map on sharded meshes (param_specs threaded in by
+    # make_sharded_train_step). optimizer_impl: "pallas" | "interpret" |
+    # "reference" | None (auto: pallas on TPU).
+    fused_optimizer: bool = False
+    optimizer_impl: str | None = None
 
     @property
     def head_dim(self) -> int:
@@ -158,6 +184,7 @@ class TransformerConfig:
 class RMSNorm(nn.Module):
     dtype: Any = jnp.bfloat16
     eps: float = 1e-6
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -166,7 +193,18 @@ class RMSNorm(nn.Module):
         x32 = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         y = x32 * jax.lax.rsqrt(var + self.eps) * scale
-        return y.astype(self.dtype)
+        y = y.astype(self.dtype)
+        if y.ndim == 3:
+            # Anchor the activation layout: without this, GSPMD sharding
+            # propagation flows the fsdp-sharded weights' D-axis sharding
+            # backward onto the norm output (a mixed dp-batch/fsdp-D
+            # layout), and resharding the norm INPUT into it is a
+            # transition XLA can only do by replicating ("involuntary
+            # full rematerialization" — one full-activation broadcast
+            # per layer on a dp×fsdp mesh).
+            y = with_sharding_constraint(y, ("batch", "seq", "embed"),
+                                         mesh=self.mesh)
+        return y
 
 
 def rotary_embedding(x, *, base: float = 10000.0):
@@ -244,7 +282,8 @@ class MultiHeadAttention(nn.Module):
             "out", nn.initializers.normal(D ** -0.5), (H, hd, D),
             jnp.float32, axes=("heads", "kv", "embed"))
         o = jnp.einsum("bshk,hkd->bsd", o, out_kernel.astype(cfg.dtype))
-        return with_sharding_constraint(o, ("batch", "seq", "embed"))
+        return with_sharding_constraint(o, ("batch", "seq", "embed"),
+                                        mesh=cfg.mesh)
 
 
 class MLP(nn.Module):
@@ -263,7 +302,8 @@ class MLP(nn.Module):
         gate, up = jnp.split(h, 2, axis=-1)
         h = nn.silu(gate) * up
         out = jnp.einsum("bsf,fd->bsd", h, wo.astype(cfg.dtype))
-        return with_sharding_constraint(out, ("batch", "seq", "embed"))
+        return with_sharding_constraint(out, ("batch", "seq", "embed"),
+                                        mesh=cfg.mesh)
 
 
 def remat_policy_for(cfg: TransformerConfig):
@@ -301,8 +341,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, _=None):
         cfg = self.cfg
-        x = x + MultiHeadAttention(cfg, name="attn")(RMSNorm(cfg.dtype)(x))
-        h = RMSNorm(cfg.dtype)(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(
+            RMSNorm(cfg.dtype, mesh=cfg.mesh)(x))
+        h = RMSNorm(cfg.dtype, mesh=cfg.mesh)(x)
         if cfg.moe_experts > 0:
             from distributed_tensorflow_tpu.parallel.moe import (
                 MoEConfig, MoELayer)
@@ -310,7 +351,7 @@ class Block(nn.Module):
                 num_experts=cfg.moe_experts, d_model=cfg.d_model,
                 d_ff=cfg.d_ff, capacity_factor=cfg.moe_capacity_factor,
                 top_k=cfg.moe_top_k, aux_loss_weight=cfg.moe_aux_weight,
-                dtype=cfg.dtype)
+                dtype=cfg.dtype, mesh=cfg.mesh)
             out, aux = MoELayer(moe_cfg, name="moe")(h)
             self.sow("losses", "moe_aux", aux,
                      reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
@@ -331,8 +372,19 @@ class TransformerLM(nn.Module):
             "embed", nn.initializers.normal(0.02),
             (cfg.vocab_size, cfg.d_model), jnp.float32,
             axes=("vocab", "embed"))
-        x = embed.astype(cfg.dtype)[tokens]
-        x = with_sharding_constraint(x, ("batch", "seq", "embed"))
+        # Unshard the table's d_model axis (fsdp) BEFORE the lookup: a
+        # gather from the fsdp-sharded table inherits D-over-fsdp output
+        # sharding, and the transition from that to the batch-sharded
+        # activation layout is one GSPMD cannot do efficiently (it
+        # replicates — "involuntary full rematerialization"). Gathering
+        # from a D-unsharded table makes the output inherit the token
+        # batch sharding directly; the explicit all-gather this forces
+        # is the same V×D traffic, minus the bad transition.
+        emb_c = with_sharding_constraint(embed.astype(cfg.dtype),
+                                         ("vocab", None), mesh=cfg.mesh)
+        x = emb_c[tokens]
+        x = with_sharding_constraint(x, ("batch", "seq", "embed"),
+                                     mesh=cfg.mesh)
 
         block = Block
         if cfg.remat:
@@ -356,7 +408,7 @@ class TransformerLM(nn.Module):
             for i in range(cfg.n_layers):
                 x, _ = block(cfg, name=f"layer_{i}")(x, None)
 
-        x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.dtype, mesh=cfg.mesh, name="final_norm")(x)
         if return_hidden:
             # Fused-loss path: the caller computes chunked logits + CE
             # against the tied embedding itself (fused_next_token_loss).
@@ -447,14 +499,29 @@ def _shifted_targets_and_mask(tokens):
 def kernel_next_token_loss(hidden, embed, tokens, *,
                            compute_dtype=jnp.bfloat16,
                            block_n: int = 512, block_v: int = 1024,
-                           implementation: str | None = None):
+                           implementation: str | None = None,
+                           mesh=None):
     """Shifted next-token CE via the Pallas fused-CE kernels
     (ops/fused_ce.py) — same semantics as ``fused_next_token_loss`` /
     ``next_token_loss`` but the (B, S, vocab) logits tensor never exists
-    even per-chunk: vocab tiles stream through VMEM."""
-    from distributed_tensorflow_tpu.ops.fused_ce import fused_cross_entropy
+    even per-chunk: vocab tiles stream through VMEM.
+
+    With a sharded ``mesh`` the kernels run per-shard under shard_map
+    (tokens over dcn/dp/fsdp/sp, vocab over tp with a cross-shard
+    logsumexp merge — ops/fused_ce.py sharded_fused_cross_entropy).
+    The next-token SHIFT happens here, outside the shard_map, so GSPMD
+    handles the sp-boundary halo exchange of the shifted targets."""
     B, S, D = hidden.shape
     targets, mask = _shifted_targets_and_mask(tokens)
+    if mesh is not None and mesh.size > 1:
+        from distributed_tensorflow_tpu.ops.fused_ce import (
+            sharded_fused_cross_entropy)
+        losses = sharded_fused_cross_entropy(
+            hidden.astype(compute_dtype), embed.astype(compute_dtype),
+            targets, mesh, block_n=block_n, block_v=block_v,
+            implementation=implementation)
+        return jnp.sum(losses * mask) / (B * (S - 1))
+    from distributed_tensorflow_tpu.ops.fused_ce import fused_cross_entropy
     losses = fused_cross_entropy(
         hidden.reshape(B * S, D).astype(compute_dtype),
         embed.astype(compute_dtype), targets.reshape(B * S),
@@ -467,23 +534,39 @@ def make_optimizer(cfg: TransformerConfig):
                        mu_dtype=cfg.adam_mu_dtype)
 
 
-def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
+def _find_adam_state(opt_state):
+    """Index of the ScaleByAdamState (count/mu/nu) in an optax chain
+    state tuple; raises if the transform isn't adam-shaped."""
+    for i, s in enumerate(opt_state):
+        if hasattr(s, "mu") and hasattr(s, "nu") and hasattr(s, "count"):
+            return i
+    raise ValueError(
+        "fused_optimizer=True needs an optax.adamw-style chain state "
+        f"(ScaleByAdamState not found in {type(opt_state)})")
+
+
+def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
+                    param_specs=None):
     """Functional (state, batch) -> (state, metrics) SPMD step. With MoE
     the per-layer load-balancing aux losses (flax "losses" collection)
-    are summed into the objective (≙ Switch Transformer training)."""
+    are summed into the objective (≙ Switch Transformer training).
+
+    ``param_specs`` (a pytree of PartitionSpecs matching params) lets
+    the fused optimizer run per-shard on sharded meshes."""
 
     if cfg.loss_impl not in ("scan", "kernel"):
         raise ValueError(f"loss_impl={cfg.loss_impl!r}; expected "
                          f"'scan' or 'kernel'")
-    # Pallas custom calls cannot be GSPMD-partitioned (same constraint
-    # as the attention kernel): the kernel CE path runs single-chip
-    # only; sharded meshes keep the scan path, whose einsums GSPMD
-    # partitions natively (incl. vocab-sharded tp embeddings).
-    # loss_impl="kernel" implies a FUSED loss in every case: on a
-    # sharded mesh it falls back to the scan path with a default chunk
-    # count rather than ever materializing full (B, S, vocab) logits.
-    use_kernel = (cfg.loss_impl == "kernel"
-                  and (cfg.mesh is None or cfg.mesh.size == 1))
+    # The kernel CE path runs everywhere: plain on a single chip,
+    # per-shard under shard_map on sharded meshes (tokens over
+    # dcn/dp/fsdp/sp, vocab over tp with a cross-shard logsumexp merge
+    # — ops/fused_ce.py sharded_fused_cross_entropy). Only meshes whose
+    # shard counts don't divide the batch/seq/vocab shapes fall back to
+    # the scan path, whose einsums GSPMD partitions natively.
+    # loss_impl="kernel" implies a FUSED loss in every case: the
+    # fallback uses the scan path with a default chunk count rather
+    # than ever materializing full (B, S, vocab) logits.
+    use_kernel = cfg.loss_impl == "kernel"
     fused = cfg.loss_chunks > 0 or cfg.loss_impl == "kernel"
     if cfg.loss_chunks > 0:
         scan_chunks = cfg.loss_chunks
@@ -496,11 +579,25 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
                and cfg.max_seq_len % (scan_chunks * 2) == 0):
             scan_chunks *= 2
 
+    def _kernel_mesh_ok(B, S):
+        mesh = cfg.mesh
+        if mesh is None or mesh.size == 1:
+            return True
+        n_batch = 1
+        for a in ("dcn", "dp", "fsdp"):
+            if a in mesh.shape:
+                n_batch *= mesh.shape[a]
+        sp = mesh.shape.get("sp", 1)
+        tp = mesh.shape.get("tp", 1)
+        return (B % n_batch == 0 and S % sp == 0
+                and cfg.vocab_size % tp == 0)
+
     def objective(out, params, tokens):
-        if use_kernel:
+        if use_kernel and _kernel_mesh_ok(*out.shape[:2]):
             return kernel_next_token_loss(
                 out, params["embed"], tokens, compute_dtype=cfg.dtype,
-                block_n=cfg.loss_block_n, block_v=cfg.loss_block_v)
+                block_n=cfg.loss_block_n, block_v=cfg.loss_block_v,
+                implementation=cfg.loss_kernel_impl, mesh=cfg.mesh)
         if fused:
             return fused_next_token_loss(
                 out, params["embed"], tokens,
@@ -518,12 +615,50 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx):
         out = model.apply({"params": params}, tokens, fused)
         return objective(out, params, tokens)
 
+    # The fused update needs per-shard execution on sharded meshes; with
+    # no param_specs on a >1 mesh the pallas call would run replicated
+    # (GSPMD can't partition it) — keep the optax path there.
+    use_fused_opt = cfg.fused_optimizer and (
+        cfg.mesh is None or cfg.mesh.size == 1 or param_specs is not None)
+
+    def fused_opt_step(state, grads):
+        from distributed_tensorflow_tpu.ops.fused_adamw import (
+            fused_adamw_update)
+        opt_state = state["opt_state"]
+        # The fused kernel REPLACES the whole optax chain with AdamW on
+        # cfg.learning_rate/weight_decay — a tx with extra stateful
+        # transforms would be silently skipped. Require the state
+        # structure to match make_optimizer(cfg) exactly so a custom tx
+        # (clipping, schedules, different chain) fails loudly here.
+        expected = jax.eval_shape(
+            lambda p: make_optimizer(cfg).init(p), state["params"])
+        if (jax.tree_util.tree_structure(expected)
+                != jax.tree_util.tree_structure(opt_state)):
+            raise ValueError(
+                "fused_optimizer=True supports exactly the "
+                "make_optimizer(cfg) adamw chain; the provided "
+                "optimizer's state structure differs — set "
+                "fused_optimizer=False or use make_optimizer(cfg)")
+        idx = _find_adam_state(opt_state)
+        adam = opt_state[idx]
+        params, mu, nu, count = fused_adamw_update(
+            state["params"], grads, adam.mu, adam.nu, adam.count,
+            lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+            implementation=cfg.optimizer_impl, mesh=cfg.mesh,
+            param_specs=param_specs)
+        new_adam = adam._replace(count=count, mu=mu, nu=nu)
+        return params, tuple(new_adam if i == idx else s
+                             for i, s in enumerate(opt_state))
+
     def train_step(state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"],
                                                   batch["tokens"])
-        updates, opt_state = tx.update(grads, state["opt_state"],
-                                       state["params"])
-        params = optax.apply_updates(state["params"], updates)
+        if use_fused_opt:
+            params, opt_state = fused_opt_step(state, grads)
+        else:
+            updates, opt_state = tx.update(grads, state["opt_state"],
+                                           state["params"])
+            params = optax.apply_updates(state["params"], updates)
         return ({"params": params, "opt_state": opt_state,
                  "step": state["step"] + 1},
                 {"loss": loss})
@@ -625,7 +760,13 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
         mesh, P(data_axes if data_axes else None, seq_axis))}
 
     rules = mesh_axis_rules(mesh)
-    step = (step_factory or make_train_step)(cfg, model, tx)
+    factory = step_factory or make_train_step
+    factory_kwargs = {}
+    import inspect
+    if "param_specs" in inspect.signature(factory).parameters:
+        factory_kwargs["param_specs"] = jax.tree_util.tree_map(
+            lambda ns: ns.spec, state_shardings["params"])
+    step = factory(cfg, model, tx, **factory_kwargs)
     with mesh, nn_partitioning.axis_rules(rules):
         state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
         step_jit = jax.jit(

@@ -454,47 +454,28 @@ def _de_call(h, emb, targets, lse, g, block_n, block_v, interpret):
 # custom-VJP op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_ce(hidden, embed, targets, block_n, block_v, interpret):
-    losses, _ = _fused_ce_fwd(hidden, embed, targets, block_n, block_v,
-                              interpret)
-    return losses
+def _bwd_dispatch(hidden, embed, targets, lse, g, *, block_n, block_v,
+                  interpret, variant, bwd_block_n, bwd_block_v):
+    """Pick and run the backward kernels for one row chunk.
 
-
-def _fused_ce_fwd(hidden, embed, targets, block_n, block_v, interpret):
-    lse, tl = _fwd_call(hidden, embed, targets, block_n, block_v,
-                        interpret)
-    return lse - tl, (hidden, embed, targets, lse)
-
-
-def _fused_ce_bwd(block_n, block_v, interpret, res, g):
-    hidden, embed, targets, lse = res
-    g = g.astype(jnp.float32)
+    Merged kernel: one logits recompute feeds both gradients (3
+    N·V·D matmuls, the scan path's cost, vs the split kernels' 4).
+    Variant "b" (dh in scratch, dE through the aliased buffer) has the
+    lower accumulation traffic when N/bn sweeps are few; variant "a"
+    (roles swapped) kept for sweeping; variant "split" forces the
+    race-free unmerged kernels. Backward tiles derive from the
+    caller's forward tiles (wider rows, narrower vocab — the fp32
+    accumulators dominate VMEM) unless overridden explicitly.
+    """
     if interpret:
         # The merged kernel accumulates dh through an input→output
         # ALIASED buffer — a compiled-mode memory property the
         # interpreter does not emulate (inputs there are functional
         # copies), so interpret mode runs the split kernels instead.
-        dh = _dh_call(hidden, embed, targets, lse, g, block_n, block_v,
-                      interpret)
-        de = _de_call(hidden, embed, targets, lse, g, block_n,
-                      min(block_v, 512), interpret)
-        return dh, de, None
-    # Merged kernel: one logits recompute feeds both gradients (3
-    # N·V·D matmuls, the scan path's cost, vs the split kernels' 4).
-    # Variant B (dh in scratch, dE through the aliased buffer) has the
-    # lower accumulation traffic when N/bn sweeps are few; variant A
-    # (roles swapped) kept for sweeping. Backward tiles derive from the
-    # caller's forward tiles (wider rows, narrower vocab — the fp32
-    # accumulators dominate VMEM); DTX_CE_BWD_BN/BV override for
-    # sweeps (read at trace time — changing them needs a retrace).
-    import os
-    variant = os.environ.get("DTX_CE_BWD", "b")
+        variant = "split"
     n, v = hidden.shape[0], embed.shape[0]
-    bn = min(int(os.environ.get("DTX_CE_BWD_BN", min(2 * block_n, 1024))),
-             n)
-    bv = min(int(os.environ.get("DTX_CE_BWD_BV",
-                                max(128, block_v // 4))), v)
+    bn = min(bwd_block_n if bwd_block_n else min(2 * block_n, 1024), n)
+    bv = min(bwd_block_v if bwd_block_v else max(128, block_v // 4), v)
     nb, vb = pl.cdiv(n, bn), pl.cdiv(v, bv)
     # The aliased accumulator block is re-read one sweep after its
     # write; with < 4 grid steps between them the write-back DMA may
@@ -502,16 +483,42 @@ def _fused_ce_bwd(block_n, block_v, interpret, res, g):
     # gap is nb steps, variant B's is vb — fall back to the split
     # kernels (no aliasing at all) when the margin is too thin.
     if variant == "a" and nb >= 4:
-        dh, de = _bwd_merged_call(hidden, embed, targets, lse, g,
+        return _bwd_merged_call(hidden, embed, targets, lse, g,
+                                bn, bv, interpret)
+    if variant == "b" and vb >= 4:
+        return _bwd_merged_b_call(hidden, embed, targets, lse, g,
                                   bn, bv, interpret)
-    elif variant != "a" and vb >= 4:
-        dh, de = _bwd_merged_b_call(hidden, embed, targets, lse, g,
-                                    bn, bv, interpret)
-    else:
-        dh = _dh_call(hidden, embed, targets, lse, g, block_n, block_v,
-                      interpret)
-        de = _de_call(hidden, embed, targets, lse, g, block_n,
-                      min(block_v, 512), interpret)
+    dh = _dh_call(hidden, embed, targets, lse, g, block_n, block_v,
+                  interpret)
+    de = _de_call(hidden, embed, targets, lse, g, block_n,
+                  min(block_v, 512), interpret)
+    return dh, de
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fused_ce(hidden, embed, targets, block_n, block_v, interpret,
+              variant, bwd_block_n, bwd_block_v):
+    losses, _ = _fused_ce_fwd(hidden, embed, targets, block_n, block_v,
+                              interpret, variant, bwd_block_n, bwd_block_v)
+    return losses
+
+
+def _fused_ce_fwd(hidden, embed, targets, block_n, block_v, interpret,
+                  variant, bwd_block_n, bwd_block_v):
+    lse, tl = _fwd_call(hidden, embed, targets, block_n, block_v,
+                        interpret)
+    return lse - tl, (hidden, embed, targets, lse)
+
+
+def _fused_ce_bwd(block_n, block_v, interpret, variant, bwd_block_n,
+                  bwd_block_v, res, g):
+    hidden, embed, targets, lse = res
+    g = g.astype(jnp.float32)
+    dh, de = _bwd_dispatch(hidden, embed, targets, lse, g,
+                           block_n=block_n, block_v=block_v,
+                           interpret=interpret, variant=variant,
+                           bwd_block_n=bwd_block_n,
+                           bwd_block_v=bwd_block_v)
     return dh, de, None
 
 
@@ -520,7 +527,10 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 def fused_cross_entropy(hidden, embed, targets, *,
                         block_n: int = 512, block_v: int = 1024,
-                        implementation: str | None = None):
+                        implementation: str | None = None,
+                        bwd_variant: str = "b",
+                        bwd_block_n: int | None = None,
+                        bwd_block_v: int | None = None):
     """Per-token CE losses of ``hidden @ embed.T`` against ``targets``
     without materializing the (N, V) logits.
 
@@ -530,6 +540,10 @@ def fused_cross_entropy(hidden, embed, targets, *,
 
     implementation: "pallas" | "reference" | "interpret" | None
     (auto: pallas on TPU, reference elsewhere).
+
+    bwd_variant: "b" | "a" | "split" — merged-backward flavor (see
+    ``_bwd_dispatch``); explicit kwargs, not env vars, so every process
+    in a multi-host job traces the same program.
     """
     if implementation is None:
         implementation = ("pallas" if jax.default_backend() == "tpu"
@@ -545,9 +559,179 @@ def fused_cross_entropy(hidden, embed, targets, *,
     row_chunk = 4096
     if n <= row_chunk or n % row_chunk:
         return _fused_ce(hidden, embed, targets, min(block_n, n),
-                         min(block_v, v), interp)
+                         min(block_v, v), interp, bwd_variant,
+                         bwd_block_n, bwd_block_v)
     return jnp.concatenate([
         _fused_ce(hidden[i:i + row_chunk], embed,
                   targets[i:i + row_chunk], block_n,
-                  min(block_v, v), interp)
+                  min(block_v, v), interp, bwd_variant,
+                  bwd_block_n, bwd_block_v)
         for i in range(0, n, row_chunk)])
+
+
+# ---------------------------------------------------------------------------
+# Sharded op: shard_map over token axes, two-pass merge over a tp vocab
+# ---------------------------------------------------------------------------
+
+def _local_targets(t, e_rows, vocab_axis):
+    """Map global target ids to this vocab shard's local row space; ids
+    owned by another shard become -1 (matches no column, so they add 0
+    to the local target-logit partial and the one-hot correction)."""
+    if vocab_axis is None:
+        return t
+    off = jax.lax.axis_index(vocab_axis) * e_rows
+    return jnp.where((t >= off) & (t < off + e_rows), t - off, -1)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _sharded_ce(hidden, embed, targets, mesh, batch_axes, seq_axis,
+                vocab_axis, block_n, block_v, interpret, variant,
+                bwd_blocks):
+    losses, _ = _sharded_ce_fwd(hidden, embed, targets, mesh, batch_axes,
+                                seq_axis, vocab_axis, block_n, block_v,
+                                interpret, variant, bwd_blocks)
+    return losses
+
+
+def _token_specs(batch_axes, seq_axis):
+    from jax.sharding import PartitionSpec as P
+    b = batch_axes if batch_axes else None
+    return P(b, seq_axis), P(b, seq_axis, None)
+
+
+def _sharded_ce_fwd(hidden, embed, targets, mesh, batch_axes, seq_axis,
+                    vocab_axis, block_n, block_v, interpret, variant,
+                    bwd_blocks):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    tspec, hspec = _token_specs(batch_axes, seq_axis)
+
+    def body(h, e, t):
+        bl, sl, d = h.shape
+        n = bl * sl
+        hf, tf = h.reshape(n, d), t.reshape(n)
+        tf = _local_targets(tf, e.shape[0], vocab_axis)
+        lse, tl = _fwd_call(hf, e, tf, min(block_n, n),
+                            min(block_v, e.shape[0]), interpret)
+        if vocab_axis is not None:
+            # Cross-shard logsumexp merge: each shard holds the online
+            # (running-max form) logsumexp of ITS vocab slice; combine
+            # exactly, then sum the (one-owner) target-logit partials.
+            m = jax.lax.pmax(lse, vocab_axis)
+            lse = m + jnp.log(jax.lax.psum(jnp.exp(lse - m), vocab_axis))
+            tl = jax.lax.psum(tl, vocab_axis)
+        return ((lse - tl).reshape(bl, sl), lse.reshape(bl, sl))
+
+    losses, lse = shard_map(
+        body, mesh=mesh,
+        in_specs=(hspec, P(vocab_axis, None), tspec),
+        out_specs=(tspec, tspec), check_vma=False)(hidden, embed, targets)
+    return losses, (hidden, embed, targets, lse)
+
+
+def _sharded_ce_bwd(mesh, batch_axes, seq_axis, vocab_axis, block_n,
+                    block_v, interpret, variant, bwd_blocks, res, g):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    hidden, embed, targets, lse = res
+    tspec, hspec = _token_specs(batch_axes, seq_axis)
+    token_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
+    bwd_block_n, bwd_block_v = bwd_blocks
+
+    def body(h, e, t, lse_l, g_l):
+        bl, sl, d = h.shape
+        n = bl * sl
+        hf, tf = h.reshape(n, d), t.reshape(n)
+        tf = _local_targets(tf, e.shape[0], vocab_axis)
+        row_chunk = 4096
+        step = row_chunk if (n > row_chunk and n % row_chunk == 0) else n
+        dhs, de = [], None
+        for i in range(0, n, step):
+            dh_c, de_c = _bwd_dispatch(
+                hf[i:i + step], e, tf[i:i + step],
+                lse_l.reshape(n)[i:i + step],
+                g_l.reshape(n)[i:i + step].astype(jnp.float32),
+                block_n=min(block_n, step), block_v=min(block_v, e.shape[0]),
+                interpret=interpret, variant=variant,
+                bwd_block_n=bwd_block_n, bwd_block_v=bwd_block_v)
+            dhs.append(dh_c)
+            de = de_c if de is None else de + de_c.astype(jnp.float32)
+        dh = jnp.concatenate(dhs) if len(dhs) > 1 else dhs[0]
+        if vocab_axis is not None:
+            # Each vocab shard produced dh from ITS vocab slice only.
+            dh = jax.lax.psum(dh.astype(jnp.float32), vocab_axis)
+        if token_axes:
+            # Each token shard produced dE from ITS tokens only.
+            de = jax.lax.psum(de.astype(jnp.float32), token_axes)
+        return (dh.astype(h.dtype).reshape(bl, sl, d),
+                de.astype(e.dtype))
+
+    dh, de = shard_map(
+        body, mesh=mesh,
+        in_specs=(hspec, P(vocab_axis, None), tspec, tspec, tspec),
+        out_specs=(hspec, P(vocab_axis, None)), check_vma=False)(
+            hidden, embed, targets, lse, g)
+    return dh, de, None
+
+
+_sharded_ce.defvjp(_sharded_ce_fwd, _sharded_ce_bwd)
+
+
+def sharded_fused_cross_entropy(hidden, embed, targets, mesh, *,
+                                block_n: int = 512, block_v: int = 1024,
+                                implementation: str | None = None,
+                                bwd_variant: str = "b",
+                                bwd_block_n: int | None = None,
+                                bwd_block_v: int | None = None):
+    """``fused_cross_entropy`` for sharded meshes: the kernels run
+    per-shard under ``shard_map`` (Pallas custom calls cannot be GSPMD-
+    partitioned — same constraint as ops/attention.py
+    ``sharded_flash_attention``), with tokens sharded over the mesh's
+    data axes (dcn/dp/fsdp) and the sequence axis (sp), and the vocab
+    either replicated or sharded over tp.
+
+    Layouts and collectives (all forward-only, inside custom_vjp):
+    - dp/fsdp/sp: embarrassingly parallel over tokens; the backward
+      psums dE over the token axes (each shard saw only its tokens).
+    - tp (vocab-sharded embedding): two-pass merge — each shard's
+      forward kernel produces the logsumexp of its vocab slice and a
+      target-logit partial; an exact ``pmax``/``psum`` combine yields
+      the global row logsumexp, which the backward feeds to each
+      shard's probability recompute, psumming dh over tp.
+
+    hidden: (B, S, D) global array; targets: (B, S) int; returns (B, S)
+    fp32 losses. ≙ the reference's fused softmax-CE partitioning under
+    every strategy (TF/python/ops/nn_ops.py
+    softmax_cross_entropy_with_logits — a fused XLA reduction GSPMD
+    partitions like any HLO; here the partitioning is explicit because
+    the op is a Mosaic custom call).
+    """
+    if implementation is None:
+        implementation = ("pallas" if jax.default_backend() == "tpu"
+                          else "reference")
+    if implementation == "reference":
+        B, S, D = hidden.shape
+        return ce_reference(hidden.reshape(B * S, D), embed,
+                            targets.reshape(B * S)).reshape(B, S)
+
+    def axis_used(a):
+        return a in mesh.shape and mesh.shape[a] > 1
+
+    batch_axes = tuple(a for a in ("dcn", "dp", "fsdp") if axis_used(a))
+    seq_axis = "sp" if axis_used("sp") else None
+    vocab_axis = "tp" if axis_used("tp") else None
+    B, S, _ = hidden.shape
+    n_batch = 1
+    for a in batch_axes:
+        n_batch *= mesh.shape[a]
+    if (B % n_batch or (seq_axis and S % mesh.shape[seq_axis])
+            or (vocab_axis and embed.shape[0] % mesh.shape[vocab_axis])):
+        raise ValueError(
+            f"sharded_fused_cross_entropy: shapes B={B}, S={S}, "
+            f"V={embed.shape[0]} not divisible by mesh shards "
+            f"{dict(mesh.shape)}")
+    return _sharded_ce(hidden, embed, targets, mesh, batch_axes, seq_axis,
+                       vocab_axis, block_n, block_v,
+                       implementation == "interpret", bwd_variant,
+                       (bwd_block_n, bwd_block_v))

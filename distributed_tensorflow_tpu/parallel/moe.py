@@ -42,6 +42,11 @@ class MoEConfig:
     top_k: int = 1
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.float32
+    # Mesh for the expert-sharding constraints: this flax/jax pairing
+    # only honors logical constraints when the mesh is passed explicitly
+    # (``with mesh:`` does not set the abstract-mesh context flax
+    # checks) — see models/transformer.py with_sharding_constraint.
+    mesh: Any = None
 
 
 class MoELayer(nn.Module):
@@ -108,12 +113,12 @@ class MoELayer(nn.Module):
         # ep-sharded; GSPMD inserts the all-to-all over ICI.
         expert_in = jnp.einsum("td,tec->ecd", tokens, dispatch)
         expert_in = nn_partitioning.with_sharding_constraint(
-            expert_in, ("expert", None, None))
+            expert_in, ("expert", None, None), mesh=cfg.mesh)
         h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(x.dtype))
         h = nn.gelu(h)
         expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(x.dtype))
         expert_out = nn_partitioning.with_sharding_constraint(
-            expert_out, ("expert", None, None))
+            expert_out, ("expert", None, None), mesh=cfg.mesh)
 
         # Combine back to token order, weighted by gates.
         out = jnp.einsum("ecd,tec->td", expert_out,

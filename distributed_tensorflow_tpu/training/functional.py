@@ -1,0 +1,222 @@
+"""Keras functional API shim: symbolic tensors + DAG models.
+
+≙ TFK/src/engine/functional.py:84 ``Functional`` — ``keras.Input``
+returns a symbolic tensor, calling a shim layer on symbolic tensors
+records a graph node (≙ KerasTensor + Node, TFK/src/engine/node.py),
+and ``keras.Model(inputs, outputs)`` compiles the recorded DAG into one
+flax module running on the SPMD training loop (training/model.py). The
+surface that reference functional scripts need: residual adds, layer
+REUSE (same layer instance called twice shares weights, like Keras),
+multi-input models, nested layer call arguments.
+
+Weight layout stays keras-shaped per layer (training/layers.py), and
+layer naming follows keras's class-based auto-naming ("conv2d",
+"conv2d_1", …) in graph order so per-layer weight mapping against a
+real tf_keras Functional model is mechanical.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.training.model import Model as _TrainModel
+
+
+class SymbolicTensor:
+    """A node in the functional graph (≙ KerasTensor). ``layer`` is None
+    for graph inputs; ``call_args`` preserves the structure the layer
+    was called with (a single tensor, a list, ...)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, *, shape=None, dtype="float32", layer=None,
+                 call_args=None, name=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.layer = layer
+        self.call_args = call_args
+        self.name = name
+        self.uid = next(self._ids)
+
+    def __repr__(self):
+        src = "Input" if self.layer is None else type(self.layer).__name__
+        return f"<SymbolicTensor {self.uid} from {src}>"
+
+
+def Input(shape=None, *, dtype="float32", name=None, batch_size=None):
+    """≙ keras.Input: a symbolic tensor with per-sample ``shape``.
+    Also accepted as the first entry of a ``Sequential`` layer list
+    (converted to an InputLayer there, like tf_keras)."""
+    if shape is None:
+        raise ValueError("Input() requires shape")
+    return SymbolicTensor(shape=tuple(shape), dtype=dtype, name=name)
+
+
+def _sym_leaves(args):
+    return [x for x in jax.tree_util.tree_leaves(args)
+            if isinstance(x, SymbolicTensor)]
+
+
+def is_symbolic(args) -> bool:
+    return bool(_sym_leaves(args))
+
+
+def symbolic_call(layer, args) -> SymbolicTensor:
+    """Record layer(args) as a graph node (called by Layer.__call__)."""
+    return SymbolicTensor(layer=layer, call_args=args)
+
+
+def _keras_auto_name(layer) -> str:
+    """keras-style base name: CamelCase class -> snake_case."""
+    explicit = getattr(layer, "name", None)
+    if explicit:
+        return explicit
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", type(layer).__name__).lower()
+
+
+class _LayerModule(nn.Module):
+    """One shim layer as a flax submodule; calling the SAME instance
+    twice replays the compact body on the same scope, so parameters are
+    shared — the keras layer-reuse semantics."""
+    layer: Any
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        return self.layer.apply(x, train=self.train, module=self)
+
+
+class _FunctionalModule(nn.Module):
+    """Evaluate the recorded DAG. ``nodes`` is the topological order
+    (inputs excluded); ``layer_names`` maps layer id -> submodule name
+    (stable, keras-style, assigned at graph-build time)."""
+    input_nodes: tuple
+    nodes: tuple
+    output_nodes: tuple
+    layer_names: Any        # dict id(layer) -> name (static)
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        xs = x if isinstance(x, (list, tuple)) else (x,)
+        if len(xs) != len(self.input_nodes):
+            raise ValueError(
+                f"model expects {len(self.input_nodes)} inputs, "
+                f"got {len(xs)}")
+        memo = {inp.uid: v for inp, v in zip(self.input_nodes, xs)}
+        mods = {}
+        for node in self.nodes:
+            key = id(node.layer)
+            if key not in mods:
+                mods[key] = _LayerModule(layer=node.layer,
+                                         train=self.train,
+                                         name=self.layer_names[key])
+            args = jax.tree_util.tree_map(
+                lambda s: memo[s.uid] if isinstance(s, SymbolicTensor)
+                else s,
+                node.call_args,
+                is_leaf=lambda s: isinstance(s, SymbolicTensor))
+            memo[node.uid] = mods[key](args)
+        outs = [memo[o.uid] for o in self.output_nodes]
+        return outs[0] if len(self.output_nodes) == 1 else tuple(outs)
+
+
+def _toposort(inputs: Sequence[SymbolicTensor],
+              outputs: Sequence[SymbolicTensor]):
+    """DFS topological order of layer nodes from outputs back to the
+    declared inputs; raises on graph tensors not reachable from
+    ``inputs`` (the keras 'disconnected graph' error)."""
+    input_ids = {i.uid for i in inputs}
+    order, seen, visiting = [], set(), set()
+
+    def visit(node):
+        if node.uid in seen:
+            return
+        if node.uid in input_ids:
+            seen.add(node.uid)
+            return
+        if node.layer is None:
+            raise ValueError(
+                f"Graph disconnected: {node!r} is an Input not listed "
+                f"in Model(inputs=...)")
+        if node.uid in visiting:
+            raise ValueError("Cycle in functional graph")
+        visiting.add(node.uid)
+        for dep in _sym_leaves(node.call_args):
+            visit(dep)
+        visiting.discard(node.uid)
+        seen.add(node.uid)
+        order.append(node)
+
+    for out in outputs:
+        visit(out)
+    return tuple(order)
+
+
+class Model(_TrainModel):
+    """≙ keras.Model: ``Model(inputs=sym, outputs=sym)`` builds a
+    Functional model over the recorded DAG; any other construction
+    defers to the module-based training Model (so subclass-style usage
+    keeps working)."""
+
+    def __init__(self, *args, inputs=None, outputs=None, **kwargs):
+        if inputs is None and args and is_symbolic(args[0]):
+            inputs, args = args[0], args[1:]
+            if outputs is None and args:
+                outputs, args = args[0], args[1:]
+        if inputs is None:
+            super().__init__(*args, **kwargs)
+            return
+        if outputs is None:
+            raise ValueError("Model(inputs=...) requires outputs=")
+        self._functional_init(inputs, outputs,
+                              seed=kwargs.pop("seed", 0),
+                              name=kwargs.pop("name", None))
+
+    def _functional_init(self, inputs, outputs, *, seed=0, name=None):
+        self.inputs = list(inputs) if isinstance(
+            inputs, (list, tuple)) else [inputs]
+        self.outputs = list(outputs) if isinstance(
+            outputs, (list, tuple)) else [outputs]
+        for i in self.inputs:
+            if not (isinstance(i, SymbolicTensor) and i.layer is None):
+                raise TypeError(
+                    "Model(inputs=...) expects keras.Input tensors, got "
+                    f"{i!r}")
+        nodes = _toposort(self.inputs, self.outputs)
+
+        # keras-style stable names in graph order; one name per layer
+        # INSTANCE (reused layers keep one name = one parameter set).
+        counters, names = {}, {}
+        for node in nodes:
+            key = id(node.layer)
+            if key in names:
+                continue
+            base = _keras_auto_name(node.layer)
+            n = counters.get(base, 0)
+            counters[base] = n + 1
+            names[key] = base if n == 0 else f"{base}_{n}"
+
+        self._graph_nodes = nodes
+        self.layers = []
+        seen_layers = set()
+        for node in nodes:
+            if id(node.layer) not in seen_layers:
+                seen_layers.add(id(node.layer))
+                self.layers.append(node.layer)
+        mk = lambda train: _FunctionalModule(
+            input_nodes=tuple(self.inputs), nodes=nodes,
+            output_nodes=tuple(self.outputs), layer_names=names,
+            train=train)
+        super().__init__(mk(True), eval_module=mk(False), seed=seed)
+        self.name = name
+        if all(i.shape is not None for i in self.inputs):
+            sample = [jnp.zeros((1, *i.shape),
+                                jnp.dtype(i.dtype)) for i in self.inputs]
+            self.build(sample[0] if len(sample) == 1 else tuple(sample))

@@ -58,9 +58,14 @@ def _pair(v):
 
 class Layer:
     """Base shim layer: a configuration object whose ``apply`` runs
-    inside the Sequential flax module's compact scope (so flax handles
+    inside the owning flax module's compact scope (so flax handles
     parameter creation/naming). ``module`` is the enclosing flax module
-    (for layers that need rngs, e.g. Dropout)."""
+    (for layers that need rngs, e.g. Dropout).
+
+    Calling a layer on symbolic tensors (``keras.Input`` outputs)
+    records a functional-graph node (training/functional.py) — the
+    keras functional API. Multi-arg calls (``mha(q, v)``) record the
+    args tuple; ``apply`` receives the same structure back."""
 
     #: set on layers like Dropout/BatchNormalization that behave
     #: differently in training
@@ -69,27 +74,37 @@ class Layer:
     def apply(self, x, *, train: bool, module=None):
         raise NotImplementedError
 
+    def __call__(self, *args):
+        from distributed_tensorflow_tpu.training.functional import (
+            is_symbolic, symbolic_call)
+        call_args = args[0] if len(args) == 1 else tuple(args)
+        if is_symbolic(call_args):
+            return symbolic_call(self, call_args)
+        raise TypeError(
+            f"{type(self).__name__} called on concrete values; shim "
+            "layers are callable only on symbolic tensors (keras.Input) "
+            "to build functional models — for eager use put the layer "
+            "in a Sequential/Model and call that")
+
     def compute_input_shape(self):
         """(sample-less) input shape if the layer pins one, else None."""
         return getattr(self, "input_shape", None)
 
 
-@dataclasses.dataclass
-class Input(Layer):
-    """≙ keras.Input / InputLayer — records the per-sample input shape
-    so Sequential can build eagerly."""
-    shape: Sequence[int]
+class InputLayer(Layer):
+    """≙ keras.layers.InputLayer — records the per-sample input shape
+    so Sequential can build eagerly. (``keras.Input`` itself is the
+    functional-API symbolic-tensor factory; Sequential converts it to
+    this layer, as tf_keras does.)"""
 
-    def __post_init__(self):
-        self.input_shape = tuple(self.shape)
+    def __init__(self, input_shape=None, *, shape=None):
+        shape = shape if shape is not None else input_shape
+        if shape is None:
+            raise ValueError("InputLayer requires a shape")
+        self.input_shape = tuple(shape)
 
     def apply(self, x, *, train, module=None):
         return x
-
-
-class InputLayer(Input):
-    def __init__(self, input_shape):
-        super().__init__(shape=input_shape)
 
 
 class Dense(Layer):
@@ -230,6 +245,108 @@ class Activation(Layer):
         return self.activation(x)
 
 
+class Add(Layer):
+    """≙ keras.layers.Add — residual merges in functional graphs."""
+
+    def apply(self, x, *, train, module=None):
+        if not isinstance(x, (list, tuple)) or len(x) < 2:
+            raise ValueError("Add expects a list of >= 2 tensors")
+        out = x[0]
+        for t in x[1:]:
+            out = out + t
+        return out
+
+
+class Multiply(Layer):
+    def apply(self, x, *, train, module=None):
+        if not isinstance(x, (list, tuple)) or len(x) < 2:
+            raise ValueError("Multiply expects a list of >= 2 tensors")
+        out = x[0]
+        for t in x[1:]:
+            out = out * t
+        return out
+
+
+class Concatenate(Layer):
+    def __init__(self, axis: int = -1):
+        self.axis = axis
+
+    def apply(self, x, *, train, module=None):
+        if not isinstance(x, (list, tuple)) or len(x) < 2:
+            raise ValueError("Concatenate expects a list of >= 2 tensors")
+        return jnp.concatenate(list(x), axis=self.axis)
+
+
+class ZeroPadding2D(Layer):
+    """≙ keras.layers.ZeroPadding2D (NHWC)."""
+
+    def __init__(self, padding=1):
+        if isinstance(padding, int):
+            pads = ((padding, padding), (padding, padding))
+        else:
+            pads = tuple(_pair(p) for p in padding)
+        self.padding = pads
+
+    def apply(self, x, *, train, module=None):
+        (t, b), (l, r) = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)))
+
+
+class Reshape(Layer):
+    def __init__(self, target_shape):
+        self.target_shape = tuple(target_shape)
+
+    def apply(self, x, *, train, module=None):
+        return x.reshape((x.shape[0], *self.target_shape))
+
+
+class MultiHeadAttention(Layer):
+    """≙ keras.layers.MultiHeadAttention with the KERAS weight layout
+    (query/key/value kernels (D_in, heads, key_dim), output kernel
+    (heads, key_dim, D_out)) so weights map 1:1 onto a real tf_keras
+    MHA (TFK/src/layers/attention/multi_head_attention.py). Call:
+    ``mha(query, value)`` or ``mha(query, value, key)``."""
+    has_train_behavior = True
+
+    def __init__(self, num_heads: int, key_dim: int, dropout: float = 0.0,
+                 use_bias: bool = True, output_shape=None,
+                 name: str | None = None):
+        self.num_heads = int(num_heads)
+        self.key_dim = int(key_dim)
+        self.dropout = float(dropout)
+        self.use_bias = use_bias
+        self.output_shape = output_shape
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        if isinstance(x, (list, tuple)):
+            q, v = x[0], x[1]
+            k = x[2] if len(x) > 2 else v
+        else:                       # self-attention on one tensor
+            q = v = k = x
+        H, hd = self.num_heads, self.key_dim
+        out_dim = self.output_shape or q.shape[-1]
+
+        def heads_proj(name):
+            return nn.DenseGeneral(features=(H, hd), axis=-1,
+                                   use_bias=self.use_bias, name=name)
+
+        qh = heads_proj("query")(q)                 # (B, S, H, hd)
+        kh = heads_proj("key")(k)
+        vh = heads_proj("value")(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / jnp.sqrt(
+            jnp.asarray(hd, qh.dtype))
+        probs = nn.softmax(scores, axis=-1)
+        if train and self.dropout > 0.0:
+            rng = module.make_rng("dropout")
+            probs = nn.Dropout(self.dropout, deterministic=False)(
+                probs, rng=rng)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+        return nn.DenseGeneral(features=out_dim, axis=(-2, -1),
+                               use_bias=self.use_bias,
+                               name="attention_output")(o)
+
+
 class _SequentialModule(nn.Module):
     """One flax module applying the shim layers in order."""
     layer_stack: tuple
@@ -249,15 +366,30 @@ class Sequential(Model):
     ``input_shape=`` kwarg), otherwise lazily on the first fit/call.
     """
 
+    @staticmethod
+    def _as_layer(lyr):
+        """Accept keras.Input symbolic tensors in the layer list (the
+        tf_keras Sequential convention) by converting them to
+        InputLayer; everything else must be a shim Layer."""
+        from distributed_tensorflow_tpu.training.functional import (
+            SymbolicTensor)
+        if isinstance(lyr, SymbolicTensor):
+            if lyr.layer is not None:
+                raise TypeError(
+                    "Sequential only accepts keras.Input symbolic "
+                    "tensors, not intermediate graph tensors — use "
+                    "keras.Model(inputs, outputs) for functional graphs")
+            return InputLayer(lyr.shape)
+        if not isinstance(lyr, Layer):
+            raise TypeError(
+                f"Sequential expects shim layers "
+                f"(distributed_tensorflow_tpu.keras.layers), got "
+                f"{type(lyr).__name__}")
+        return lyr
+
     def __init__(self, layers: Sequence[Layer] | None = None, *,
                  seed: int = 0):
-        stack = tuple(layers or ())
-        for lyr in stack:
-            if not isinstance(lyr, Layer):
-                raise TypeError(
-                    f"Sequential expects shim layers "
-                    f"(distributed_tensorflow_tpu.keras.layers), got "
-                    f"{type(lyr).__name__}")
+        stack = tuple(self._as_layer(lyr) for lyr in (layers or ()))
         super().__init__(
             _SequentialModule(layer_stack=stack, train=True),
             eval_module=_SequentialModule(layer_stack=stack, train=False),
@@ -270,14 +402,18 @@ class Sequential(Model):
 
     def add(self, layer: Layer):
         """≙ keras Sequential.add: incremental construction. Adding to
-        an already-built stack re-initializes the parameters (the keras
-        incremental-build pattern adds layers BEFORE training, so fresh
-        init is indistinguishable there)."""
-        if not isinstance(layer, Layer):
-            raise TypeError(
-                f"Sequential expects shim layers "
-                f"(distributed_tensorflow_tpu.keras.layers), got "
-                f"{type(layer).__name__}")
+        an already-built stack re-initializes ALL parameters (tf_keras
+        preserves existing weights); warn loudly so a migrated script
+        that adds layers after fit() cannot silently lose training."""
+        layer = self._as_layer(layer)
+        if self._built and self._state is not None:
+            import warnings
+            warnings.warn(
+                "Sequential.add() after the model was built "
+                "re-initializes ALL parameters in this framework "
+                "(tf_keras would keep the existing weights); add every "
+                "layer before training, or rebuild and reload weights",
+                UserWarning, stacklevel=2)
         self.layers.append(layer)
         stack = tuple(self.layers)
         self.module = _SequentialModule(layer_stack=stack, train=True)
@@ -289,3 +425,9 @@ class Sequential(Model):
                       if lyr.compute_input_shape()), None)
         if shape is not None:
             self.build(jnp.zeros((1, *shape), jnp.float32))
+
+
+# keras.layers.Input is the same symbolic-tensor factory as keras.Input
+# (tf_keras exposes it in both places); imported at the bottom because
+# functional.py is import-independent of this module (no cycle).
+from distributed_tensorflow_tpu.training.functional import Input  # noqa: E402,F401
