@@ -40,11 +40,14 @@ def param_count(params):
 
 def step_flops(cfg, batch: int, n_params: int) -> float:
     """Model FLOPs per train step: 6*N per token (fwd+bwd matmuls) +
-    the causal attention term. Single source of truth — tools/ce_ab.py
-    imports this so A/B MFU numbers stay comparable to the headline."""
+    the attention term (halved only under CAUSAL masking — BERT-style
+    bidirectional encoders compute the full S^2). Single source of
+    truth — tools/ce_ab.py imports this so A/B MFU numbers stay
+    comparable to the headline."""
     tokens_per_step = batch * cfg.max_seq_len
+    causal_factor = 0.5 if getattr(cfg, "causal", True) else 1.0
     attn = (cfg.n_layers * 12 * batch * cfg.max_seq_len ** 2
-            * cfg.d_model * 0.5)
+            * cfg.d_model * causal_factor)
     return 6 * n_params * tokens_per_step + attn
 
 
@@ -128,6 +131,109 @@ def ce_grad_parity_smoke() -> str:
         return "ok"
     except Exception as e:                      # noqa: BLE001
         return f"{type(e).__name__}: {str(e)[:200]}"
+
+
+def _timed_loop(step, state, batch, n_iters, reps):
+    """Shared fori-loop delta timing (see module docstring): identical
+    methodology for every workload so README rows are comparable."""
+    @functools.partial(jax.jit, static_argnums=2)
+    def loop(state, batch, n):
+        def body(_, s):
+            s2, _metrics = step(s, batch)
+            return s2
+        return jax.lax.fori_loop(0, n, body, state)
+
+    def timed(n):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = loop(state, batch, n)
+            float(out["step"])        # scalar readback = true completion
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    jax.block_until_ready(loop(state, batch, 1))
+    jax.block_until_ready(loop(state, batch, 1 + n_iters))
+    return (timed(1 + n_iters) - timed(1)) / n_iters
+
+
+def run_resnet50():
+    """BASELINE.md config #2: ResNet-50 ImageNet-shape train-step
+    throughput (images/sec), single chip, bf16, batch 128 @ 224x224."""
+    from distributed_tensorflow_tpu.models import resnet
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = resnet.ResNetConfig.resnet50()
+        batch, size, n_iters, reps = 128, 224, 8, 4
+    else:
+        cfg = resnet.ResNetConfig.tiny()
+        batch, size, n_iters, reps = 8, 32, 3, 2
+    model = resnet.ResNet(cfg)
+    tx = resnet.make_optimizer(cfg)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    @jax.jit
+    def init_fn(rng):
+        variables = model.init(rng, images)
+        return {"params": variables["params"],
+                "batch_stats": variables["batch_stats"],
+                "opt_state": tx.init(variables["params"]),
+                "step": jnp.zeros((), jnp.int32)}
+
+    state = jax.block_until_ready(init_fn(rng))
+    step = resnet.make_train_step(cfg, model, tx)
+    dt = _timed_loop(step, state, {"image": images, "label": labels},
+                     n_iters, reps)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(batch / dt, 1), "unit": "images/s",
+        "vs_baseline": None,
+        "extra": {"backend": backend, "global_batch": batch,
+                  "image_size": size,
+                  "step_time_ms": round(dt * 1e3, 2)}}))
+
+
+def run_bert():
+    """BASELINE.md config #3: BERT-base MLM train-step throughput
+    (sequences/sec), single chip, bf16, batch 32 @ seq 512."""
+    from distributed_tensorflow_tpu.models import bert
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = bert.bert_config(loss_chunks=8)
+        batch, n_iters, reps = 32, 10, 4
+    else:
+        cfg = bert.tiny_bert_config()
+        batch, n_iters, reps = 8, 3, 2
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    batch_tokens = bert.synthetic_corpus(batch, cfg.max_seq_len,
+                                         cfg.vocab_size)
+
+    @jax.jit
+    def init_fn(rng):
+        params = model.init(rng, batch_tokens["tokens"])["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    state = jax.block_until_ready(init_fn(jax.random.PRNGKey(0)))
+    step = bert.make_train_step(cfg, model, tx)
+    dt = _timed_loop(step, state, batch_tokens, n_iters, reps)
+    n_params = param_count(state["params"])
+    flops = step_flops(cfg, batch, n_params)
+    mfu = (flops / dt) / (PEAK_TFLOPS.get(backend, 1.0) * 1e12)
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_seqs_per_sec",
+        "value": round(batch / dt, 1), "unit": "seqs/s",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "extra": {"backend": backend, "global_batch": batch,
+                  "seq_len": cfg.max_seq_len, "mfu": round(mfu, 4),
+                  "step_time_ms": round(dt * 1e3, 2)}}))
 
 
 def main():
@@ -225,4 +331,17 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--workload", default="transformer",
+                        choices=["transformer", "resnet50", "bert"],
+                        help="transformer = the driver headline; "
+                             "resnet50/bert fill BASELINE.md's per-config "
+                             "rows with the same timing methodology")
+    args = parser.parse_args()
+    if args.workload == "resnet50":
+        run_resnet50()
+    elif args.workload == "bert":
+        run_bert()
+    else:
+        main()

@@ -524,18 +524,34 @@ class Dataset:
                 arrs = [np.asarray(a) for a in leaves]
                 if arrs[0].ndim == 0:
                     return np.stack(arrs)
+                ndim = arrs[0].ndim
+                if any(a.ndim != ndim for a in arrs):
+                    raise ValueError(
+                        "bucket_by_sequence_length: elements of one "
+                        "bucket differ in rank")
                 if pad_to_bucket_boundary:
                     if bucket_idx >= len(boundaries):
                         raise ValueError(
                             "pad_to_bucket_boundary needs a final "
                             "boundary covering the longest element")
-                    target = boundaries[bucket_idx] - 1
+                    bound = boundaries[bucket_idx] - 1
+                    # tf.data pads every UNKNOWN (varying) dim to
+                    # boundary-1 in this mode; statically-equal dims
+                    # keep their size (grouping.py padded_batch with
+                    # the None dims of the element spec).
+                    targets = [bound] + [
+                        (arrs[0].shape[d]
+                         if all(a.shape[d] == arrs[0].shape[d]
+                                for a in arrs) else bound)
+                        for d in range(1, ndim)]
                 else:
-                    target = max(a.shape[0] for a in arrs)
+                    # pad EVERY dim to the batch max, not just the
+                    # leading axis — e.g. (T, feat) with varying feat.
+                    targets = [max(a.shape[d] for a in arrs)
+                               for d in range(ndim)]
                 out = []
                 for a in arrs:
-                    pad = [(0, target - a.shape[0])] + \
-                        [(0, 0)] * (a.ndim - 1)
+                    pad = [(0, t - s) for t, s in zip(targets, a.shape)]
                     out.append(np.pad(a, pad))
                 return np.stack(out)
             return jax.tree_util.tree_map(pad_leaf, *elements)

@@ -68,12 +68,58 @@ class SparseFeature:
 
 @dataclasses.dataclass(frozen=True)
 class RaggedFeature:
-    """≙ tf.io.RaggedFeature (basic value-only form): variable-length
-    values parsed to a 1-D array per example — the host-side stand-in
-    for RaggedTensor (the embedding layer's combiners consume ragged
-    rows directly)."""
+    """≙ tf.io.RaggedFeature. The value-only form parses to a 1-D array
+    per example; with ``partitions`` (outermost first, the tf.io inner
+    classes below — TF/python/ops/parsing_config.py RaggedFeature) it
+    parses to a :class:`RaggedValue` carrying the nested row-splits,
+    matching ``tf.RaggedTensor.from_nested_row_splits`` semantics."""
     dtype: Any = np.float32
     value_key: str | None = None
+    partitions: tuple = ()
+    row_splits_dtype: Any = np.int64
+
+    @dataclasses.dataclass(frozen=True)
+    class RowLengths:
+        key: str
+
+    @dataclasses.dataclass(frozen=True)
+    class RowSplits:
+        key: str
+
+    @dataclasses.dataclass(frozen=True)
+    class RowStarts:
+        key: str
+
+    @dataclasses.dataclass(frozen=True)
+    class RowLimits:
+        key: str
+
+    @dataclasses.dataclass(frozen=True)
+    class ValueRowIds:
+        key: str
+
+    @dataclasses.dataclass(frozen=True)
+    class UniformRowLength:
+        length: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RaggedValue:
+    """Host-side ragged tensor: flat ``values`` + ``nested_row_splits``
+    (outermost first) — ≙ tf.RaggedTensor.from_nested_row_splits."""
+    values: np.ndarray
+    nested_row_splits: tuple
+
+    def to_list(self):
+        def build(level, lo, hi):
+            if level == len(self.nested_row_splits):
+                return self.values[lo:hi].tolist()
+            splits = self.nested_row_splits[level]
+            return [build(level + 1, int(splits[i]), int(splits[i + 1]))
+                    for i in range(lo, hi)]
+        outer = self.nested_row_splits[0]
+        return [build(1, int(outer[i]), int(outer[i + 1]))
+                for i in range(len(outer) - 1)]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,6 +287,70 @@ def _ragged_from_raw(spec, value):
         if not isinstance(value, list) else value
 
 
+def _partition_splits(name, part, raw, n_next, splits_dtype):
+    """Row splits for ONE ragged partition level over ``n_next`` inner
+    items (≙ each RaggedFeature partition class's semantics in
+    TF/python/ops/parsing_ops.py _parse_ragged_feature)."""
+    RF = RaggedFeature
+    if isinstance(part, RF.UniformRowLength):
+        L = int(part.length)
+        if L <= 0:
+            raise ValueError(
+                f"RaggedFeature {name!r}: UniformRowLength must be "
+                f"positive, got {L}")
+        if n_next % L:
+            raise ValueError(
+                f"RaggedFeature {name!r}: {n_next} inner items do not "
+                f"divide into uniform rows of length {L}")
+        return np.arange(0, n_next + 1, L, dtype=splits_dtype)
+    key = np.asarray(raw.get(part.key, []), np.int64)
+    if isinstance(part, RF.RowLengths):
+        splits = np.concatenate([[0], np.cumsum(key)])
+    elif isinstance(part, RF.RowSplits):
+        splits = key
+    elif isinstance(part, RF.RowStarts):
+        splits = np.concatenate([key, [n_next]])
+    elif isinstance(part, RF.RowLimits):
+        splits = np.concatenate([[0], key])
+    elif isinstance(part, RF.ValueRowIds):
+        nrows = int(key.max()) + 1 if key.size else 0
+        if key.size and (np.any(np.diff(key) < 0) or key.min() < 0):
+            raise ValueError(
+                f"RaggedFeature {name!r}: ValueRowIds feature "
+                f"{part.key!r} must be nonnegative and nondecreasing")
+        splits = np.concatenate(
+            [[0], np.cumsum(np.bincount(key, minlength=nrows))])
+    else:
+        raise TypeError(
+            f"RaggedFeature {name!r}: unsupported partition "
+            f"{type(part).__name__}")
+    splits = np.asarray(splits, splits_dtype)
+    if (splits.size == 0 or splits[0] != 0
+            or np.any(np.diff(splits) < 0) or splits[-1] != n_next):
+        raise ValueError(
+            f"RaggedFeature {name!r}: partition "
+            f"{type(part).__name__} yields invalid row_splits "
+            f"{splits.tolist()} over {n_next} inner items")
+    return splits
+
+
+def _ragged_with_partitions(name, spec, raw):
+    """RaggedValue from values + partition features, innermost level
+    partitioning the flat values (≙ tf.io.RaggedFeature parsing with
+    ``partitions``; output matches
+    tf.RaggedTensor.from_nested_row_splits)."""
+    values = _ragged_from_raw(spec, raw.get(spec.value_key or name))
+    values = np.asarray(values, spec.dtype)
+    nested = []
+    n_next = values.size
+    for part in reversed(spec.partitions):
+        splits = _partition_splits(name, part, raw, n_next,
+                                   spec.row_splits_dtype)
+        nested.append(splits)
+        n_next = splits.size - 1
+    return RaggedValue(values, tuple(reversed(nested)))
+
+
 def parse_single_example(serialized: bytes, features: dict) -> dict:
     """Parse ONE serialized tf.train.Example against a feature spec
     (≙ tf.io.parse_single_example). Specs: FixedLenFeature,
@@ -275,6 +385,8 @@ def _resolve_example_spec(name, spec, raw: dict):
             idx, vals = idx[order], vals[order]
         return SparseValue(idx, vals, (spec.size,))
     if isinstance(spec, RaggedFeature):
+        if spec.partitions:
+            return _ragged_with_partitions(name, spec, raw)
         return _ragged_from_raw(spec, raw.get(spec.value_key or name))
     if isinstance(spec, VarLenFeature):
         return _ragged_from_raw(spec, raw.get(name))

@@ -33,31 +33,55 @@ from distributed_tensorflow_tpu.training.layers import Sequential
 
 class _Optimizers:
     """≙ tf_keras.optimizers — constructors returning optax transforms
-    (wrapped in inject_hyperparams so LearningRateScheduler works)."""
+    (wrapped in inject_hyperparams so LearningRateScheduler works).
+    ``learning_rate`` may be a float OR a ``schedules.*`` object —
+    inject_hyperparams re-evaluates callables per optimizer step, the
+    keras per-step schedule semantics."""
+
+    from distributed_tensorflow_tpu.training import schedules
 
     @staticmethod
-    def SGD(learning_rate: float = 0.01, momentum: float = 0.0):
+    def SGD(learning_rate=0.01, momentum: float = 0.0):
         return _optax.inject_hyperparams(_optax.sgd)(
             learning_rate=learning_rate, momentum=momentum)
 
     @staticmethod
-    def Adam(learning_rate: float = 1e-3, b1: float = 0.9,
-             b2: float = 0.999):
+    def Adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999):
         return _optax.inject_hyperparams(_optax.adam)(
             learning_rate=learning_rate, b1=b1, b2=b2)
 
     @staticmethod
-    def AdamW(learning_rate: float = 1e-3, weight_decay: float = 1e-4):
+    def AdamW(learning_rate=1e-3, weight_decay: float = 1e-4):
         return _optax.inject_hyperparams(_optax.adamw)(
             learning_rate=learning_rate, weight_decay=weight_decay)
 
     @staticmethod
-    def RMSprop(learning_rate: float = 1e-3):
+    def RMSprop(learning_rate=1e-3):
         return _optax.inject_hyperparams(_optax.rmsprop)(
             learning_rate=learning_rate)
 
 
 optimizers = _Optimizers()
 
+
+class _Models:
+    """≙ tf_keras.models — whole-model persistence + aliases."""
+
+    Model = Model
+    Sequential = Sequential
+
+    @staticmethod
+    def load_model(filepath: str):
+        from distributed_tensorflow_tpu.training.saving import load_model
+        return load_model(filepath)
+
+    @staticmethod
+    def save_model(model, filepath: str):
+        from distributed_tensorflow_tpu.training.saving import save_model
+        save_model(model, filepath)
+
+
+models = _Models()
+
 __all__ = ["layers", "losses", "metrics", "callbacks", "optimizers",
-           "Model", "Sequential", "Input"]
+           "models", "Model", "Sequential", "Input"]

@@ -227,7 +227,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         kk = k_ref[0]
         vv = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        # Keep matmul OPERANDS in the input dtype (bf16 in production):
+        # fp32 operands run the MXU at half rate, and with head_dim 64
+        # already capping utilization at 50% the all-fp32 backward was
+        # the single largest off-ideal factor in the step profile.
+        # Accumulation stays fp32 via preferred_element_type; only the
+        # elementwise softmax-gradient algebra runs in fp32.
+        do = do_ref[0]
         lse = lse_ref[0]                   # (block_q, 1)
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
@@ -239,12 +245,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if valid is not None:
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)               # (block_q, block_k)
-        dp = jax.lax.dot_general(do, vv.astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, vv, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(kk.dtype)
         dq_scr[:] += jax.lax.dot_general(
-            ds, kk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds, kk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_k_blocks - 1)
@@ -274,7 +279,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         kk = k_ref[0]
         vv = v_ref[0]
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul operands, fp32 accumulation — see _bwd_dq_kernel.
+        do = do_ref[0]
         lse = lse_ref[0]                   # (block_q, 1)
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, kk, (((1,), (1,)), ((), ())),
@@ -287,14 +293,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, vv.astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, vv, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qb == num_q_blocks - 1)

@@ -66,16 +66,31 @@ def _adamw_kernel(c_ref, p_ref, g_ref, mu_ref, nu_ref,
 
 def _fused_leaf_update(p, g, mu, nu, corrections, *, lr, b1, b2, eps, wd,
                        interpret):
-    """One parameter leaf, flattened and padded to the tile grid. The
-    three outputs alias their input buffers — with jit donation this is
-    a true in-place update."""
+    """One parameter leaf in one aliased pallas pass. The three outputs
+    alias their input buffers — with jit donation this is a true
+    in-place update.
+
+    Layout discipline: a leaf that is already (..., cols) with a
+    128-multiple minor dim is viewed as (prod(leading), cols) — under
+    TPU tiling that collapse is physically free, whereas flattening to
+    a fixed (N/1024, 1024) grid re-tiles the buffer (a full extra
+    read+write per operand, which is how the first version of this
+    kernel LOST to XLA's fusions). Only oddly-shaped small leaves
+    (biases, norm scales) take the pad-and-reshape path."""
     shape = p.shape
     n = p.size
-    cols = _LANES if n >= _LANES else max(128, 1 << (n - 1).bit_length())
-    rows_total = -(-n // cols)
-    block_rows = min(_ROWS, rows_total)
+    if p.ndim >= 2 and shape[-1] % 128 == 0:
+        cols = shape[-1]
+        rows_total = n // cols
+    else:
+        cols = _LANES if n >= _LANES else max(
+            128, 1 << (n - 1).bit_length())
+        rows_total = -(-n // cols)
+    block_rows = min(max(_ROWS // max(cols // _LANES, 1), 8), rows_total)
 
     def prep(x):
+        if x.ndim >= 2 and x.shape[-1] % 128 == 0:
+            return x.reshape(-1, x.shape[-1])
         flat = x.reshape(-1)
         pad = rows_total * cols - n
         if pad:

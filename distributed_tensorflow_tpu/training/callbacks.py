@@ -165,11 +165,15 @@ class ModelCheckpoint(Callback):
     on monitored improvement. ``filepath`` may contain ``{epoch}``."""
 
     def __init__(self, filepath, monitor="val_loss", save_best_only=False,
-                 mode="auto", save_weights_only=True):
+                 mode="auto", save_weights_only=False):
+        # save_weights_only default matches tf_keras (False = full
+        # model); plain training.Model users (no serializable
+        # architecture) should pass save_weights_only=True.
         super().__init__()
         self.filepath = str(filepath)
         self.monitor = monitor
         self.save_best_only = save_best_only
+        self.save_weights_only = save_weights_only
         if mode == "auto":
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
@@ -183,7 +187,10 @@ class ModelCheckpoint(Callback):
                                                 self.mode, 0.0):
                 return
             self.best = current
-        self.model.save_weights(path)
+        if self.save_weights_only:
+            self.model.save_weights(path)
+        else:
+            self.model.save(path)       # full model (arch + weights)
 
 
 class LearningRateScheduler(Callback):

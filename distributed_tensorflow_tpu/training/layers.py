@@ -90,6 +90,35 @@ class Layer:
         """(sample-less) input shape if the layer pins one, else None."""
         return getattr(self, "input_shape", None)
 
+    def get_config(self) -> dict:
+        """≙ keras Layer.get_config: constructor kwargs, reconstructable
+        via ``type(self)(**config)``. Derived generically from the
+        constructor signature (every shim layer stores its args under
+        the parameter name; ``activation`` serializes its string
+        identifier)."""
+        import inspect
+        cfg = {}
+        params = inspect.signature(type(self).__init__).parameters
+        for name, p in params.items():
+            if name == "self" or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                continue
+            key = "activation_id" if name == "activation" else name
+            if not hasattr(self, key):
+                raise ValueError(
+                    f"{type(self).__name__} cannot serialize constructor "
+                    f"param {name!r} (no matching attribute)")
+            v = getattr(self, key)
+            if callable(v) and not isinstance(v, str):
+                raise ValueError(
+                    f"{type(self).__name__}.{name} is a Python callable; "
+                    "only string-identified values are serializable")
+            cfg[name] = list(v) if isinstance(v, tuple) else v
+        return cfg
+
+    @classmethod
+    def from_config(cls, config: dict):
+        return cls(**config)
+
 
 class InputLayer(Layer):
     """≙ keras.layers.InputLayer — records the per-sample input shape
@@ -106,12 +135,16 @@ class InputLayer(Layer):
     def apply(self, x, *, train, module=None):
         return x
 
+    def get_config(self):
+        return {"input_shape": list(self.input_shape)}
+
 
 class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
                  input_shape=None, name: str | None = None):
         self.units = int(units)
         self.activation = _activation(activation)
+        self.activation_id = activation
         self.use_bias = use_bias
         self.input_shape = tuple(input_shape) if input_shape else None
         self.name = name
@@ -132,6 +165,7 @@ class Conv2D(Layer):
         self.strides = _pair(strides)
         self.padding = padding.upper()
         self.activation = _activation(activation)
+        self.activation_id = activation
         self.use_bias = use_bias
         self.input_shape = tuple(input_shape) if input_shape else None
         self.name = name
@@ -164,6 +198,16 @@ class AveragePooling2D(MaxPooling2D):
 class GlobalAveragePooling2D(Layer):
     def apply(self, x, *, train, module=None):
         return jnp.mean(x, axis=(1, 2))
+
+
+class GlobalAveragePooling1D(Layer):
+    def apply(self, x, *, train, module=None):
+        return jnp.mean(x, axis=1)
+
+
+class GlobalMaxPooling2D(Layer):
+    def apply(self, x, *, train, module=None):
+        return jnp.max(x, axis=(1, 2))
 
 
 class Flatten(Layer):
@@ -240,6 +284,7 @@ class Softmax(Layer):
 class Activation(Layer):
     def __init__(self, activation):
         self.activation = _activation(activation)
+        self.activation_id = activation
 
     def apply(self, x, *, train, module=None):
         return self.activation(x)

@@ -476,6 +476,13 @@ class Model:
             lambda w, s: jax.device_put(jnp.asarray(w), s),
             weights, shardings)
 
+    def save(self, filepath: str):
+        """≙ keras Model.save (TFK/src/engine/training.py:2779):
+        architecture + weights to a directory; reload with
+        ``keras.models.load_model``. Supported for shim Sequential and Functional models."""
+        from distributed_tensorflow_tpu.training.saving import save_model
+        save_model(self, filepath)
+
     def save_weights(self, path: str):
         """Params AND non-param model state (BN running stats — the
         Keras non-trainable weights) when present."""

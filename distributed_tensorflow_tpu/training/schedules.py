@@ -1,0 +1,120 @@
+"""keras.optimizers.schedules-shaped learning-rate schedules.
+
+≙ TFK/src/optimizers/schedules/learning_rate_schedule.py — the same
+constructor signatures and step semantics, as jit-traceable callables
+``schedule(step) -> lr``. ``optax.inject_hyperparams`` detects callables
+and re-evaluates them every update, so a schedule passed to any
+``keras.optimizers.*`` constructor (or used directly with optax) decays
+per OPTIMIZER STEP, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LearningRateSchedule:
+    """Base class (≙ keras LearningRateSchedule): callable on a step."""
+
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def get_config(self) -> dict:
+        raise NotImplementedError
+
+
+class ExponentialDecay(LearningRateSchedule):
+    def __init__(self, initial_learning_rate, decay_steps, decay_rate,
+                 staircase: bool = False, name: str | None = None):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = bool(staircase)
+        self.name = name
+
+    def __call__(self, step):
+        p = jnp.asarray(step, jnp.float32) / self.decay_steps
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.initial_learning_rate * jnp.power(self.decay_rate, p)
+
+    def get_config(self):
+        return {"initial_learning_rate": self.initial_learning_rate,
+                "decay_steps": self.decay_steps,
+                "decay_rate": self.decay_rate,
+                "staircase": self.staircase, "name": self.name}
+
+
+class CosineDecay(LearningRateSchedule):
+    def __init__(self, initial_learning_rate, decay_steps,
+                 alpha: float = 0.0, name: str | None = None):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.alpha = float(alpha)
+        self.name = name
+
+    def __call__(self, step):
+        frac = jnp.minimum(jnp.asarray(step, jnp.float32)
+                           / self.decay_steps, 1.0)
+        cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return self.initial_learning_rate * (
+            (1.0 - self.alpha) * cosine + self.alpha)
+
+    def get_config(self):
+        return {"initial_learning_rate": self.initial_learning_rate,
+                "decay_steps": self.decay_steps, "alpha": self.alpha,
+                "name": self.name}
+
+
+class PiecewiseConstantDecay(LearningRateSchedule):
+    def __init__(self, boundaries, values, name: str | None = None):
+        if len(values) != len(boundaries) + 1:
+            raise ValueError(
+                f"values needs len(boundaries)+1 entries; got "
+                f"{len(values)} values for {len(boundaries)} boundaries")
+        self.boundaries = [int(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+        self.name = name
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        lr = jnp.asarray(self.values[0], jnp.float32)
+        for b, v in zip(self.boundaries, self.values[1:]):
+            lr = jnp.where(step > b, v, lr)
+        return lr
+
+    def get_config(self):
+        return {"boundaries": self.boundaries, "values": self.values,
+                "name": self.name}
+
+
+class PolynomialDecay(LearningRateSchedule):
+    def __init__(self, initial_learning_rate, decay_steps,
+                 end_learning_rate: float = 1e-4, power: float = 1.0,
+                 cycle: bool = False, name: str | None = None):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.end_learning_rate = float(end_learning_rate)
+        self.power = float(power)
+        self.cycle = bool(cycle)
+        self.name = name
+
+    def __call__(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            mult = jnp.maximum(
+                1.0, jnp.ceil(step / jnp.maximum(self.decay_steps, 1)))
+            decay_steps = self.decay_steps * mult
+        else:
+            decay_steps = jnp.asarray(self.decay_steps, jnp.float32)
+            step = jnp.minimum(step, decay_steps)
+        frac = 1.0 - step / decay_steps
+        return ((self.initial_learning_rate - self.end_learning_rate)
+                * jnp.power(frac, self.power) + self.end_learning_rate)
+
+    def get_config(self):
+        return {"initial_learning_rate": self.initial_learning_rate,
+                "decay_steps": self.decay_steps,
+                "end_learning_rate": self.end_learning_rate,
+                "power": self.power, "cycle": self.cycle,
+                "name": self.name}

@@ -1,0 +1,74 @@
+"""Config #3 (BERT-style encoder classifier) as a VERBATIM
+reference-style FUNCTIONAL-API Keras script.
+
+Written the way the reference's BERT fine-tuning scripts compose an
+encoder in keras (functional graph of MultiHeadAttention + residual
+Add + LayerNormalization blocks — TFK/src/layers/attention/
+multi_head_attention.py); the ONLY line that differs from the tf_keras
+original is the import.
+
+    reference:  import tensorflow as tf; keras = tf.keras
+    here:       from distributed_tensorflow_tpu import keras
+"""
+
+import numpy as np
+
+import distributed_tensorflow_tpu as tf_distribute
+from distributed_tensorflow_tpu import keras
+
+layers = keras.layers
+
+
+def encoder_block(x, d_model, num_heads, ff_dim, dropout=0.1):
+    """Post-LN transformer encoder block, keras-tutorial style."""
+    attn = layers.MultiHeadAttention(num_heads, d_model // num_heads,
+                                     dropout=dropout)(x, x)
+    attn = layers.Dropout(dropout)(attn)
+    x = layers.LayerNormalization(epsilon=1e-6)(layers.Add()([x, attn]))
+    ff = layers.Dense(ff_dim, activation="gelu")(x)
+    ff = layers.Dense(d_model)(ff)
+    ff = layers.Dropout(dropout)(ff)
+    return layers.LayerNormalization(epsilon=1e-6)(
+        layers.Add()([x, ff]))
+
+
+def build_encoder(vocab_size=1000, seq_len=64, d_model=64, num_heads=4,
+                  ff_dim=256, num_blocks=2, classes=4):
+    inputs = keras.Input(shape=(seq_len,), dtype="int32")
+    x = layers.Embedding(vocab_size, d_model)(inputs)
+    for _ in range(num_blocks):
+        x = encoder_block(x, d_model, num_heads, ff_dim)
+    x = layers.GlobalAveragePooling1D()(x)
+    x = layers.Dense(d_model, activation="tanh")(x)   # pooler
+    outputs = layers.Dense(classes)(x)
+    return keras.Model(inputs=inputs, outputs=outputs)
+
+
+def load_data(n=2048, seq_len=64, vocab=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, vocab, size=(n, seq_len)).astype("int32")
+    y = (x[:, :8].sum(axis=1) % 4).astype("int32")
+    return (x[: n - 256], y[: n - 256]), (x[n - 256:], y[n - 256:])
+
+
+def main():
+    (x_train, y_train), (x_test, y_test) = load_data()
+
+    strategy = tf_distribute.MirroredStrategy()
+    with strategy.scope():
+        model = build_encoder()
+        model.compile(
+            optimizer=keras.optimizers.Adam(5e-4),
+            loss=keras.losses.SparseCategoricalCrossentropy(
+                from_logits=True),
+            metrics=["accuracy"],
+        )
+
+    model.fit(x_train, y_train, batch_size=64, epochs=3,
+              validation_data=(x_test, y_test))
+    loss, acc = model.evaluate(x_test, y_test, batch_size=64)
+    print(f"eval loss {loss:.4f}  accuracy {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Config #1 with CHECKPOINTING + LR SCHEDULE, verbatim reference style.
+
+The reference's production training scripts nearly always combine
+``ModelCheckpoint`` + ``model.save`` + a ``keras.optimizers.schedules``
+learning-rate schedule (TFK/src/engine/training.py:2779 save;
+TFK/src/optimizers/schedules/). This script exercises that surface with
+ONLY the import changed:
+
+    reference:  import tensorflow as tf; keras = tf.keras
+    here:       from distributed_tensorflow_tpu import keras
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import distributed_tensorflow_tpu as tf_distribute
+from distributed_tensorflow_tpu import keras
+
+
+def load_data(n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 28, 28, 1)).astype("float32")
+    y = (np.abs(x.mean(axis=(1, 2, 3))) * 40).astype("int32") % 10
+    return (x[: n - 512], y[: n - 512]), (x[n - 512:], y[n - 512:])
+
+
+def main():
+    (x_train, y_train), (x_test, y_test) = load_data()
+    workdir = tempfile.mkdtemp(prefix="mnist_ckpt_")
+
+    strategy = tf_distribute.MirroredStrategy()
+    with strategy.scope():
+        model = keras.Sequential([
+            keras.Input((28, 28, 1)),
+            keras.layers.Conv2D(32, 3, padding="same", activation="relu"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dense(10),
+        ])
+        lr_schedule = keras.optimizers.schedules.ExponentialDecay(
+            initial_learning_rate=1e-3, decay_steps=100, decay_rate=0.9)
+        model.compile(
+            optimizer=keras.optimizers.Adam(lr_schedule),
+            loss=keras.losses.SparseCategoricalCrossentropy(
+                from_logits=True),
+            metrics=["accuracy"],
+        )
+
+    checkpoint_cb = keras.callbacks.ModelCheckpoint(
+        os.path.join(workdir, "ckpt-{epoch}"), monitor="val_loss",
+        save_best_only=True, save_weights_only=False)
+    model.fit(x_train, y_train, batch_size=256, epochs=3,
+              validation_data=(x_test, y_test), callbacks=[checkpoint_cb])
+
+    model.save(os.path.join(workdir, "final_model"))
+    restored = keras.models.load_model(os.path.join(workdir, "final_model"))
+    restored.compile(
+        optimizer=keras.optimizers.Adam(1e-4),
+        loss=keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    loss, acc = restored.evaluate(x_test, y_test, batch_size=256)
+    print(f"restored-model eval loss {loss:.4f}  accuracy {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Config #2 (ResNet-50 classifier) as a VERBATIM reference-style
+FUNCTIONAL-API Keras script.
+
+Written exactly the way the reference's ResNet training script is
+(SURVEY.md §3.1 / TFK/src/applications/resnet.py style: functional
+graph with identity/conv blocks under strategy.scope, compile, fit) —
+the ONLY line that differs from the tf_keras original is the import.
+Residual connections make this impossible in Sequential; it exercises
+keras.Model(inputs, outputs), layers.Add, ZeroPadding2D and
+BatchNormalization through the functional shim
+(training/functional.py ≙ TFK/src/engine/functional.py:84).
+
+    reference:  import tensorflow as tf; keras = tf.keras
+    here:       from distributed_tensorflow_tpu import keras
+"""
+
+import numpy as np
+
+import distributed_tensorflow_tpu as tf_distribute
+from distributed_tensorflow_tpu import keras
+
+layers = keras.layers
+
+
+def identity_block(x, filters, kernel_size=3):
+    """Standard ResNet identity block (1x1 -> 3x3 -> 1x1 + shortcut)."""
+    f1, f2, f3 = filters
+    shortcut = x
+    x = layers.Conv2D(f1, 1)(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    x = layers.Conv2D(f2, kernel_size, padding="same")(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    x = layers.Conv2D(f3, 1)(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Add()([x, shortcut])
+    return layers.Activation("relu")(x)
+
+
+def conv_block(x, filters, kernel_size=3, strides=2):
+    """ResNet conv block: projection shortcut with stride."""
+    f1, f2, f3 = filters
+    shortcut = layers.Conv2D(f3, 1, strides=strides)(x)
+    shortcut = layers.BatchNormalization()(shortcut)
+    x = layers.Conv2D(f1, 1, strides=strides)(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    x = layers.Conv2D(f2, kernel_size, padding="same")(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    x = layers.Conv2D(f3, 1)(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Add()([x, shortcut])
+    return layers.Activation("relu")(x)
+
+
+def build_resnet50(input_shape=(64, 64, 3), classes=10):
+    """ResNet-50: [3, 4, 6, 3] bottleneck stages, keras-application
+    style (TFK/src/applications/resnet.py ResNet50 stack)."""
+    inputs = keras.Input(shape=input_shape)
+    x = layers.ZeroPadding2D(3)(inputs)
+    x = layers.Conv2D(64, 7, strides=2)(x)
+    x = layers.BatchNormalization()(x)
+    x = layers.Activation("relu")(x)
+    x = layers.ZeroPadding2D(1)(x)
+    x = layers.MaxPooling2D(3, strides=2)(x)
+
+    x = conv_block(x, [64, 64, 256], strides=1)
+    for _ in range(2):
+        x = identity_block(x, [64, 64, 256])
+    x = conv_block(x, [128, 128, 512])
+    for _ in range(3):
+        x = identity_block(x, [128, 128, 512])
+    x = conv_block(x, [256, 256, 1024])
+    for _ in range(5):
+        x = identity_block(x, [256, 256, 1024])
+    x = conv_block(x, [512, 512, 2048])
+    for _ in range(2):
+        x = identity_block(x, [512, 512, 2048])
+
+    x = layers.GlobalAveragePooling2D()(x)
+    outputs = layers.Dense(classes)(x)
+    return keras.Model(inputs=inputs, outputs=outputs)
+
+
+def load_data(n=2048, shape=(64, 64, 3), seed=0):
+    """Synthetic ImageNet-shaped data (zero-egress environment); labels
+    derived from image statistics so the model can actually fit."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, *shape)).astype("float32")
+    y = (np.abs(x.mean(axis=(1, 2, 3))) * 400).astype("int32") % 10
+    return (x[: n - 256], y[: n - 256]), (x[n - 256:], y[n - 256:])
+
+
+def main():
+    (x_train, y_train), (x_test, y_test) = load_data()
+
+    strategy = tf_distribute.MirroredStrategy()
+    with strategy.scope():
+        model = build_resnet50()
+        model.compile(
+            optimizer=keras.optimizers.SGD(0.05, momentum=0.9),
+            loss=keras.losses.SparseCategoricalCrossentropy(
+                from_logits=True),
+            metrics=["accuracy"],
+        )
+
+    model.fit(x_train, y_train, batch_size=64, epochs=2,
+              validation_data=(x_test, y_test))
+    loss, acc = model.evaluate(x_test, y_test, batch_size=64)
+    print(f"eval loss {loss:.4f}  accuracy {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""Keras functional-API shim (training/functional.py ≙
+TFK/src/engine/functional.py:84): symbolic graphs with residual adds,
+layer reuse (shared weights), multi-input models — and forward parity
+against a REAL tf_keras Functional model from mapped weights
+(VERDICT r4 item 4's done bar)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu import keras
+
+
+def _residual_model():
+    inp = keras.Input(shape=(8, 8, 3))
+    x = keras.layers.Conv2D(4, 3, padding="same", name="c1")(inp)
+    x = keras.layers.BatchNormalization(name="bn1")(x)
+    x = keras.layers.Activation("relu")(x)
+    y = keras.layers.Conv2D(4, 3, padding="same", name="c2")(x)
+    z = keras.layers.Add()([x, y])
+    z = keras.layers.GlobalAveragePooling2D()(z)
+    out = keras.layers.Dense(3, name="head")(z)
+    return keras.Model(inputs=inp, outputs=out)
+
+
+def test_functional_residual_model_trains(devices):
+    x = np.random.default_rng(0).normal(size=(256, 8, 8, 3)) \
+        .astype("float32")
+    y = (np.abs(x.mean(axis=(1, 2, 3))) * 40).astype("int32") % 3
+    strategy = dtx.MirroredStrategy()
+    with strategy.scope():
+        model = _residual_model()
+        model.compile(optimizer="adam", learning_rate=5e-3,
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+    h = model.fit(x, y, batch_size=64, epochs=3, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+    preds = model.predict(x[:8], batch_size=8)
+    assert preds.shape == (8, 3)
+
+
+def test_layer_reuse_shares_weights(devices):
+    """Calling the SAME layer instance twice creates ONE parameter set
+    (keras sharing semantics)."""
+    inp = keras.Input(shape=(5,))
+    shared = keras.layers.Dense(5, name="shared")
+    a = shared(inp)
+    b = shared(a)              # reuse
+    out = keras.layers.Add()([a, b])
+    model = keras.Model(inputs=inp, outputs=out)
+    names = list(model.params.keys())
+    assert names.count("shared") == 1 and len(names) == 1
+    # forward equals manual composition with the single kernel (the
+    # inner flax submodule carries the layer's explicit name)
+    inner = model.params["shared"]["shared"]
+    k = np.asarray(inner["kernel"])
+    bia = np.asarray(inner["bias"])
+    x = np.random.default_rng(1).normal(size=(4, 5)).astype("float32")
+    a_ref = x @ k + bia
+    b_ref = a_ref @ k + bia
+    np.testing.assert_allclose(np.asarray(model(jnp.asarray(x))),
+                               a_ref + b_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_multi_input_model(devices):
+    ia = keras.Input(shape=(4,))
+    ib = keras.Input(shape=(6,))
+    a = keras.layers.Dense(8)(ia)
+    b = keras.layers.Dense(8)(ib)
+    merged = keras.layers.Concatenate()([a, b])
+    out = keras.layers.Dense(2)(merged)
+    model = keras.Model(inputs=[ia, ib], outputs=out)
+    xa = jnp.ones((3, 4))
+    xb = jnp.ones((3, 6))
+    y = model((xa, xb))
+    assert y.shape == (3, 2)
+
+
+def test_disconnected_graph_raises(devices):
+    inp = keras.Input(shape=(4,))
+    other = keras.Input(shape=(4,))
+    out = keras.layers.Add()([keras.layers.Dense(4)(inp),
+                              keras.layers.Dense(4)(other)])
+    with pytest.raises(ValueError, match="disconnected"):
+        keras.Model(inputs=inp, outputs=out)
+
+
+def test_forward_parity_with_real_tf_keras_functional(devices):
+    """Our functional model's weights load into the same architecture
+    built with real tf_keras Functional; predictions match."""
+    tf_keras = pytest.importorskip("tf_keras")
+
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        ours = _residual_model()
+        ours.compile(optimizer="sgd", learning_rate=0.01,
+                     loss="sparse_categorical_crossentropy")
+
+    inp = tf_keras.Input(shape=(8, 8, 3))
+    x = tf_keras.layers.Conv2D(4, 3, padding="same", name="c1")(inp)
+    x = tf_keras.layers.BatchNormalization(name="bn1")(x)
+    x = tf_keras.layers.Activation("relu")(x)
+    y = tf_keras.layers.Conv2D(4, 3, padding="same", name="c2")(x)
+    z = tf_keras.layers.Add()([x, y])
+    z = tf_keras.layers.GlobalAveragePooling2D()(z)
+    out = tf_keras.layers.Dense(3, name="head")(z)
+    ref = tf_keras.Model(inputs=inp, outputs=out)
+
+    p = ours.params
+    ms = ours._state["model_state"]["batch_stats"]
+    ref.get_layer("c1").set_weights([
+        np.asarray(p["c1"]["c1"]["kernel"]),
+        np.asarray(p["c1"]["c1"]["bias"])])
+    ref.get_layer("c2").set_weights([
+        np.asarray(p["c2"]["c2"]["kernel"]),
+        np.asarray(p["c2"]["c2"]["bias"])])
+    ref.get_layer("head").set_weights([
+        np.asarray(p["head"]["head"]["kernel"]),
+        np.asarray(p["head"]["head"]["bias"])])
+    ref.get_layer("bn1").set_weights([
+        np.asarray(p["bn1"]["bn1"]["scale"]),
+        np.asarray(p["bn1"]["bn1"]["bias"]),
+        np.asarray(ms["bn1"]["bn1"]["mean"]),
+        np.asarray(ms["bn1"]["bn1"]["var"])])
+
+    x_in = np.random.default_rng(3).normal(size=(16, 8, 8, 3)) \
+        .astype("float32")
+    ours_pred = ours.predict(x_in, batch_size=16)
+    ref_pred = ref.predict(x_in, verbose=0)
+    np.testing.assert_allclose(ours_pred, ref_pred, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_parity_with_real_tf_keras(devices):
+    """Shim MultiHeadAttention == tf_keras MultiHeadAttention from
+    mapped weights (keras kernel layouts pinned)."""
+    tf_keras = pytest.importorskip("tf_keras")
+
+    D, H, hd, S = 8, 2, 4, 5
+    q_in = keras.Input(shape=(S, D))
+    out = keras.layers.MultiHeadAttention(H, hd, name="mha")(q_in, q_in)
+    model = keras.Model(inputs=q_in, outputs=out)
+
+    ti = tf_keras.Input(shape=(S, D))
+    tout = tf_keras.layers.MultiHeadAttention(H, hd, name="mha")(ti, ti)
+    ref = tf_keras.Model(inputs=ti, outputs=tout)
+
+    p = model.params["mha"]
+    ref.get_layer("mha").set_weights([
+        np.asarray(p["query"]["kernel"]), np.asarray(p["query"]["bias"]),
+        np.asarray(p["key"]["kernel"]), np.asarray(p["key"]["bias"]),
+        np.asarray(p["value"]["kernel"]), np.asarray(p["value"]["bias"]),
+        np.asarray(p["attention_output"]["kernel"]),
+        np.asarray(p["attention_output"]["bias"])])
+
+    x = np.random.default_rng(4).normal(size=(3, S, D)).astype("float32")
+    np.testing.assert_allclose(
+        np.asarray(model(jnp.asarray(x))), ref(x).numpy(),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_resnet50_script_architecture_builds_and_steps(devices):
+    """The verbatim-style ResNet-50 functional script's builder
+    (examples/train_resnet_keras_script.py) constructs and takes a
+    training step at reduced input size."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "train_resnet_keras_script",
+        os.path.join(os.path.dirname(__file__), "..", "examples",
+                     "train_resnet_keras_script.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model = mod.build_resnet50(input_shape=(32, 32, 3), classes=5)
+        model.compile(optimizer="sgd", learning_rate=0.01,
+                      loss="sparse_categorical_crossentropy")
+    # 50 conv layers + bn + adds + head present
+    from distributed_tensorflow_tpu.training import layers as L
+    convs = [l for l in model.layers if isinstance(l, L.Conv2D)]
+    assert len(convs) == 53     # stem + 16x3 bottleneck + 4 projections
+    x = np.random.default_rng(5).normal(size=(8, 32, 32, 3)) \
+        .astype("float32")
+    y = np.arange(8, dtype="int32") % 5
+    h = model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(h.history["loss"][0])

@@ -468,3 +468,20 @@ def test_bucket_by_sequence_length_boundary_padding():
     with pytest.raises(ValueError, match="entries"):
         Dataset.range(3).bucket_by_sequence_length(
             lambda x: 1, [5], [1])
+
+
+def test_bucket_by_sequence_length_pads_trailing_dims():
+    """tf.data pads every unknown dim, not just the leading axis
+    (ADVICE r4): (T, feat) elements with varying feat must batch."""
+    from distributed_tensorflow_tpu.input.dataset import Dataset
+    els = [np.ones((2, 3), np.float32), np.ones((4, 5), np.float32),
+           np.ones((3, 2), np.float32), np.ones((5, 4), np.float32)]
+    ds = Dataset.from_iterable(els).bucket_by_sequence_length(
+        lambda el: el.shape[0], bucket_boundaries=[4],
+        bucket_batch_sizes=[2, 2])
+    batches = list(ds)
+    shapes = sorted(tuple(b.shape) for b in batches)
+    # bucket <4: lens 2,3 feats 3,2 -> (2, 3, 3); bucket >=4: (2, 5, 5)
+    assert shapes == [(2, 3, 3), (2, 5, 5)]
+    total = sum(float(b.sum()) for b in batches)
+    assert total == sum(float(e.sum()) for e in els)   # zero padding only

@@ -1,0 +1,175 @@
+"""Whole-model persistence + optimizer schedules (VERDICT r4 item 7):
+model.save / keras.models.load_model round-trips architecture AND
+weights; keras.optimizers.schedules match tf_keras numerically; the
+ModelCheckpoint + schedule reference-style script surface works
+end-to-end; saved weights round-trip into real tf_keras."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import distributed_tensorflow_tpu as dtx
+from distributed_tensorflow_tpu import keras
+from distributed_tensorflow_tpu.training import schedules
+
+
+def _model_and_data(n=256):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 12, 12, 1)).astype("float32")
+    y = (np.abs(x.mean(axis=(1, 2, 3))) * 40).astype("int32") % 4
+    model = keras.Sequential([
+        keras.Input((12, 12, 1)),
+        keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(4),
+    ])
+    return model, x, y
+
+
+def test_save_load_model_roundtrip(devices, tmp_path):
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model, x, y = _model_and_data()
+        model.compile(optimizer="sgd", learning_rate=0.05,
+                      loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=64, epochs=1, verbose=0)
+    path = str(tmp_path / "saved_model")
+    model.save(path)
+
+    restored = keras.models.load_model(path)
+    np.testing.assert_allclose(
+        model.predict(x[:16], batch_size=16),
+        restored.predict(x[:16], batch_size=16), rtol=1e-6)
+    # loaded model re-compiles and keeps training
+    restored.compile(optimizer="sgd", learning_rate=0.05,
+                     loss="sparse_categorical_crossentropy")
+    h = restored.fit(x, y, batch_size=64, epochs=1, verbose=0)
+    assert np.isfinite(h.history["loss"][0])
+
+
+def test_model_checkpoint_full_model_and_reload(devices, tmp_path):
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model, x, y = _model_and_data()
+        model.compile(optimizer="adam", learning_rate=1e-3,
+                      loss="sparse_categorical_crossentropy")
+    cb = keras.callbacks.ModelCheckpoint(
+        str(tmp_path / "ckpt-{epoch}"), monitor="loss",
+        save_weights_only=False)
+    model.fit(x, y, batch_size=64, epochs=2, verbose=0, callbacks=[cb])
+    assert os.path.isdir(tmp_path / "ckpt-2")
+    restored = keras.models.load_model(str(tmp_path / "ckpt-2"))
+    np.testing.assert_allclose(
+        model.predict(x[:8], batch_size=8),
+        restored.predict(x[:8], batch_size=8), rtol=1e-6)
+
+
+def test_schedules_match_tf_keras():
+    tf_keras = pytest.importorskip("tf_keras")
+    ks = tf_keras.optimizers.schedules
+    pairs = [
+        (schedules.ExponentialDecay(0.1, 20, 0.7),
+         ks.ExponentialDecay(0.1, 20, 0.7)),
+        (schedules.ExponentialDecay(0.1, 20, 0.7, staircase=True),
+         ks.ExponentialDecay(0.1, 20, 0.7, staircase=True)),
+        (schedules.CosineDecay(0.2, 50, alpha=0.1),
+         ks.CosineDecay(0.2, 50, alpha=0.1)),
+        (schedules.PiecewiseConstantDecay([10, 30], [1.0, 0.5, 0.1]),
+         ks.PiecewiseConstantDecay([10, 30], [1.0, 0.5, 0.1])),
+        (schedules.PolynomialDecay(0.3, 40, 0.01, power=2.0),
+         ks.PolynomialDecay(0.3, 40, 0.01, power=2.0)),
+    ]
+    for ours, ref in pairs:
+        for step in (0, 1, 7, 10, 25, 30, 40, 55, 120):
+            np.testing.assert_allclose(
+                float(ours(step)), float(ref(step).numpy()), rtol=1e-6,
+                err_msg=f"{type(ours).__name__} at step {step}")
+
+
+def test_schedule_decays_lr_during_fit(devices):
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model, x, y = _model_and_data()
+        sched = keras.optimizers.schedules.ExponentialDecay(1e-2, 4, 0.5)
+        model.compile(optimizer=keras.optimizers.SGD(sched),
+                      loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=64, epochs=2, verbose=0)
+    # 8 steps at decay 0.5^(step/4): lr should be ~1e-2 * 0.5^2
+    assert model.learning_rate < 5e-3
+
+
+def test_saved_model_weights_roundtrip_into_tf_keras(devices, tmp_path):
+    tf_keras = pytest.importorskip("tf_keras")
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model, x, y = _model_and_data()
+        model.compile(optimizer="sgd", learning_rate=0.05,
+                      loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=64, epochs=1, verbose=0)
+    model.save(str(tmp_path / "m"))
+    restored = keras.models.load_model(str(tmp_path / "m"))
+
+    ref = tf_keras.Sequential([
+        tf_keras.layers.Input((12, 12, 1)),
+        tf_keras.layers.Conv2D(4, 3, padding="same", activation="relu"),
+        tf_keras.layers.MaxPooling2D(2),
+        tf_keras.layers.Flatten(),
+        tf_keras.layers.Dense(4),
+    ])
+    p = restored.params
+    flat = [np.asarray(leaf) for _, leaf in
+            sorted(jax.tree_util.tree_flatten_with_path(p)[0],
+                   key=lambda kv: jax.tree_util.keystr(kv[0]))]
+    conv_b, conv_k, dense_b, dense_k = flat
+    ref.set_weights([conv_k, conv_b, dense_k, dense_b])
+    np.testing.assert_allclose(
+        restored.predict(x[:8], batch_size=8),
+        ref.predict(x[:8], verbose=0), rtol=1e-4, atol=1e-5)
+
+
+def test_checkpoint_schedule_script_runs(devices):
+    """The verbatim ModelCheckpoint+schedule script's main() runs
+    end-to-end (smaller data via monkeypatched loader would slow CI less
+    but the script is already small)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "train_mnist_checkpoint_schedule_script",
+        os.path.join(os.path.dirname(__file__), "..", "examples",
+                     "train_mnist_checkpoint_schedule_script.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+def test_save_load_functional_model_roundtrip(devices, tmp_path):
+    """Functional DAG (residual add + layer reuse + MHA multi-arg call)
+    serializes and reloads with identical predictions."""
+    import jax.numpy as jnp
+    inp = keras.Input(shape=(6, 8))
+    mha = keras.layers.MultiHeadAttention(2, 4, name="mha")
+    a = mha(inp, inp)                       # multi-positional call
+    x = keras.layers.Add()([inp, a])        # list call
+    shared = keras.layers.Dense(8, name="shared")
+    y = shared(x)
+    y = shared(y)                           # reuse
+    out = keras.layers.Dense(3)(keras.layers.GlobalAveragePooling1D()(y))
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model = keras.Model(inputs=inp, outputs=out)
+        model.compile(optimizer="sgd", learning_rate=0.01,
+                      loss="sparse_categorical_crossentropy")
+    x_in = np.random.default_rng(6).normal(size=(4, 6, 8)) \
+        .astype("float32")
+    y_in = np.zeros(4, "int32")
+    model.fit(x_in, y_in, batch_size=4, epochs=1, verbose=0)
+    model.save(str(tmp_path / "fm"))
+    restored = keras.models.load_model(str(tmp_path / "fm"))
+    np.testing.assert_allclose(
+        np.asarray(model(jnp.asarray(x_in))),
+        np.asarray(restored(jnp.asarray(x_in))), rtol=1e-6)
+    # reuse preserved: single shared parameter set
+    assert list(restored.params).count("shared") == 1

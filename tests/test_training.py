@@ -111,7 +111,7 @@ def test_model_checkpoint_and_weights_roundtrip(data, devices, tmp_path):
     x, y = data
     model = compiled_model(MirroredStrategy())
     cb = ModelCheckpoint(str(tmp_path / "ck-{epoch}"), monitor="loss",
-                         save_best_only=False)
+                         save_best_only=False, save_weights_only=True)
     model.fit(x, y, epochs=2, batch_size=64, verbose=0, callbacks=[cb])
     assert (tmp_path / "ck-1").exists() and (tmp_path / "ck-2").exists()
 
