@@ -205,7 +205,11 @@ def run_bert():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     if on_tpu:
-        cfg = bert.bert_config(loss_chunks=8)
+        # Same single-chip recipe as the flagship (see main()): unroll,
+        # no remat, Pallas fused CE, full-sequence attention tiles.
+        cfg = bert.bert_config(remat=False, scan_layers=False,
+                               loss_chunks=8, loss_impl="kernel",
+                               attn_block_q=512, attn_block_k=512)
         batch, n_iters, reps = 32, 10, 4
     else:
         cfg = bert.tiny_bert_config()

@@ -65,9 +65,40 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
                     seed: int = 0):
     """(state, batch{"tokens"}) -> (state, metrics). 80/10/10 masking is
     applied on-device inside the step, re-drawn per step from
-    fold_in(seed, step) — dynamic masking, fresh every epoch."""
+    fold_in(seed, step) — dynamic masking, fresh every epoch.
+
+    With ``cfg.loss_impl="kernel"`` the masked-position CE runs through
+    the Pallas fused-CE kernels against the tied embedding
+    (ops/fused_ce.py) — the (B, S, vocab) logits never materialize,
+    same as the flagship LM loss. Any other setting keeps the classic
+    full-logits path."""
+
+    def kernel_loss_fn(params, inputs, labels):
+        from distributed_tensorflow_tpu.ops.fused_ce import (
+            fused_cross_entropy, sharded_fused_cross_entropy)
+        hidden = model.apply({"params": params}, inputs,
+                             return_hidden=True)
+        B, S, D = hidden.shape
+        mask = labels != IGNORE_LABEL
+        safe = jnp.where(mask, labels, 0)
+        emb = params["embed"].astype(cfg.dtype)
+        if cfg.mesh is not None and cfg.mesh.size > 1:
+            losses = sharded_fused_cross_entropy(
+                hidden.astype(cfg.dtype), emb, safe, cfg.mesh,
+                block_n=cfg.loss_block_n, block_v=cfg.loss_block_v,
+                implementation=cfg.loss_kernel_impl)
+        else:
+            losses = fused_cross_entropy(
+                hidden.reshape(B * S, D).astype(cfg.dtype), emb,
+                safe.reshape(B * S), block_n=cfg.loss_block_n,
+                block_v=cfg.loss_block_v,
+                implementation=cfg.loss_kernel_impl).reshape(B, S)
+        denom = jnp.maximum(mask.sum(), 1)
+        return (losses * mask).sum() / denom
 
     def loss_fn(params, inputs, labels):
+        if cfg.loss_impl == "kernel":
+            return kernel_loss_fn(params, inputs, labels)
         logits = model.apply({"params": params}, inputs)
         return mlm_loss(logits, labels)
 

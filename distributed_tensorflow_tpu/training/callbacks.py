@@ -179,6 +179,22 @@ class ModelCheckpoint(Callback):
         self.mode = mode
         self.best = np.inf if self.mode == "min" else -np.inf
 
+    def on_train_begin(self, logs=None):
+        # Fail FAST when full-model saving can't work for this model
+        # kind (plain training.Model has no serializable architecture) —
+        # not after a full epoch of compute.
+        if not self.save_weights_only:
+            from distributed_tensorflow_tpu.training import functional
+            from distributed_tensorflow_tpu.training import layers
+            if not isinstance(self.model, layers.Sequential) and not (
+                    isinstance(self.model, functional.Model)
+                    and hasattr(self.model, "_graph_nodes")):
+                raise NotImplementedError(
+                    f"ModelCheckpoint(save_weights_only=False) needs a "
+                    f"shim Sequential/Functional model to serialize; "
+                    f"got {type(self.model).__name__} — pass "
+                    "save_weights_only=True")
+
     def on_epoch_end(self, epoch, logs=None):
         path = self.filepath.format(epoch=epoch + 1)
         if self.save_best_only:

@@ -56,6 +56,11 @@ def _pair(v):
     return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
 
 
+def _single(v):
+    """1-D window arg: int -> (int,), sequence -> tuple."""
+    return (v,) if isinstance(v, int) else tuple(v)
+
+
 class Layer:
     """Base shim layer: a configuration object whose ``apply`` runs
     inside the owning flax module's compact scope (so flax handles
@@ -177,10 +182,107 @@ class Conv2D(Layer):
         return self.activation(x)
 
 
+class Conv1D(Layer):
+    def __init__(self, filters: int, kernel_size, strides=1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True, input_shape=None,
+                 name: str | None = None):
+        self.filters = int(filters)
+        self.kernel_size = _single(kernel_size)
+        self.strides = _single(strides)
+        self.padding = padding.upper()
+        self.activation = _activation(activation)
+        self.activation_id = activation
+        self.use_bias = use_bias
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        x = nn.Conv(self.filters, self.kernel_size, strides=self.strides,
+                    padding=self.padding, use_bias=self.use_bias,
+                    name=self.name)(x)
+        return self.activation(x)
+
+
+class DepthwiseConv2D(Layer):
+    """≙ keras DepthwiseConv2D (depth_multiplier=1): one filter per
+    input channel via flax's feature_group_count grouping; kernel kept
+    in the KERAS layout (H, W, Cin, 1)."""
+
+    def __init__(self, kernel_size, strides=1, padding: str = "valid",
+                 activation=None, use_bias: bool = True,
+                 name: str | None = None):
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.upper()
+        self.activation = _activation(activation)
+        self.activation_id = activation
+        self.use_bias = use_bias
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        cin = x.shape[-1]
+        x = nn.Conv(cin, self.kernel_size, strides=self.strides,
+                    padding=self.padding, use_bias=self.use_bias,
+                    feature_group_count=cin, name=self.name)(x)
+        return self.activation(x)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=2, interpolation: str = "nearest"):
+        self.size = _pair(size)
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                "UpSampling2D supports interpolation='nearest'")
+        self.interpolation = interpolation
+
+    def apply(self, x, *, train, module=None):
+        sh, sw = self.size
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+
+
+class Permute(Layer):
+    """≙ keras Permute: 1-indexed dims over the non-batch axes."""
+
+    def __init__(self, dims):
+        self.dims = tuple(int(d) for d in dims)
+
+    def apply(self, x, *, train, module=None):
+        return jnp.transpose(x, (0,) + self.dims)
+
+
+class Lambda(Layer):
+    """≙ keras Lambda — arbitrary stateless function. Not serializable
+    (model.save raises), same as tf_keras without safe_mode=False."""
+
+    def __init__(self, function):
+        self.function = function
+
+    def apply(self, x, *, train, module=None):
+        return self.function(x)
+
+    def get_config(self):
+        raise ValueError(
+            "Lambda layers are not serializable; rebuild the model in "
+            "code and use load_weights")
+
+
 class MaxPooling2D(Layer):
     def __init__(self, pool_size=2, strides=None, padding: str = "valid"):
         self.pool_size = _pair(pool_size)
         self.strides = _pair(strides) if strides is not None \
+            else self.pool_size
+        self.padding = padding.upper()
+
+    def apply(self, x, *, train, module=None):
+        return nn.max_pool(x, self.pool_size, strides=self.strides,
+                           padding=self.padding)
+
+
+class MaxPooling1D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding: str = "valid"):
+        self.pool_size = _single(pool_size)
+        self.strides = _single(strides) if strides is not None \
             else self.pool_size
         self.padding = padding.upper()
 
@@ -208,6 +310,11 @@ class GlobalAveragePooling1D(Layer):
 class GlobalMaxPooling2D(Layer):
     def apply(self, x, *, train, module=None):
         return jnp.max(x, axis=(1, 2))
+
+
+class GlobalMaxPooling1D(Layer):
+    def apply(self, x, *, train, module=None):
+        return jnp.max(x, axis=1)
 
 
 class Flatten(Layer):

@@ -341,12 +341,38 @@ class Model:
     # -- fit / evaluate / predict -----------------------------------------
     def fit(self, x, y=None, *, batch_size: int = 32, epochs: int = 1,
             verbose: int = 1, callbacks: Sequence | None = None,
-            validation_data=None, shuffle: bool = True,
+            validation_data=None, validation_split: float = 0.0,
+            shuffle: bool = True,
             initial_epoch: int = 0, steps_per_epoch: int | None = None,
             sample_weight=None):
-        """≙ Model.fit (tf_keras training.py:1453)."""
+        """≙ Model.fit (tf_keras training.py:1453). ``validation_split``
+        holds out the LAST fraction of (x, y) before shuffling, like
+        keras (training.py train_validation_split)."""
         if not self._compiled:
             raise RuntimeError("compile() the model before fit()")
+        if validation_split:
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1), got "
+                    f"{validation_split}")
+            if validation_data is not None:
+                raise ValueError(
+                    "pass either validation_data or validation_split, "
+                    "not both")
+            if y is None:
+                raise ValueError(
+                    "validation_split requires array inputs (x, y)")
+            x, y = np.asarray(x), np.asarray(y)
+            split = int(len(x) * (1.0 - validation_split))
+            if split == 0 or split == len(x):
+                raise ValueError(
+                    f"validation_split={validation_split} on "
+                    f"{len(x)} samples leaves an empty training or "
+                    f"validation set")
+            x, y, validation_data = x[:split], y[:split], \
+                (x[split:], y[split:])
+            if sample_weight is not None:
+                sample_weight = np.asarray(sample_weight)[:split]
         if not self._built:
             (first_x, _, _), _ = next(iter(self._batches(
                 x, y, batch_size=batch_size, shuffle=False)))
@@ -475,6 +501,35 @@ class Model:
         self._state["params"] = jax.tree_util.tree_map(
             lambda w, s: jax.device_put(jnp.asarray(w), s),
             weights, shardings)
+
+    def summary(self, print_fn=print):
+        """≙ keras Model.summary: per-top-level-module parameter counts
+        (shim models list their layers; plain flax modules list the
+        params tree's top-level groups)."""
+        if not self._built:
+            raise ValueError("build the model (or fit once) before "
+                             "summary()")
+        import numpy as _np
+        params = self._state["params"]
+        rows = []
+        for name, sub in (params.items() if hasattr(params, "items")
+                          else [("params", params)]):
+            n = sum(int(_np.prod(x.shape))
+                    for x in jax.tree_util.tree_leaves(sub))
+            rows.append((name, n))
+        width = max([len(r[0]) for r in rows] + [10]) + 2
+        print_fn(f"Model: {type(self).__name__}")
+        print_fn("-" * (width + 14))
+        for name, n in rows:
+            print_fn(f"{name:<{width}}{n:>12,}")
+        total = sum(n for _, n in rows)
+        n_state = sum(int(_np.prod(x.shape)) for x in
+                      jax.tree_util.tree_leaves(
+                          self._state.get("model_state", {})))
+        print_fn("-" * (width + 14))
+        print_fn(f"Total params: {total:,}")
+        if n_state:
+            print_fn(f"Non-trainable state: {n_state:,}")
 
     def save(self, filepath: str):
         """≙ keras Model.save (TFK/src/engine/training.py:2779):

@@ -204,3 +204,29 @@ def test_train_step_with_kernel_loss_impl():
         state, metrics = step(state, {"tokens": tokens})
         results[impl] = float(metrics["loss"])
     assert results["kernel"] == pytest.approx(results["scan"], rel=1e-5)
+
+
+def test_bert_mlm_kernel_loss_matches_classic():
+    """bert.make_train_step with loss_impl='kernel' routes the masked
+    CE through the fused-CE kernels and matches the full-logits MLM
+    path (the config is live, not a label)."""
+    import optax
+    from distributed_tensorflow_tpu.models import bert
+
+    losses = {}
+    for impl in ("scan", "kernel"):
+        cfg = bert.tiny_bert_config(
+            loss_impl=impl, loss_kernel_impl="interpret",
+            loss_block_n=32, loss_block_v=64)
+        model = transformer.TransformerLM(cfg)
+        batch = bert.synthetic_corpus(2, cfg.max_seq_len,
+                                      cfg.vocab_size, seed=1)
+        params = model.init(jax.random.PRNGKey(0),
+                            batch["tokens"])["params"]
+        tx = optax.sgd(1e-2)
+        state = {"params": params, "opt_state": tx.init(params),
+                 "step": jnp.zeros((), jnp.int32)}
+        step = jax.jit(bert.make_train_step(cfg, model, tx, seed=0))
+        _, metrics = step(state, batch)
+        losses[impl] = float(metrics["loss"])
+    assert losses["kernel"] == pytest.approx(losses["scan"], rel=1e-5)
