@@ -180,20 +180,21 @@ class ModelCheckpoint(Callback):
         self.best = np.inf if self.mode == "min" else -np.inf
 
     def on_train_begin(self, logs=None):
-        # Fail FAST when full-model saving can't work for this model
-        # kind (plain training.Model has no serializable architecture) —
-        # not after a full epoch of compute.
+        # Fail FAST when full-model saving can't work for this model —
+        # not after a full epoch of compute. Attempting the actual
+        # architecture serialization catches both unsupported model
+        # kinds (plain training.Model) AND unserializable layers
+        # (Lambda) up front.
         if not self.save_weights_only:
-            from distributed_tensorflow_tpu.training import functional
-            from distributed_tensorflow_tpu.training import layers
-            if not isinstance(self.model, layers.Sequential) and not (
-                    isinstance(self.model, functional.Model)
-                    and hasattr(self.model, "_graph_nodes")):
-                raise NotImplementedError(
-                    f"ModelCheckpoint(save_weights_only=False) needs a "
-                    f"shim Sequential/Functional model to serialize; "
-                    f"got {type(self.model).__name__} — pass "
-                    "save_weights_only=True")
+            from distributed_tensorflow_tpu.training.saving import (
+                model_config)
+            try:
+                model_config(self.model)
+            except (NotImplementedError, ValueError) as e:
+                raise type(e)(
+                    f"ModelCheckpoint(save_weights_only=False) cannot "
+                    f"serialize this model ({e}); pass "
+                    "save_weights_only=True") from e
 
     def on_epoch_end(self, epoch, logs=None):
         path = self.filepath.format(epoch=epoch + 1)

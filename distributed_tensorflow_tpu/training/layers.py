@@ -204,10 +204,33 @@ class Conv1D(Layer):
         return self.activation(x)
 
 
+class _DepthwiseModule(nn.Module):
+    """Grouped conv holding the kernel in the KERAS depthwise layout
+    (H, W, Cin, 1) so get/set_weights round-trips with tf_keras
+    (flax nn.Conv would store (H, W, 1, Cin))."""
+    kernel_size: tuple
+    strides: tuple
+    padding: str
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (*self.kernel_size, cin, 1))
+        y = jax.lax.conv_general_dilated(
+            x, jnp.transpose(kernel, (0, 1, 3, 2)).astype(x.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=cin)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (cin,))
+        return y
+
+
 class DepthwiseConv2D(Layer):
     """≙ keras DepthwiseConv2D (depth_multiplier=1): one filter per
-    input channel via flax's feature_group_count grouping; kernel kept
-    in the KERAS layout (H, W, Cin, 1)."""
+    input channel; kernel kept in the KERAS layout (H, W, Cin, 1)."""
 
     def __init__(self, kernel_size, strides=1, padding: str = "valid",
                  activation=None, use_bias: bool = True,
@@ -221,10 +244,9 @@ class DepthwiseConv2D(Layer):
         self.name = name
 
     def apply(self, x, *, train, module=None):
-        cin = x.shape[-1]
-        x = nn.Conv(cin, self.kernel_size, strides=self.strides,
-                    padding=self.padding, use_bias=self.use_bias,
-                    feature_group_count=cin, name=self.name)(x)
+        x = _DepthwiseModule(self.kernel_size, self.strides,
+                             self.padding, self.use_bias,
+                             name=self.name)(x)
         return self.activation(x)
 
 

@@ -16,6 +16,18 @@ import jax.numpy as jnp
 import optax
 
 
+def _align_ranks(y_true, y_pred):
+    """keras's rank alignment (losses_utils.squeeze_or_expand): a
+    trailing size-1 prediction dim pairs with rank-1-lower labels —
+    WITHOUT this, ``(B,) vs (B, 1)`` elementwise math silently
+    broadcasts to (B, B) and trains garbage."""
+    if y_true.ndim == y_pred.ndim - 1 and y_pred.shape[-1] == 1:
+        y_true = y_true[..., None]
+    elif y_pred.ndim == y_true.ndim - 1 and y_true.shape[-1] == 1:
+        y_pred = y_pred[..., None]
+    return y_true, y_pred
+
+
 class Loss:
     """Base loss: ``call`` returns per-example losses (batch leading)."""
 
@@ -63,6 +75,7 @@ class BinaryCrossentropy(Loss):
         self.from_logits = from_logits
 
     def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
         y = y_true.astype(jnp.float32)
         p = y_pred.astype(jnp.float32)
         if self.from_logits:
@@ -75,6 +88,7 @@ class BinaryCrossentropy(Loss):
 
 class MeanSquaredError(Loss):
     def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
         per = jnp.square(y_pred.astype(jnp.float32)
                          - y_true.astype(jnp.float32))
         return per.reshape(per.shape[0], -1).mean(axis=-1)
@@ -82,8 +96,53 @@ class MeanSquaredError(Loss):
 
 class MeanAbsoluteError(Loss):
     def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
         per = jnp.abs(y_pred.astype(jnp.float32)
                       - y_true.astype(jnp.float32))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class Huber(Loss):
+    """≙ keras Huber: quadratic below ``delta``, linear above."""
+
+    def __init__(self, delta: float = 1.0, name: str = "huber"):
+        super().__init__(name)
+        self.delta = float(delta)
+
+    def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
+        err = y_pred.astype(jnp.float32) - y_true.astype(jnp.float32)
+        a = jnp.abs(err)
+        per = jnp.where(a <= self.delta, 0.5 * jnp.square(err),
+                        self.delta * (a - 0.5 * self.delta))
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class Hinge(Loss):
+    """≙ keras Hinge: labels in {0,1} are mapped to {-1,1}."""
+
+    def __init__(self, name: str = "hinge"):
+        super().__init__(name)
+
+    def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
+        t = y_true.astype(jnp.float32)
+        t = jnp.where(t <= 0.0, -1.0, t)
+        per = jnp.maximum(1.0 - t * y_pred.astype(jnp.float32), 0.0)
+        return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+class KLDivergence(Loss):
+    """≙ keras KLDivergence: sum over classes of y·log(y/ŷ)."""
+
+    def __init__(self, name: str = "kl_divergence"):
+        super().__init__(name)
+
+    def call(self, y_true, y_pred):
+        y_true, y_pred = _align_ranks(y_true, y_pred)
+        y = jnp.clip(y_true.astype(jnp.float32), 1e-7, 1.0)
+        p = jnp.clip(y_pred.astype(jnp.float32), 1e-7, 1.0)
+        per = jnp.sum(y * jnp.log(y / p), axis=-1)
         return per.reshape(per.shape[0], -1).mean(axis=-1)
 
 
@@ -95,6 +154,10 @@ _ALIASES = {
     "mean_squared_error": MeanSquaredError,
     "mae": MeanAbsoluteError,
     "mean_absolute_error": MeanAbsoluteError,
+    "huber": Huber,
+    "hinge": Hinge,
+    "kld": KLDivergence,
+    "kl_divergence": KLDivergence,
 }
 
 

@@ -87,9 +87,88 @@ class BinaryAccuracy(Metric):
         self.from_logits = from_logits
 
     def _values(self, y_true, y_pred):
+        from distributed_tensorflow_tpu.training.losses import _align_ranks
+        y_true, y_pred = _align_ranks(y_true, y_pred)
         p = jax.nn.sigmoid(y_pred) if self.from_logits else y_pred
         pred = (p > self.threshold).astype(jnp.float32)
         return (pred == y_true.astype(jnp.float32)).astype(jnp.float32)
+
+
+class TopKCategoricalAccuracy(Metric):
+    """≙ keras (Sparse)TopKCategoricalAccuracy: hit iff the true class
+    is among the k highest-scoring predictions. Accepts sparse integer
+    OR one-hot labels (resolved by rank, like keras's sparse variant
+    pairing with the compiled loss)."""
+
+    def __init__(self, k: int = 5, name: str | None = None):
+        super().__init__(name or f"top_{k}_accuracy")
+        self.k = int(k)
+
+    def _values(self, y_true, y_pred):
+        if y_true.ndim == y_pred.ndim:
+            if y_true.shape[-1] == y_pred.shape[-1]:   # one-hot
+                y_true = jnp.argmax(y_true, axis=-1)
+            else:                                      # sparse (B, 1)
+                y_true = jnp.squeeze(y_true, axis=-1)
+        _, topk = jax.lax.top_k(y_pred, self.k)
+        hit = jnp.any(topk == y_true[..., None].astype(topk.dtype),
+                      axis=-1)
+        return hit.astype(jnp.float32)
+
+
+class _ConfusionMetric(Metric):
+    """Threshold-based confusion-count metric base (Precision/Recall):
+    state carries the relevant counts (SUM-reducible across replicas
+    and steps, ≙ keras's update_confusion_matrix_variables)."""
+
+    def __init__(self, name: str, threshold: float, from_logits: bool):
+        super().__init__(name)
+        self.threshold = float(threshold)
+        self.from_logits = from_logits
+
+    def init(self):
+        return {"true_pos": jnp.zeros((), jnp.float32),
+                "denom": jnp.zeros((), jnp.float32)}
+
+    def _pred(self, y_pred):
+        p = jax.nn.sigmoid(y_pred) if self.from_logits else y_pred
+        return (p > self.threshold).astype(jnp.float32)
+
+    def update(self, state, y_true, y_pred, sample_weight=None):
+        pred = self._pred(y_pred).reshape(y_pred.shape[0], -1)
+        true = jnp.asarray(y_true, jnp.float32).reshape(pred.shape)
+        if sample_weight is None:
+            w = jnp.ones((pred.shape[0], 1), jnp.float32)
+        else:
+            w = sample_weight.astype(jnp.float32).reshape(-1, 1)
+        tp = jnp.sum(pred * true * w)
+        denom = jnp.sum(self._denom_mask(true, pred) * w)
+        return {"true_pos": state["true_pos"] + tp,
+                "denom": state["denom"] + denom}
+
+    def result(self, state):
+        return state["true_pos"] / jnp.maximum(state["denom"], 1e-9)
+
+    def _denom_mask(self, true, pred):
+        raise NotImplementedError
+
+
+class Precision(_ConfusionMetric):
+    def __init__(self, name: str = "precision", threshold: float = 0.5,
+                 from_logits: bool = False):
+        super().__init__(name, threshold, from_logits)
+
+    def _denom_mask(self, true, pred):
+        return pred                                 # TP + FP
+
+
+class Recall(_ConfusionMetric):
+    def __init__(self, name: str = "recall", threshold: float = 0.5,
+                 from_logits: bool = False):
+        super().__init__(name, threshold, from_logits)
+
+    def _denom_mask(self, true, pred):
+        return true                                 # TP + FN
 
 
 class MeanMetricWrapper(Metric):
@@ -122,6 +201,10 @@ def get(identifier, *, loss=None) -> Metric:
         "sparse_categorical_accuracy": SparseCategoricalAccuracy,
         "categorical_accuracy": CategoricalAccuracy,
         "binary_accuracy": BinaryAccuracy,
+        "precision": Precision,
+        "recall": Recall,
+        "top_k_categorical_accuracy": TopKCategoricalAccuracy,
+        "sparse_top_k_categorical_accuracy": TopKCategoricalAccuracy,
     }
     if key in table:
         return table[key]()

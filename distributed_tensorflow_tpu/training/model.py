@@ -369,10 +369,13 @@ class Model:
                     f"validation_split={validation_split} on "
                     f"{len(x)} samples leaves an empty training or "
                     f"validation set")
-            x, y, validation_data = x[:split], y[:split], \
-                (x[split:], y[split:])
             if sample_weight is not None:
-                sample_weight = np.asarray(sample_weight)[:split]
+                sw = np.asarray(sample_weight)
+                validation_data = (x[split:], y[split:], sw[split:])
+                sample_weight = sw[:split]
+            else:
+                validation_data = (x[split:], y[split:])
+            x, y = x[:split], y[:split]
         if not self._built:
             (first_x, _, _), _ = next(iter(self._batches(
                 x, y, batch_size=batch_size, shuffle=False)))
@@ -422,7 +425,11 @@ class Model:
                     break
             logs = self._metric_results(mstate)
             if validation_data is not None:
-                val = self.evaluate(*validation_data,
+                # 2-tuple (x, y) or keras's 3-tuple (x, y, sample_weight)
+                vx, vy = validation_data[0], validation_data[1]
+                vsw = (validation_data[2]
+                       if len(validation_data) > 2 else None)
+                val = self.evaluate(vx, vy, sample_weight=vsw,
                                     batch_size=batch_size, verbose=0,
                                     return_dict=True)
                 logs.update({f"val_{k}": v for k, v in val.items()})

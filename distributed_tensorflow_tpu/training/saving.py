@@ -110,28 +110,34 @@ def _layer_from_record(rec: dict, layers_lib):
     return cls.from_config(rec["config"])
 
 
-def save_model(model, filepath: str) -> None:
-    """Serialize a shim Sequential or Functional: architecture +
-    weights."""
+def model_config(model) -> dict:
+    """Architecture-only serialization (raises for unsupported model
+    kinds or unserializable layers — used by ModelCheckpoint's
+    fail-fast check as well as save_model)."""
     from distributed_tensorflow_tpu.training import functional
     from distributed_tensorflow_tpu.training import layers as layers_lib
 
     if isinstance(model, layers_lib.Sequential):
-        config = {
+        return {
             "class_name": "Sequential",
             "config": {"layers": [
                 {"class_name": type(lyr).__name__,
                  "config": lyr.get_config()}
                 for lyr in model.layers]},
         }
-    elif isinstance(model, functional.Model) and hasattr(model,
-                                                         "_graph_nodes"):
-        config = _functional_config(model)
-    else:
-        raise NotImplementedError(
-            f"save_model supports shim Sequential and Functional "
-            f"models; got {type(model).__name__}. For other models use "
-            "save_weights/load_weights (weights only).")
+    if isinstance(model, functional.Model) and hasattr(model,
+                                                       "_graph_nodes"):
+        return _functional_config(model)
+    raise NotImplementedError(
+        f"save_model supports shim Sequential and Functional "
+        f"models; got {type(model).__name__}. For other models use "
+        "save_weights/load_weights (weights only).")
+
+
+def save_model(model, filepath: str) -> None:
+    """Serialize a shim Sequential or Functional: architecture +
+    weights."""
+    config = model_config(model)
     if not model._built:
         raise ValueError("build the model (or fit once) before save()")
     os.makedirs(filepath, exist_ok=True)

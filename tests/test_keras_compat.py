@@ -236,11 +236,10 @@ def test_depthwise_conv_matches_tf_keras(devices):
     tout = tf_keras.layers.DepthwiseConv2D(3, padding="same",
                                            name="dw")(ti)
     ref = tf_keras.Model(inputs=ti, outputs=tout)
-    k = np.asarray(model.params["dw"]["dw"]["kernel"])  # (3,3,2?,..)
+    k = np.asarray(model.params["dw"]["dw"]["kernel"])
     b = np.asarray(model.params["dw"]["dw"]["bias"])
-    # flax grouped-conv kernel (H, W, Cin/groups=1, Cout=Cin) ->
-    # keras depthwise kernel (H, W, Cin, 1)
-    ref.get_layer("dw").set_weights([k.reshape(3, 3, 2, 1), b])
+    assert k.shape == (3, 3, 2, 1)      # KERAS depthwise layout, as-is
+    ref.get_layer("dw").set_weights([k, b])
     x = np.random.default_rng(2).normal(size=(3, 6, 6, 2)) \
         .astype("float32")
     np.testing.assert_allclose(

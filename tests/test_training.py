@@ -243,3 +243,82 @@ def test_resnet_via_fit_under_tpu_strategy(devices):
     assert "loss" in res and np.isfinite(res["loss"])
     preds = model.predict(x[:40], batch_size=32)
     assert preds.shape == (40, cfg.num_classes)
+
+
+def test_new_metrics_and_losses_match_tf_keras(devices):
+    """Precision/Recall/TopK metrics and Huber/Hinge/KLD losses match
+    tf_keras numerics on random data."""
+    tf_keras = pytest.importorskip("tf_keras")
+    from distributed_tensorflow_tpu.training import (losses as L,
+                                                     metrics as M)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+
+    # binary metrics
+    y = (rng.random(64) > 0.6).astype("float32")
+    p = rng.random(64).astype("float32")
+    for ours, ref in ((M.Precision(), tf_keras.metrics.Precision()),
+                      (M.Recall(), tf_keras.metrics.Recall())):
+        st = ours.update(ours.init(), jnp.asarray(y), jnp.asarray(p))
+        ref.update_state(y, p)
+        np.testing.assert_allclose(float(ours.result(st)),
+                                   float(ref.result().numpy()),
+                                   rtol=1e-5)
+
+    # top-k
+    logits = rng.normal(size=(32, 10)).astype("float32")
+    labels = rng.integers(0, 10, 32).astype("int32")
+    ours = M.TopKCategoricalAccuracy(k=3)
+    st = ours.update(ours.init(), jnp.asarray(labels), jnp.asarray(logits))
+    ref = tf_keras.metrics.SparseTopKCategoricalAccuracy(k=3)
+    ref.update_state(labels, logits)
+    np.testing.assert_allclose(float(ours.result(st)),
+                               float(ref.result().numpy()), rtol=1e-6)
+
+    # losses (per-batch means)
+    yt = rng.normal(size=(16, 5)).astype("float32")
+    yp = rng.normal(size=(16, 5)).astype("float32")
+    probs_t = np.abs(yt) / np.abs(yt).sum(-1, keepdims=True)
+    probs_p = np.abs(yp) / np.abs(yp).sum(-1, keepdims=True)
+    cases = [
+        (L.Huber(delta=1.0), tf_keras.losses.Huber(), yt, yp),
+        (L.Hinge(), tf_keras.losses.Hinge(), (yt > 0).astype("float32"),
+         yp),
+        (L.KLDivergence(), tf_keras.losses.KLDivergence(), probs_t,
+         probs_p),
+    ]
+    for ours_l, ref_l, a, b in cases:
+        np.testing.assert_allclose(
+            float(ours_l.call(jnp.asarray(a), jnp.asarray(b)).mean()),
+            float(ref_l(a, b).numpy()), rtol=1e-5,
+            err_msg=type(ours_l).__name__)
+
+
+def test_binary_head_rank_alignment(devices):
+    """(B,) labels vs (B,1) sigmoid head must NOT broadcast to (B,B)
+    (keras losses_utils.squeeze_or_expand semantics): the model must
+    actually learn a separable binary task."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 10)).astype("float32")
+    y = (x.sum(-1) > 0).astype("float32")
+    from distributed_tensorflow_tpu import keras
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model = keras.Sequential([
+            keras.Input((10,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(1, activation="sigmoid"),
+        ])
+        model.compile(
+            optimizer="adam", learning_rate=3e-2,
+            loss=keras.losses.BinaryCrossentropy(from_logits=False),
+            metrics=["precision", "recall"])
+    model.fit(x, y, batch_size=64, epochs=10, verbose=0)
+    res = model.evaluate(x, y, batch_size=64, return_dict=True)
+    assert res["precision"] > 0.9 and res["recall"] > 0.9, res
+    # loss itself: per-example shape stays (B,)
+    from distributed_tensorflow_tpu.training import losses as L
+    import jax.numpy as jnp
+    per = L.BinaryCrossentropy(from_logits=False).call(
+        jnp.asarray(y), jnp.asarray(rng.random((256, 1)), jnp.float32))
+    assert per.shape == (256,)
