@@ -83,5 +83,36 @@ class _Models:
 
 models = _Models()
 
+
+class _Utils:
+    """≙ tf_keras.utils — the helpers reference scripts actually call."""
+
+    @staticmethod
+    def to_categorical(y, num_classes=None, dtype="float32"):
+        import numpy as np
+        y = np.asarray(y, dtype="int64")
+        shape = y.shape
+        flat = y.reshape(-1)
+        n = int(num_classes) if num_classes else int(flat.max()) + 1
+        out = np.zeros((flat.shape[0], n), dtype=dtype)
+        out[np.arange(flat.shape[0]), flat] = 1
+        return out.reshape(*shape, n)       # keras: input shape + (C,)
+
+    @staticmethod
+    def set_random_seed(seed: int):
+        import random
+
+        import numpy as np
+        random.seed(seed)
+        np.random.seed(seed)
+
+    @staticmethod
+    def plot_model(model, *a, **kw):
+        raise NotImplementedError(
+            "plot_model needs graphviz; use model.summary() instead")
+
+
+utils = _Utils()
+
 __all__ = ["layers", "losses", "metrics", "callbacks", "optimizers",
-           "models", "Model", "Sequential", "Input"]
+           "models", "utils", "Model", "Sequential", "Input"]

@@ -110,7 +110,9 @@ class TopKCategoricalAccuracy(Metric):
                 y_true = jnp.argmax(y_true, axis=-1)
             else:                                      # sparse (B, 1)
                 y_true = jnp.squeeze(y_true, axis=-1)
-        _, topk = jax.lax.top_k(y_pred, self.k)
+        # k >= num_classes: everything is in the top k (tf in_top_k)
+        k = min(self.k, y_pred.shape[-1])
+        _, topk = jax.lax.top_k(y_pred, k)
         hit = jnp.any(topk == y_true[..., None].astype(topk.dtype),
                       axis=-1)
         return hit.astype(jnp.float32)
@@ -207,5 +209,9 @@ def get(identifier, *, loss=None) -> Metric:
         "sparse_top_k_categorical_accuracy": TopKCategoricalAccuracy,
     }
     if key in table:
-        return table[key]()
+        metric = table[key]()
+        # history/monitor keys must equal the compiled string (tf_keras
+        # names the metric exactly what compile() was given)
+        metric.name = key
+        return metric
     raise ValueError(f"Unknown metric: {identifier!r}")

@@ -344,10 +344,12 @@ class Model:
             validation_data=None, validation_split: float = 0.0,
             shuffle: bool = True,
             initial_epoch: int = 0, steps_per_epoch: int | None = None,
-            sample_weight=None):
+            sample_weight=None, class_weight=None):
         """≙ Model.fit (tf_keras training.py:1453). ``validation_split``
         holds out the LAST fraction of (x, y) before shuffling, like
-        keras (training.py train_validation_split)."""
+        keras (training.py train_validation_split); ``class_weight``
+        maps class index -> weight, multiplied into sample_weight
+        (keras class_weight semantics, sparse integer labels)."""
         if not self._compiled:
             raise RuntimeError("compile() the model before fit()")
         if validation_split:
@@ -376,6 +378,25 @@ class Model:
             else:
                 validation_data = (x[split:], y[split:])
             x, y = x[:split], y[:split]
+        if class_weight:
+            # AFTER the validation split: keras applies class_weight to
+            # TRAINING batches only (val_loss stays unweighted).
+            if y is None:
+                raise ValueError(
+                    "class_weight requires array labels (x, y)")
+            y_arr = np.asarray(y)
+            if y_arr.ndim > 1:        # one-hot -> sparse for lookup
+                y_arr = np.argmax(y_arr, axis=-1)
+            cw = np.ones(int(y_arr.max()) + 1, np.float32)
+            for cls, w in class_weight.items():
+                if int(cls) >= len(cw):
+                    cw = np.concatenate(
+                        [cw, np.ones(int(cls) + 1 - len(cw), np.float32)])
+                cw[int(cls)] = w
+            per_sample = cw[y_arr.astype(np.int64)]
+            sample_weight = (per_sample if sample_weight is None
+                             else np.asarray(sample_weight, np.float32)
+                             * per_sample)
         if not self._built:
             (first_x, _, _), _ = next(iter(self._batches(
                 x, y, batch_size=batch_size, shuffle=False)))
