@@ -493,9 +493,31 @@ class Model:
                                     for m in self._metrics]
 
     def predict(self, x, *, batch_size: int = 32) -> Any:
+        """≙ Model.predict. Accepts an array OR a pre-batched Dataset /
+        iterable of input batches (keras predict(dataset) semantics —
+        elements may be bare inputs or (x, ...) tuples whose first
+        entry is the input)."""
         if not self._built:
             raise RuntimeError("build the model before predict()")
         predict_fn = self._make_predict_function()
+        if isinstance(x, Dataset) or not isinstance(
+                x, (np.ndarray, jnp.ndarray, list, tuple)):
+            outs = []
+            static = None
+            for el in Dataset.from_iterable(x):
+                bx = el[0] if isinstance(el, (tuple, list)) else el
+                bx = np.asarray(bx)
+                n = len(bx)
+                if static is None:
+                    static = n
+                if n < static:
+                    width = [(0, static - n)] + [(0, 0)] * (bx.ndim - 1)
+                    bx = np.pad(bx, width)
+                preds = predict_fn(self._state["params"],
+                                   self._state.get("model_state", {}),
+                                   self._place(bx))
+                outs.append(np.asarray(preds)[:n])
+            return np.concatenate(outs, axis=0)
         outs, total = [], 0
         x = np.asarray(x)
         for start in range(0, len(x), batch_size):

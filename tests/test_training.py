@@ -390,3 +390,14 @@ def test_metric_name_matches_compile_string(devices):
     oh = keras.utils.to_categorical(
         np.zeros((2, 3), np.int64), num_classes=4)
     assert oh.shape == (2, 3, 4)     # keras: input shape + (C,)
+
+
+def test_predict_on_prebatched_dataset(devices):
+    x, y = make_data(seed=13)
+    from distributed_tensorflow_tpu.input.dataset import Dataset
+    model = compiled_model(OneDeviceStrategy())
+    model.fit(x, y, epochs=1, batch_size=64, verbose=0)
+    ds = Dataset.from_tensor_slices((x, y)).batch(64)
+    preds = model.predict(ds)
+    np.testing.assert_allclose(
+        preds, model.predict(x, batch_size=64), rtol=1e-6)
