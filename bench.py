@@ -269,7 +269,10 @@ def main():
                                                 loss_impl="kernel",
                                                 attn_block_q=1024,
                                                 attn_block_k=1024)
-        batch, n_iters, reps = 8, 12, 5
+        # n_iters/reps sized for the pooled-tunnel variance: the
+        # min-of-reps delta estimator converges with more reps (r5
+        # sessions saw ±0.015 MFU run-to-run at reps=5).
+        batch, n_iters, reps = 8, 12, 8
     else:  # local smoke run
         cfg = TransformerConfig.tiny()
         batch, n_iters, reps = 8, 5, 2
