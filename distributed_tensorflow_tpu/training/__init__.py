@@ -10,15 +10,19 @@ from distributed_tensorflow_tpu.training import callbacks
 from distributed_tensorflow_tpu.training.callbacks import (
     BackupAndRestore,
     Callback,
+    CSVLogger,
     EarlyStopping,
     History,
     LearningRateScheduler,
     ModelCheckpoint,
+    ReduceLROnPlateau,
     TensorBoard,
+    TerminateOnNaN,
 )
 
 __all__ = [
     "Model", "losses", "metrics", "callbacks", "Callback", "History",
     "EarlyStopping", "ModelCheckpoint", "LearningRateScheduler",
-    "BackupAndRestore", "TensorBoard",
+    "BackupAndRestore", "TensorBoard", "ReduceLROnPlateau",
+    "CSVLogger", "TerminateOnNaN",
 ]

@@ -18,6 +18,12 @@ import numpy as np
 class Callback:
     """Base callback (≙ tf_keras Callback). Overridable hooks only."""
 
+    #: How often this callback needs per-batch LOGS. Computing batch
+    #: logs materializes every metric on the host (a device sync that
+    #: defeats async dispatch), so Model.fit only builds them on steps
+    #: where some overriding callback's interval divides the step.
+    batch_log_interval = 1
+
     def __init__(self):
         self.model = None
         self.params = {}
@@ -117,6 +123,121 @@ def _improved(current, best, mode: str, min_delta: float) -> bool:
     if mode == "min":
         return current < best - min_delta
     return current > best + min_delta
+
+
+class ReduceLROnPlateau(Callback):
+    """≙ tf_keras ReduceLROnPlateau: multiply the (mutable) learning
+    rate by ``factor`` after ``patience`` epochs without monitored
+    improvement; stop at ``min_lr``; ``cooldown`` epochs pause the
+    patience counter after each reduction."""
+
+    def __init__(self, monitor="val_loss", factor=0.1, patience=10,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0,
+                 verbose=0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau requires factor < 1.0")
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        self.verbose = verbose
+        self._reset()
+
+    def _reset(self):
+        self.best = np.inf if self.mode == "min" else -np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()       # reusable across fit() calls, like keras
+
+    def on_epoch_end(self, epoch, logs=None):
+        current = (logs or {}).get(self.monitor)
+        if current is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if _improved(current, self.best, self.mode, self.min_delta):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                old = self.model.learning_rate
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    self.model.learning_rate = new
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: epoch {epoch + 1}: "
+                              f"lr -> {new:.3e}", flush=True)
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class CSVLogger(Callback):
+    """≙ tf_keras CSVLogger: one row of epoch logs per epoch."""
+
+    def __init__(self, filename, separator=",", append=False):
+        super().__init__()
+        self.filename = str(filename)
+        self.sep = separator
+        self.append = append
+        self._file = None
+        self._keys = None
+
+    def on_train_begin(self, logs=None):
+        import os
+        # append mode resumes an existing file WITHOUT a second header
+        # (tf_keras checks existing content the same way)
+        has_content = (self.append and os.path.exists(self.filename)
+                       and os.path.getsize(self.filename) > 0)
+        self._file = open(self.filename,
+                          "a" if self.append else "w", buffering=1)
+        self._keys = None
+        self._header_written = has_content
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = dict(logs or {})
+        if self._keys is None:
+            self._keys = sorted(logs)
+            if not self._header_written:
+                self._file.write(
+                    self.sep.join(["epoch"] + self._keys) + "\n")
+                self._header_written = True
+        row = [str(epoch)] + [f"{logs.get(k, '')}" for k in self._keys]
+        self._file.write(self.sep.join(row) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class TerminateOnNaN(Callback):
+    """≙ tf_keras TerminateOnNaN: stop training on a NaN/inf running
+    loss. Checks every ``check_every`` batches (default 10) instead of
+    every batch: the epoch loss metric is a running mean, so one NaN
+    batch poisons it permanently and a sparse check still catches it
+    within ``check_every`` steps — without forcing the per-batch
+    host-device metric sync that defeats async dispatch."""
+
+    def __init__(self, check_every: int = 10):
+        super().__init__()
+        self.batch_log_interval = max(1, int(check_every))
+
+    def on_train_batch_end(self, batch, logs=None):
+        loss = (logs or {}).get("loss")
+        if loss is not None and not np.isfinite(loss):
+            print(f"TerminateOnNaN: batch {batch}: invalid loss "
+                  f"{loss}, terminating", flush=True)
+            self.model.stop_training = True
 
 
 class EarlyStopping(Callback):

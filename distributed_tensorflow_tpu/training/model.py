@@ -414,10 +414,14 @@ class Model:
             cbs, self, {"epochs": epochs, "batch_size": batch_size})
 
         train_fn = self._make_train_function()
-        want_batch_logs = any(
-            type(cb).on_train_batch_end
-            is not callbacks_lib.Callback.on_train_batch_end
-            for cb in cb_list.callbacks)
+        # Batch logs materialize every metric on the host (a device
+        # sync per step); only build them on steps some overriding
+        # callback wants, at its declared interval.
+        log_intervals = [
+            cb.batch_log_interval for cb in cb_list.callbacks
+            if type(cb).on_train_batch_end
+            is not callbacks_lib.Callback.on_train_batch_end]
+        batch_log_every = min(log_intervals) if log_intervals else 0
 
         cb_list.on_train_begin()
         start_epoch = initial_epoch
@@ -436,13 +440,15 @@ class Model:
                 cb_list.on_train_batch_begin(steps)
                 self._state, mstate = train_fn(
                     self._state, mstate, self._place(batch), full)
-                if want_batch_logs:
+                if batch_log_every and steps % batch_log_every == 0:
                     cb_list.on_train_batch_end(
                         steps, self._metric_results(mstate))
                 else:
                     cb_list.on_train_batch_end(steps, None)
                 steps += 1
                 if steps_per_epoch and steps >= steps_per_epoch:
+                    break
+                if self.stop_training:      # e.g. TerminateOnNaN
                     break
             logs = self._metric_results(mstate)
             if validation_data is not None:

@@ -401,3 +401,53 @@ def test_predict_on_prebatched_dataset(devices):
     preds = model.predict(ds)
     np.testing.assert_allclose(
         preds, model.predict(x, batch_size=64), rtol=1e-6)
+
+
+def test_reduce_lr_on_plateau_csv_logger_terminate_on_nan(devices,
+                                                          tmp_path):
+    """ReduceLROnPlateau halves lr after patience epochs without
+    improvement; CSVLogger writes one row per epoch; TerminateOnNaN
+    stops on divergence."""
+    from distributed_tensorflow_tpu.training import (
+        CSVLogger, ReduceLROnPlateau, TerminateOnNaN)
+    x, y = make_data(seed=17)
+    model = compiled_model(OneDeviceStrategy(), lr=1e-8)  # ~no progress
+    csv_path = tmp_path / "log.csv"
+    model.fit(x, y, epochs=4, batch_size=64, verbose=0,
+              callbacks=[
+                  ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                    patience=1, min_delta=10.0),
+                  CSVLogger(str(csv_path))])
+    # patience=1 with an unimprovable min_delta: lr halves epochs 2..4
+    np.testing.assert_allclose(model.learning_rate, 1e-8 * 0.5 ** 3,
+                               rtol=1e-4)
+    lines = csv_path.read_text().strip().splitlines()
+    assert lines[0].startswith("epoch,") and len(lines) == 5
+
+    # TerminateOnNaN: diverge with a huge lr
+    model2 = compiled_model(OneDeviceStrategy(), lr=1e18)
+    h = model2.fit(x, y, epochs=5, batch_size=64, verbose=0,
+                   callbacks=[TerminateOnNaN()])
+    assert len(h.epoch) < 5 or model2.stop_training
+
+
+def test_csv_logger_append_and_plateau_reuse(devices, tmp_path):
+    """CSVLogger(append=True) resumes without a duplicate header;
+    ReduceLROnPlateau resets its state across fit() calls."""
+    from distributed_tensorflow_tpu.training import (CSVLogger,
+                                                     ReduceLROnPlateau)
+    x, y = make_data(seed=19)
+    model = compiled_model(OneDeviceStrategy(), lr=1e-8)
+    csv = tmp_path / "resume.csv"
+    plateau = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                min_delta=10.0)
+    for _ in range(2):
+        model.fit(x, y, epochs=2, batch_size=64, verbose=0,
+                  callbacks=[plateau, CSVLogger(str(csv), append=True)])
+    lines = csv.read_text().strip().splitlines()
+    assert sum(1 for ln in lines if ln.startswith("epoch,")) == 1
+    assert len(lines) == 5          # 1 header + 4 epoch rows
+    # patience=1 per 2-epoch fit with state RESET between fits:
+    # each fit cuts exactly once at its second epoch -> 2 cuts total
+    np.testing.assert_allclose(model.learning_rate, 1e-8 * 0.25,
+                               rtol=1e-4)
