@@ -521,6 +521,222 @@ class MultiHeadAttention(Layer):
                                name="attention_output")(o)
 
 
+class _LSTMModule(nn.Module):
+    """LSTM with the KERAS parameter layout: ``kernel`` (D, 4H),
+    ``recurrent_kernel`` (H, 4H), ``bias`` (4H,), gate order
+    [i, f, c, o], unit_forget_bias init (forget bias starts at 1) —
+    so get/set_weights round-trips with tf_keras LSTM
+    (TFK/src/layers/rnn/lstm.py). Time loop via lax.scan."""
+    units: int
+    use_bias: bool
+    unit_forget_bias: bool
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        H = self.units
+        kernel = self.param("kernel", nn.initializers.glorot_uniform(),
+                            (D, 4 * H))
+        rec = self.param("recurrent_kernel",
+                         nn.initializers.orthogonal(), (H, 4 * H))
+        if self.use_bias:
+            if self.unit_forget_bias:
+                def bias_init(key, shape, dtype=jnp.float32):
+                    return jnp.concatenate([
+                        jnp.zeros((H,), dtype), jnp.ones((H,), dtype),
+                        jnp.zeros((2 * H,), dtype)])
+                bias = self.param("bias", bias_init, (4 * H,))
+            else:
+                bias = self.param("bias", nn.initializers.zeros,
+                                  (4 * H,))
+        else:
+            bias = None
+
+        xz = jnp.einsum("btd,dh->bth", x, kernel)
+        if bias is not None:
+            xz = xz + bias
+
+        def step(carry, zt):
+            h, c = carry
+            z = zt + h @ rec
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i = nn.sigmoid(i)
+            f = nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = nn.sigmoid(o)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        init = (jnp.zeros((B, H), xz.dtype), jnp.zeros((B, H), xz.dtype))
+        (h_last, c_last), hs = jax.lax.scan(step, init,
+                                            xz.swapaxes(0, 1))
+        return hs.swapaxes(0, 1), h_last, c_last
+
+
+class LSTM(Layer):
+    """≙ keras.layers.LSTM (default activations; keras weight layout —
+    see _LSTMModule). ``return_sequences``/``return_state`` supported."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 return_state: bool = False, use_bias: bool = True,
+                 unit_forget_bias: bool = True, name: str | None = None):
+        self.units = int(units)
+        self.return_sequences = return_sequences
+        self.return_state = return_state
+        self.use_bias = use_bias
+        self.unit_forget_bias = unit_forget_bias
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        seq, h, c = _LSTMModule(self.units, self.use_bias,
+                                self.unit_forget_bias,
+                                name=self.name)(x)
+        out = seq if self.return_sequences else h
+        if self.return_state:
+            return [out, h, c]
+        return out
+
+
+class _GRUModule(nn.Module):
+    """GRU with the KERAS v2 layout (reset_after=True): ``kernel``
+    (D, 3H), ``recurrent_kernel`` (H, 3H), ``bias`` (2, 3H) [input row,
+    recurrent row], gate order [z, r, h]
+    (TFK/src/layers/rnn/gru.py)."""
+    units: int
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        H = self.units
+        kernel = self.param("kernel", nn.initializers.glorot_uniform(),
+                            (D, 3 * H))
+        rec = self.param("recurrent_kernel",
+                         nn.initializers.orthogonal(), (H, 3 * H))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (2, 3 * H))
+            b_in, b_rec = bias[0], bias[1]
+        else:
+            b_in = b_rec = jnp.zeros((3 * H,), x.dtype)
+
+        xz = jnp.einsum("btd,dh->bth", x, kernel) + b_in
+
+        def step(h, zt):
+            hz = h @ rec + b_rec
+            xz_z, xz_r, xz_h = jnp.split(zt, 3, axis=-1)
+            hz_z, hz_r, hz_h = jnp.split(hz, 3, axis=-1)
+            z = nn.sigmoid(xz_z + hz_z)
+            r = nn.sigmoid(xz_r + hz_r)
+            hh = jnp.tanh(xz_h + r * hz_h)     # reset_after semantics
+            h2 = z * h + (1.0 - z) * hh
+            return h2, h2
+
+        h_last, hs = jax.lax.scan(
+            step, jnp.zeros((B, H), xz.dtype), xz.swapaxes(0, 1))
+        return hs.swapaxes(0, 1), h_last
+
+
+class GRU(Layer):
+    """≙ keras.layers.GRU (v2 defaults: reset_after=True, keras weight
+    layout — see _GRUModule)."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 return_state: bool = False, use_bias: bool = True,
+                 name: str | None = None):
+        self.units = int(units)
+        self.return_sequences = return_sequences
+        self.return_state = return_state
+        self.use_bias = use_bias
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        seq, h = _GRUModule(self.units, self.use_bias,
+                            name=self.name)(x)
+        out = seq if self.return_sequences else h
+        if self.return_state:
+            return [out, h]
+        return out
+
+
+class _SimpleRNNModule(nn.Module):
+    """Vanilla RNN, keras layout: kernel (D, H), recurrent_kernel
+    (H, H), bias (H,) (TFK/src/layers/rnn/simple_rnn.py)."""
+    units: int
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, D = x.shape
+        H = self.units
+        kernel = self.param("kernel", nn.initializers.glorot_uniform(),
+                            (D, H))
+        rec = self.param("recurrent_kernel",
+                         nn.initializers.orthogonal(), (H, H))
+        xz = jnp.einsum("btd,dh->bth", x, kernel)
+        if self.use_bias:
+            xz = xz + self.param("bias", nn.initializers.zeros, (H,))
+
+        def step(h, zt):
+            h2 = jnp.tanh(zt + h @ rec)
+            return h2, h2
+
+        h_last, hs = jax.lax.scan(
+            step, jnp.zeros((B, H), xz.dtype), xz.swapaxes(0, 1))
+        return hs.swapaxes(0, 1), h_last
+
+
+class SimpleRNN(Layer):
+    """≙ keras.layers.SimpleRNN (tanh)."""
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 use_bias: bool = True, name: str | None = None):
+        self.units = int(units)
+        self.return_sequences = return_sequences
+        self.use_bias = use_bias
+        self.name = name
+
+    def apply(self, x, *, train, module=None):
+        seq, h = _SimpleRNNModule(self.units, self.use_bias,
+                                  name=self.name)(x)
+        return seq if self.return_sequences else h
+
+
+class Bidirectional(Layer):
+    """≙ keras.layers.Bidirectional (concat merge) over a shim RNN
+    layer. The wrapped layer's config is duplicated for the backward
+    direction (independent weights, like keras)."""
+
+    def __init__(self, layer, merge_mode: str = "concat"):
+        if not isinstance(layer, (LSTM, GRU, SimpleRNN)):
+            raise TypeError(
+                "Bidirectional wraps a shim LSTM/GRU/SimpleRNN layer")
+        if merge_mode != "concat":
+            raise NotImplementedError(
+                "Bidirectional supports merge_mode='concat'")
+        self.layer = layer
+        self.backward_layer = type(layer).from_config(layer.get_config())
+        self.backward_layer.name = (layer.name + "_backward"
+                                    if layer.name else None)
+        self.merge_mode = merge_mode
+
+    def apply(self, x, *, train, module=None):
+        fwd = self.layer.apply(x, train=train, module=module)
+        bwd = self.backward_layer.apply(x[:, ::-1], train=train,
+                                        module=module)
+        if self.layer.return_sequences:
+            bwd = bwd[:, ::-1]
+        if isinstance(fwd, list):          # return_state
+            return [jnp.concatenate([fwd[0], bwd[0]], axis=-1),
+                    *fwd[1:], *bwd[1:]]
+        return jnp.concatenate([fwd, bwd], axis=-1)
+
+    def get_config(self):
+        raise ValueError(
+            "Bidirectional serialization is not supported; rebuild in "
+            "code and use load_weights")
+
+
 class _SequentialModule(nn.Module):
     """One flax module applying the shim layers in order."""
     layer_stack: tuple
