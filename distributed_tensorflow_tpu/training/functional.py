@@ -31,20 +31,28 @@ from distributed_tensorflow_tpu.training.model import Model as _TrainModel
 class SymbolicTensor:
     """A node in the functional graph (≙ KerasTensor). ``layer`` is None
     for graph inputs; ``call_args`` preserves the structure the layer
-    was called with (a single tensor, a list, ...)."""
+    was called with (a single tensor, a list, ...). A multi-output
+    layer call (e.g. ``LSTM(return_state=True)``) produces ALIAS
+    tensors carrying ``source`` (the producing call node) and
+    ``index`` into its output list."""
 
     _ids = itertools.count()
 
     def __init__(self, *, shape=None, dtype="float32", layer=None,
-                 call_args=None, name=None):
+                 call_args=None, name=None, source=None, index=0):
         self.shape = tuple(shape) if shape is not None else None
         self.dtype = dtype
         self.layer = layer
         self.call_args = call_args
         self.name = name
+        self.source = source
+        self.index = index
         self.uid = next(self._ids)
 
     def __repr__(self):
+        if self.source is not None:
+            return (f"<SymbolicTensor {self.uid} = output {self.index} "
+                    f"of node {self.source.uid}>")
         src = "Input" if self.layer is None else type(self.layer).__name__
         return f"<SymbolicTensor {self.uid} from {src}>"
 
@@ -67,9 +75,15 @@ def is_symbolic(args) -> bool:
     return bool(_sym_leaves(args))
 
 
-def symbolic_call(layer, args) -> SymbolicTensor:
-    """Record layer(args) as a graph node (called by Layer.__call__)."""
-    return SymbolicTensor(layer=layer, call_args=args)
+def symbolic_call(layer, args):
+    """Record layer(args) as a graph node (called by Layer.__call__).
+    A layer declaring ``symbolic_outputs > 1`` returns a LIST of alias
+    tensors — the keras ``out, h, c = LSTM(...)(x)`` unpack idiom."""
+    node = SymbolicTensor(layer=layer, call_args=args)
+    n = getattr(layer, "symbolic_outputs", 1)
+    if n == 1:
+        return node
+    return [SymbolicTensor(source=node, index=i) for i in range(n)]
 
 
 def _keras_auto_name(layer) -> str:
@@ -110,6 +124,12 @@ class _FunctionalModule(nn.Module):
                 f"model expects {len(self.input_nodes)} inputs, "
                 f"got {len(xs)}")
         memo = {inp.uid: v for inp, v in zip(self.input_nodes, xs)}
+
+        def resolve(s):
+            if s.source is not None:        # alias into a multi-output
+                return memo[s.source.uid][s.index]
+            return memo[s.uid]
+
         mods = {}
         for node in self.nodes:
             key = id(node.layer)
@@ -118,12 +138,12 @@ class _FunctionalModule(nn.Module):
                                          train=self.train,
                                          name=self.layer_names[key])
             args = jax.tree_util.tree_map(
-                lambda s: memo[s.uid] if isinstance(s, SymbolicTensor)
+                lambda s: resolve(s) if isinstance(s, SymbolicTensor)
                 else s,
                 node.call_args,
                 is_leaf=lambda s: isinstance(s, SymbolicTensor))
             memo[node.uid] = mods[key](args)
-        outs = [memo[o.uid] for o in self.output_nodes]
+        outs = [resolve(o) for o in self.output_nodes]
         return outs[0] if len(self.output_nodes) == 1 else tuple(outs)
 
 
@@ -140,6 +160,10 @@ def _toposort(inputs: Sequence[SymbolicTensor],
             return
         if node.uid in input_ids:
             seen.add(node.uid)
+            return
+        if node.source is not None:         # alias -> visit producer
+            seen.add(node.uid)
+            visit(node.source)
             return
         if node.layer is None:
             raise ValueError(

@@ -76,6 +76,13 @@ class Layer:
     #: differently in training
     has_train_behavior = False
 
+    @property
+    def symbolic_outputs(self) -> int:
+        """Number of outputs a symbolic call yields (1 for almost all
+        layers; RNNs with return_state=True return [out, *states] and
+        declare it here so ``out, h, c = lstm(x)`` unpacks)."""
+        return 1
+
     def apply(self, x, *, train: bool, module=None):
         raise NotImplementedError
 
@@ -588,6 +595,10 @@ class LSTM(Layer):
         self.unit_forget_bias = unit_forget_bias
         self.name = name
 
+    @property
+    def symbolic_outputs(self):
+        return 3 if self.return_state else 1
+
     def apply(self, x, *, train, module=None):
         seq, h, c = _LSTMModule(self.units, self.use_bias,
                                 self.unit_forget_bias,
@@ -649,6 +660,10 @@ class GRU(Layer):
         self.return_state = return_state
         self.use_bias = use_bias
         self.name = name
+
+    @property
+    def symbolic_outputs(self):
+        return 2 if self.return_state else 1
 
     def apply(self, x, *, train, module=None):
         seq, h = _GRUModule(self.units, self.use_bias,
@@ -720,15 +735,23 @@ class Bidirectional(Layer):
                                     if layer.name else None)
         self.merge_mode = merge_mode
 
+    @property
+    def symbolic_outputs(self):
+        n = self.layer.symbolic_outputs
+        return 1 if n == 1 else 1 + 2 * (n - 1)   # out + fwd/bwd states
+
     def apply(self, x, *, train, module=None):
         fwd = self.layer.apply(x, train=train, module=module)
         bwd = self.backward_layer.apply(x[:, ::-1], train=train,
                                         module=module)
+        if isinstance(fwd, list):          # return_state
+            out_b = bwd[0]
+            if self.layer.return_sequences:
+                out_b = out_b[:, ::-1]
+            return [jnp.concatenate([fwd[0], out_b], axis=-1),
+                    *fwd[1:], *bwd[1:]]
         if self.layer.return_sequences:
             bwd = bwd[:, ::-1]
-        if isinstance(fwd, list):          # return_state
-            return [jnp.concatenate([fwd[0], bwd[0]], axis=-1),
-                    *fwd[1:], *bwd[1:]]
         return jnp.concatenate([fwd, bwd], axis=-1)
 
     def get_config(self):
@@ -775,6 +798,11 @@ class Sequential(Model):
                 f"Sequential expects shim layers "
                 f"(distributed_tensorflow_tpu.keras.layers), got "
                 f"{type(lyr).__name__}")
+        if lyr.symbolic_outputs != 1:
+            raise ValueError(
+                f"{type(lyr).__name__} with return_state=True has "
+                "multiple outputs; Sequential layers must have exactly "
+                "one output (use the functional API)")
         return lyr
 
     def __init__(self, layers: Sequence[Layer] | None = None, *,

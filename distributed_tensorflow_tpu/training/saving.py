@@ -30,6 +30,9 @@ def _encode_args(args, node_ids):
     from distributed_tensorflow_tpu.training.functional import (
         SymbolicTensor)
     if isinstance(args, SymbolicTensor):
+        if args.source is not None:     # output i of a multi-output call
+            return {"__node_out__": [node_ids[args.source.uid],
+                                     args.index]}
         return {"__node__": node_ids[args.uid]}
     if isinstance(args, tuple):
         return {"__tuple__": [_encode_args(a, node_ids) for a in args]}
@@ -44,6 +47,9 @@ def _encode_args(args, node_ids):
 def _decode_args(enc, nodes):
     if isinstance(enc, dict) and "__node__" in enc:
         return nodes[enc["__node__"]]
+    if isinstance(enc, dict) and "__node_out__" in enc:
+        i, idx = enc["__node_out__"]
+        return nodes[i][idx]
     if isinstance(enc, dict) and "__tuple__" in enc:
         return tuple(_decode_args(a, nodes) for a in enc["__tuple__"])
     if isinstance(enc, list):
@@ -74,7 +80,8 @@ def _functional_config(model) -> dict:
             "inputs": [{"shape": list(i.shape), "dtype": str(i.dtype)}
                        for i in model.inputs],
             "nodes": nodes,
-            "outputs": [node_ids[o.uid] for o in model.outputs],
+            "outputs": [_encode_args(o, node_ids)
+                        for o in model.outputs],
         },
     }
 
@@ -95,7 +102,7 @@ def _rebuild_functional(config: dict):
         # else was a single argument (tensor or list of tensors)
         nodes.append(layer(*args) if isinstance(args, tuple)
                      else layer(args))
-    outputs = [nodes[i] for i in config["outputs"]]
+    outputs = [_decode_args(o, nodes) for o in config["outputs"]]
     return keras.Model(inputs=inputs if len(inputs) > 1 else inputs[0],
                        outputs=outputs if len(outputs) > 1 else outputs[0])
 

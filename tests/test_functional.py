@@ -188,3 +188,55 @@ def test_resnet50_script_architecture_builds_and_steps(devices):
     y = np.arange(8, dtype="int32") % 5
     h = model.fit(x, y, batch_size=8, epochs=1, verbose=0)
     assert np.isfinite(h.history["loss"][0])
+
+
+def test_rnn_return_state_unpack_and_save(devices, tmp_path):
+    """The keras encoder idiom ``out, h, c = LSTM(return_state=True)(x)``
+    unpacks symbolically; alias outputs flow through the graph, into
+    Model outputs, and survive save/load."""
+    import jax.numpy as jnp
+    inp = keras.Input(shape=(6, 4))
+    out, h, c = keras.layers.LSTM(5, return_sequences=True,
+                                  return_state=True, name="enc")(inp)
+    merged = keras.layers.Concatenate()([h, c])
+    pred = keras.layers.Dense(3, name="head")(merged)
+    strategy = dtx.OneDeviceStrategy()
+    with strategy.scope():
+        model = keras.Model(inputs=inp, outputs=pred)
+        model.compile(optimizer="adam", learning_rate=1e-2,
+                      loss="sparse_categorical_crossentropy")
+    x = np.random.default_rng(20).normal(size=(8, 6, 4)) \
+        .astype("float32")
+    y = np.zeros(8, "int32")
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    before = np.asarray(model(jnp.asarray(x)))
+    model.save(str(tmp_path / "enc"))
+    restored = keras.models.load_model(str(tmp_path / "enc"))
+    np.testing.assert_allclose(before,
+                               np.asarray(restored(jnp.asarray(x))),
+                               rtol=1e-6)
+
+    # multi-output model: outputs may BE aliases
+    m2 = keras.Model(inputs=inp, outputs=[out, h])
+    seq, hh = m2(jnp.asarray(x))
+    assert seq.shape == (8, 6, 5) and hh.shape == (8, 5)
+    np.testing.assert_allclose(np.asarray(seq[:, -1]), np.asarray(hh),
+                               rtol=1e-6)
+
+    # Sequential rejects multi-output layers, like keras
+    with pytest.raises(ValueError, match="multiple outputs"):
+        keras.Sequential([keras.Input((6, 4)),
+                          keras.layers.LSTM(5, return_state=True)])
+
+
+def test_bidirectional_return_state_shapes(devices):
+    import jax.numpy as jnp
+    inp = keras.Input(shape=(5, 3))
+    outs = keras.layers.Bidirectional(
+        keras.layers.LSTM(4, return_sequences=True,
+                          return_state=True))(inp)
+    assert len(outs) == 5            # seq, h_f, c_f, h_b, c_b
+    model = keras.Model(inputs=inp, outputs=list(outs))
+    res = model(jnp.ones((2, 5, 3)))
+    assert res[0].shape == (2, 5, 8)
+    assert all(r.shape == (2, 4) for r in res[1:])
