@@ -27,6 +27,7 @@ from distributed_tensorflow_tpu.training import callbacks
 from distributed_tensorflow_tpu.training import layers
 from distributed_tensorflow_tpu.training import losses
 from distributed_tensorflow_tpu.training import metrics
+from distributed_tensorflow_tpu.training import regularizers
 from distributed_tensorflow_tpu.training.functional import Input, Model
 from distributed_tensorflow_tpu.training.layers import Sequential
 
@@ -115,4 +116,5 @@ class _Utils:
 utils = _Utils()
 
 __all__ = ["layers", "losses", "metrics", "callbacks", "optimizers",
-           "models", "utils", "Model", "Sequential", "Input"]
+           "models", "utils", "regularizers", "Model", "Sequential",
+           "Input"]

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from distributed_tensorflow_tpu.training import regularizers as reg_lib
 from distributed_tensorflow_tpu.training.model import Model
 
 _ACTIVATIONS = {
@@ -120,12 +121,36 @@ class Layer:
                     f"{type(self).__name__} cannot serialize constructor "
                     f"param {name!r} (no matching attribute)")
             v = getattr(self, key)
-            if callable(v) and not isinstance(v, str):
+            if isinstance(v, reg_lib.Regularizer):
+                v = reg_lib.serialize(v)
+            elif callable(v) and not isinstance(v, str):
                 raise ValueError(
                     f"{type(self).__name__}.{name} is a Python callable; "
                     "only string-identified values are serializable")
             cfg[name] = list(v) if isinstance(v, tuple) else v
         return cfg
+
+    def _sow_reg(self, child, module):
+        """Sow this layer's weight-regularizer penalties into the
+        ``reg_losses`` collection (summed into the objective by
+        training/model.py — ≙ keras layer.losses)."""
+        kr = getattr(self, "kernel_regularizer", None)
+        br = getattr(self, "bias_regularizer", None)
+        if module is None or (kr is None and br is None):
+            return
+        params = child.variables["params"]
+        # one slot per (layer instance, param): a REUSED layer replays
+        # its compact body per call, but the penalty must count once
+        # (keras registers regularizers per weight, not per call) —
+        # the overwrite reduce_fn keeps a single value per slot.
+        keep_last = dict(reduce_fn=lambda prev, new: new,
+                         init_fn=lambda: 0.0)
+        if kr is not None:
+            module.sow("reg_losses", f"reg_{id(self)}_k",
+                       kr(params["kernel"]), **keep_last)
+        if br is not None and "bias" in params:
+            module.sow("reg_losses", f"reg_{id(self)}_b",
+                       br(params["bias"]), **keep_last)
 
     @classmethod
     def from_config(cls, config: dict):
@@ -153,24 +178,30 @@ class InputLayer(Layer):
 
 class Dense(Layer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 kernel_regularizer=None, bias_regularizer=None,
                  input_shape=None, name: str | None = None):
         self.units = int(units)
         self.activation = _activation(activation)
         self.activation_id = activation
         self.use_bias = use_bias
+        self.kernel_regularizer = reg_lib.get(kernel_regularizer)
+        self.bias_regularizer = reg_lib.get(bias_regularizer)
         self.input_shape = tuple(input_shape) if input_shape else None
         self.name = name
 
     def apply(self, x, *, train, module=None):
-        x = nn.Dense(self.units, use_bias=self.use_bias,
-                     name=self.name)(x)
+        dense = nn.Dense(self.units, use_bias=self.use_bias,
+                         name=self.name)
+        x = dense(x)
+        self._sow_reg(dense, module)
         return self.activation(x)
 
 
 class Conv2D(Layer):
     def __init__(self, filters: int, kernel_size, strides=1,
                  padding: str = "valid", activation=None,
-                 use_bias: bool = True, input_shape=None,
+                 use_bias: bool = True, kernel_regularizer=None,
+                 bias_regularizer=None, input_shape=None,
                  name: str | None = None):
         self.filters = int(filters)
         self.kernel_size = _pair(kernel_size)
@@ -179,13 +210,17 @@ class Conv2D(Layer):
         self.activation = _activation(activation)
         self.activation_id = activation
         self.use_bias = use_bias
+        self.kernel_regularizer = reg_lib.get(kernel_regularizer)
+        self.bias_regularizer = reg_lib.get(bias_regularizer)
         self.input_shape = tuple(input_shape) if input_shape else None
         self.name = name
 
     def apply(self, x, *, train, module=None):
-        x = nn.Conv(self.filters, self.kernel_size, strides=self.strides,
-                    padding=self.padding, use_bias=self.use_bias,
-                    name=self.name)(x)
+        conv = nn.Conv(self.filters, self.kernel_size,
+                       strides=self.strides, padding=self.padding,
+                       use_bias=self.use_bias, name=self.name)
+        x = conv(x)
+        self._sow_reg(conv, module)
         return self.activation(x)
 
 

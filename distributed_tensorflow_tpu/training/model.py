@@ -115,6 +115,7 @@ class Model:
 
         variables = self.strategy.init_state(init_vars)
         params = variables.pop("params", {})   # parameter-less models OK
+        variables.pop("reg_losses", None)      # recomputed per step
         self._state = {"params": params, "step": jnp.zeros((), jnp.int32),
                        "model_state": variables}
         if self._compiled:
@@ -216,14 +217,15 @@ class Model:
                                                   state["step"])}
 
             def compute_loss(params):
-                if collections:
-                    preds, mutated = module.apply(
-                        {"params": params, **model_state}, x,
-                        mutable=collections, rngs=rngs)
-                else:
-                    preds, mutated = module.apply({"params": params}, x,
-                                                  rngs=rngs), {}
-                per = loss_obj.call(y, preds).astype(jnp.float32)
+                preds, mutated = module.apply(
+                    {"params": params, **model_state}, x,
+                    mutable=collections + ["reg_losses"], rngs=rngs)
+                mutated = dict(mutated)
+                # weight-regularizer penalties (keras model.losses):
+                # part of the objective AND the reported loss
+                reg = sum(jax.tree_util.tree_leaves(
+                    mutated.pop("reg_losses", {})), jnp.zeros((), jnp.float32))
+                per = loss_obj.call(y, preds).astype(jnp.float32) + reg
                 w = sw.astype(jnp.float32)
                 loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-9)
                 return loss, (preds, per, mutated)
@@ -259,8 +261,13 @@ class Model:
 
         def eval_step(params, model_state, mstate, batch):
             x, y, sw = batch
-            preds = module.apply({"params": params, **model_state}, x)
-            per = loss_obj.call(y, preds).astype(jnp.float32)
+            preds, mutated = module.apply(
+                {"params": params, **model_state}, x,
+                mutable=["reg_losses"])
+            reg = sum(jax.tree_util.tree_leaves(
+                dict(mutated).get("reg_losses", {})),
+                jnp.zeros((), jnp.float32))
+            per = loss_obj.call(y, preds).astype(jnp.float32) + reg
             m2 = dict(mstate)
             m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
             for m in metrics:
