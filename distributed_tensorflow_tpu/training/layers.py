@@ -39,6 +39,12 @@ _ACTIVATIONS = {
     "softmax": lambda x: nn.softmax(x, axis=-1),
     "silu": nn.silu,
     "swish": nn.silu,
+    "elu": nn.elu,
+    # keras's leaky_relu activation slope is 0.2 (nn.leaky_relu
+    # defaults to 0.01)
+    "leaky_relu": lambda x: nn.leaky_relu(x, negative_slope=0.2),
+    "softplus": nn.softplus,
+    "exponential": jnp.exp,
 }
 
 
@@ -355,10 +361,22 @@ class MaxPooling1D(Layer):
                            padding=self.padding)
 
 
+class AveragePooling1D(MaxPooling1D):
+    def apply(self, x, *, train, module=None):
+        # count_include_pad=False: keras excludes padded cells from the
+        # mean under padding='same'
+        return nn.avg_pool(x, self.pool_size, strides=self.strides,
+                           padding=self.padding,
+                           count_include_pad=False)
+
+
 class AveragePooling2D(MaxPooling2D):
     def apply(self, x, *, train, module=None):
+        # count_include_pad=False: keras excludes padded cells from the
+        # mean under padding='same'
         return nn.avg_pool(x, self.pool_size, strides=self.strides,
-                           padding=self.padding)
+                           padding=self.padding,
+                           count_include_pad=False)
 
 
 class GlobalAveragePooling2D(Layer):
@@ -445,6 +463,22 @@ class Embedding(Layer):
 class ReLU(Layer):
     def apply(self, x, *, train, module=None):
         return nn.relu(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = float(alpha)
+
+    def apply(self, x, *, train, module=None):
+        return nn.leaky_relu(x, negative_slope=self.alpha)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = float(alpha)
+
+    def apply(self, x, *, train, module=None):
+        return nn.elu(x, alpha=self.alpha)
 
 
 class Softmax(Layer):

@@ -449,3 +449,43 @@ def test_shared_layer_regularizer_counts_once(devices):
     k = np.asarray(model.params["s"]["s"]["kernel"])
     expected = 0.1 * float((k ** 2).sum())   # once, despite 3 calls
     np.testing.assert_allclose(loss, expected, rtol=1e-5)
+
+
+def test_activation_layers_match_tf_keras(devices):
+    """LeakyReLU/ELU layers and the new activation strings match
+    tf_keras numerics."""
+    tf_keras = pytest.importorskip("tf_keras")
+    import jax.numpy as jnp
+    x = np.linspace(-3, 3, 31).astype("float32").reshape(1, -1)
+    cases = [
+        (keras.layers.LeakyReLU(0.2), tf_keras.layers.LeakyReLU(0.2)),
+        (keras.layers.ELU(0.7), tf_keras.layers.ELU(0.7)),
+    ]
+    for ours, ref in cases:
+        got = np.asarray(ours.apply(jnp.asarray(x), train=False))
+        np.testing.assert_allclose(got, ref(x).numpy(), rtol=1e-5,
+                                   atol=1e-6,
+                                   err_msg=type(ours).__name__)
+    for name in ("elu", "softplus"):
+        lyr = keras.layers.Activation(name)
+        got = np.asarray(lyr.apply(jnp.asarray(x), train=False))
+        ref = tf_keras.activations.get(name)
+        np.testing.assert_allclose(got, ref(x).numpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+    # keras's leaky_relu string uses slope 0.2 (no tf_keras string to
+    # compare against; pin the math directly)
+    lk = keras.layers.Activation("leaky_relu")
+    got = np.asarray(lk.apply(jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, np.where(x > 0, x, 0.2 * x),
+                               rtol=1e-6)
+    # AveragePooling1D value; 'same' padding excludes padded cells
+    ap = keras.layers.AveragePooling1D(2)
+    seq = jnp.arange(8, dtype=jnp.float32).reshape(1, 8, 1)
+    got = np.asarray(ap.apply(seq, train=False))
+    np.testing.assert_allclose(got[0, :, 0], [0.5, 2.5, 4.5, 6.5])
+    ap_same = keras.layers.AveragePooling1D(2, strides=2, padding="same")
+    seq7 = jnp.arange(7, dtype=jnp.float32).reshape(1, 7, 1)
+    ours7 = np.asarray(ap_same.apply(seq7, train=False))[0, :, 0]
+    ref7 = tf_keras.layers.AveragePooling1D(
+        2, strides=2, padding="same")(seq7[..., None][:, :, 0]).numpy()
+    np.testing.assert_allclose(ours7, ref7[0, :, 0], rtol=1e-6)
