@@ -17,6 +17,10 @@ Conventional import:
     import distributed_tensorflow_tpu as dtx
 """
 
+from distributed_tensorflow_tpu.utils import jax_compat as _jax_compat
+
+_jax_compat.install()   # backfill jax.shard_map & friends on old jax
+
 from distributed_tensorflow_tpu.cluster.topology import (
     Topology,
     DeviceAssignment,
@@ -112,6 +116,8 @@ from distributed_tensorflow_tpu import embedding
 from distributed_tensorflow_tpu.cluster.coordination import (
     coordination_service,
 )
+from distributed_tensorflow_tpu import resilience
+from distributed_tensorflow_tpu.resilience import RetryPolicy
 from distributed_tensorflow_tpu.utils import bfloat16
 from distributed_tensorflow_tpu.utils import summary
 from distributed_tensorflow_tpu.utils import tensor_tracer

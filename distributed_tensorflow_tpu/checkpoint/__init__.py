@@ -6,6 +6,7 @@ tf.train.Checkpoint / CheckpointManager / PreemptionCheckpointHandler.
 
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
+    CheckpointCorruptError,
     CheckpointManager,
 )
 from distributed_tensorflow_tpu.checkpoint.failure_handling import (

@@ -27,6 +27,7 @@ import re
 import shutil
 import threading
 import time
+import zlib
 from typing import Any, Mapping
 
 import jax
@@ -34,9 +35,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_tpu.parallel.values import DistributedVariable
+from distributed_tensorflow_tpu.resilience import faults
 
 _INDEX_FILE = "checkpoint.index.json"
 _LATEST_FILE = "checkpoint"  # ≙ the reference's `checkpoint` state file
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A shard file fails its recorded checksum/size — the checkpoint is
+    torn (truncated write, partial commit) and must not be restored."""
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
 
 
 def _flatten(tree, prefix=""):
@@ -121,7 +138,14 @@ class Checkpoint:
                                                             dtype=np.int64)
 
         def finish():
-            np.savez(os.path.join(tmp, f"shard_{proc}.npz"), **host_arrays)
+            # fsync BEFORE the rename into place: an OS crash after the
+            # rename must not leave a shard whose data pages never hit
+            # disk (rename is only atomic for the directory entry).
+            shard = os.path.join(tmp, f"shard_{proc}.npz")
+            with open(shard, "wb") as f:
+                np.savez(f, **host_arrays)
+                f.flush()
+                os.fsync(f.fileno())
             self._commit(tmp, path, index)
 
         def finish_async():
@@ -147,16 +171,29 @@ class Checkpoint:
         1. every process renames its shard files into ``path``;
         2. cross-process barrier — no host proceeds until ALL shards are
            in place (TSL coordination service; no-op single-process);
-        3. process 0 writes the index to a temp name and atomically
-           renames it LAST — the index's existence marks the checkpoint
-           complete (``_list_checkpoints`` keys on it), so a torn
-           checkpoint is never observable;
+        3. process 0 writes the index — which records every shard file's
+           size + crc32 (gathered over the KV store) — to a temp name
+           and atomically renames it LAST: the index's existence marks
+           the checkpoint complete (``_list_checkpoints`` keys on it,
+           and verifies the recorded sizes), so a torn checkpoint is
+           never observable;
         4. exit barrier so no process returns (and e.g. starts a restore
            or another save into the same path) before the index exists.
+
+        Chaos site ``checkpoint.commit``: ``raise`` fails the commit,
+        ``corrupt`` tears this process's shard AFTER the index lands —
+        the exact failure the size/crc records exist to catch.
         """
         from distributed_tensorflow_tpu.cluster.coordination import (
             coordination_service)
         agent = coordination_service()
+        decision = faults.fire("checkpoint.commit", tag=path, exc=OSError,
+                               msg=f"injected commit failure for {path}")
+        # Per-file integrity record for this process's shards, taken
+        # while they are still private to us (pre-rename).
+        sums = {f: {"crc32": _crc32_file(os.path.join(tmp, f)),
+                    "size": os.path.getsize(os.path.join(tmp, f))}
+                for f in os.listdir(tmp)}
         os.makedirs(path, exist_ok=True)
         for f in os.listdir(tmp):
             os.replace(os.path.join(tmp, f), os.path.join(path, f))
@@ -168,7 +205,16 @@ class Checkpoint:
         token = (os.path.basename(path) + "."
                  + hashlib.sha1(os.path.abspath(path).encode())
                  .hexdigest()[:12])
+        # Save-counter suffix: a re-save into the SAME path must use
+        # fresh KV keys (legacy TSL clients cannot safely re-read
+        # deleted-then-recreated keys).
+        sums_prefix = f"dtx_ckpt_sums/{token}.{self._save_counter}"
         if agent.is_distributed:
+            try:
+                agent.key_value_set(f"{sums_prefix}/p{agent.process_id}",
+                                    json.dumps(sums))
+            except Exception:
+                pass            # degraded: index carries fewer records
             try:
                 agent.barrier(f"ckpt_shards/{token}", timeout_s=600.0)
             except Exception as e:
@@ -179,6 +225,21 @@ class Checkpoint:
                       f"({e}); committing possibly-incomplete checkpoint "
                       f"{path}", file=sys.stderr)
         if agent.is_chief:
+            all_sums = dict(sums)
+            if agent.is_distributed:
+                # enumerated point reads (every process published before
+                # the shard barrier; legacy TSL clients hang on remote
+                # GetKeyValueDir, and a dead peer just contributes no
+                # record — best-effort by design)
+                for i in range(agent.num_processes):
+                    v = agent.key_value_try_get(f"{sums_prefix}/p{i}")
+                    if v is None:
+                        continue
+                    try:
+                        all_sums.update(json.loads(v))
+                    except ValueError:
+                        pass
+            index["shards"] = all_sums
             tmp_index = os.path.join(path, _INDEX_FILE + ".tmp")
             with open(tmp_index, "w") as f:
                 json.dump(index, f)
@@ -190,6 +251,18 @@ class Checkpoint:
                 agent.barrier(f"ckpt_index/{token}", timeout_s=600.0)
             except Exception:
                 pass            # exit barrier is best-effort by nature
+            if agent.is_chief:
+                try:
+                    agent.key_value_delete(sums_prefix)
+                except Exception:
+                    pass
+        if decision is not None and decision.action == "corrupt":
+            # Torn write AFTER the commit protocol finished: the index
+            # says the checkpoint is complete, the storage disagrees.
+            shard = os.path.join(path, f"shard_{jax.process_index()}.npz")
+            size = os.path.getsize(shard)
+            with open(shard, "rb+") as f:
+                f.truncate(max(size - max(size // 4, 1), 0))
 
     def _join_pending(self):
         if self._async_thread is not None and self._async_thread.is_alive():
@@ -267,6 +340,24 @@ class Checkpoint:
             raise FileNotFoundError(f"No checkpoint index at {path}")
         with open(index_path) as f:
             index = json.load(f)
+        # Integrity first (size is cheap, crc reads the file the load
+        # below reads anyway): a truncated/corrupt shard must surface as
+        # CheckpointCorruptError, not an obscure zipfile traceback.
+        # Pre-checksum checkpoints (no "shards" record) skip this.
+        for f_name, meta in index.get("shards", {}).items():
+            fpath = os.path.join(path, f_name)
+            if not os.path.exists(fpath):
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} is missing shard {f_name}")
+            size = os.path.getsize(fpath)
+            if size != meta.get("size"):
+                raise CheckpointCorruptError(
+                    f"shard {f_name} in {path} is {size} bytes, index "
+                    f"records {meta.get('size')} (torn write?)")
+            if "crc32" in meta and _crc32_file(fpath) != meta["crc32"]:
+                raise CheckpointCorruptError(
+                    f"shard {f_name} in {path} fails its crc32 "
+                    f"(corrupt data)")
         shards = {}
         shard_pat = re.compile(r"shard_(\d+)\.npz$")
         for f_name in sorted(os.listdir(path),
@@ -425,14 +516,37 @@ class CheckpointManager:
                                   for p in self._kept_pinned]}, f)
         os.replace(tmp, self._meta_path)
 
+    @staticmethod
+    def _is_complete(full: str) -> bool:
+        """A checkpoint counts only if its index exists AND every shard
+        the index records is present at its recorded size — so a torn
+        checkpoint (truncated shard, interrupted commit) is skipped by
+        rotation/latest rather than handed to restore. Size-only here
+        (stat, not a read); restore does the full crc verification."""
+        idx = os.path.join(full, _INDEX_FILE)
+        if not os.path.exists(idx):
+            return False
+        try:
+            with open(idx) as f:
+                index = json.load(f)
+        except (ValueError, OSError):
+            return False
+        for f_name, meta in index.get("shards", {}).items():
+            try:
+                if os.path.getsize(os.path.join(full, f_name)) != \
+                        meta.get("size"):
+                    return False
+            except OSError:
+                return False
+        return True
+
     def _list_checkpoints(self) -> list[tuple[int, str]]:
         pat = re.compile(re.escape(self._name) + r"-(\d+)$")
         out = []
         for d in os.listdir(self.directory):
             m = pat.match(d)
             full = os.path.join(self.directory, d)
-            if m and os.path.isdir(full) and \
-                    os.path.exists(os.path.join(full, _INDEX_FILE)):
+            if m and os.path.isdir(full) and self._is_complete(full):
                 out.append((int(m.group(1)), full))
         return sorted(out)
 

@@ -35,6 +35,7 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
     CheckpointManager,
 )
+from distributed_tensorflow_tpu.resilience import faults
 
 
 @dataclasses.dataclass
@@ -191,6 +192,12 @@ class PreemptionCheckpointHandler:
         signalled (≙ failure_handling.py:805/:1082)."""
         result = distributed_train_fn(*args, **kwargs)
         self._step += 1
+        # Chaos site: a scheduled synthetic preemption notice, delivered
+        # exactly as a platform SIGTERM would be (the active() guard
+        # keeps jax.process_index() off the disabled-path per-step cost).
+        if faults.active() and faults.fire(
+                "preemption.signal", tag=jax.process_index()) is not None:
+            self._received.set()
         self._check_preemption_and_maybe_checkpoint()
         return result
 
@@ -252,9 +259,13 @@ class PreemptionCheckpointHandler:
                         f"{self._STEPS_PREFIX}/p{agent.process_id}",
                         str(self._step))
                     agent.barrier(self._GATHER_BARRIER, timeout_s=600)
-                    steps = [int(v) for _, v in
-                             agent.key_value_dir_get(
-                                 self._STEPS_PREFIX + "/")]
+                    # enumerated point reads, not a directory listing:
+                    # every process published before the barrier, and
+                    # point gets work on every client vintage (legacy
+                    # TSL clients hang on remote GetKeyValueDir)
+                    steps = [int(agent.key_value_get(
+                        f"{self._STEPS_PREFIX}/p{i}", timeout_s=60))
+                        for i in range(agent.num_processes)]
                     # margin covers steps taken while the barrier settled
                     self._save_at = max(steps) + 2
                 except BaseException as e:
@@ -303,8 +314,11 @@ class PreemptionCheckpointHandler:
                     f"{self._step}{mark}")
                 agent.barrier(f"{self._CONFIRM_PREFIX}{r}/barrier",
                               timeout_s=600)
-                entries = [v.decode() for _, v in agent.key_value_dir_get(
-                    f"{self._CONFIRM_PREFIX}{r}/")]
+                # enumerated point reads (see sync() above)
+                entries = [agent.key_value_get(
+                    f"{self._CONFIRM_PREFIX}{r}/p{i}",
+                    timeout_s=60).decode()
+                    for i in range(agent.num_processes)]
                 steps = [int(e.rstrip("!")) for e in entries]
                 final = max(steps)
                 # Convergence when no more catching-up is possible:

@@ -22,6 +22,8 @@ class PreemptionWatcher:
         self._message: str | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._handler = None
+        self._prev_handler = None
         self._install()
         self._thread = None
         if watcher_fn is not None:
@@ -51,6 +53,10 @@ class PreemptionWatcher:
                     prev(signum, frame)
 
             signal.signal(signal.SIGTERM, handler)
+            # kept for stop(): stacked watchers must unwind LIFO without
+            # leaking handlers across tests
+            self._handler = handler
+            self._prev_handler = prev
         except (ValueError, OSError):
             pass
 
@@ -72,4 +78,25 @@ class PreemptionWatcher:
             time.sleep(0.05)
 
     def stop(self):
+        """Stop the poll thread and restore the SIGTERM handler that was
+        installed before this watcher (only if ours is still the current
+        one — an out-of-order stop must not break a newer watcher's
+        chain)."""
         self._stop.set()
+        if (self._handler is not None
+                and threading.current_thread() is threading.main_thread()):
+            try:
+                if signal.getsignal(signal.SIGTERM) is self._handler:
+                    signal.signal(signal.SIGTERM, self._prev_handler)
+                    self._handler = None
+            except (ValueError, OSError):
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -22,6 +22,8 @@ import re
 import threading
 import time
 
+from distributed_tensorflow_tpu.resilience import faults
+
 
 class CoordinationError(RuntimeError):
     """A coordination-service operation failed (timeout, peer error)."""
@@ -122,6 +124,39 @@ class CoordinationServiceAgent:
 
     def __init__(self):
         self._local = _LOCAL
+        self._legacy: bool | None = None
+        self._inc_hint: dict[str, int] = {}
+
+    # -- legacy-client compatibility --------------------------------------
+    # jaxlib builds whose DistributedRuntimeClient lacks
+    # ``key_value_try_get_bytes`` (jax < 0.5) also have a fatal read bug:
+    # the bytes/dir get APIs can SEGFAULT the service-hosting process
+    # when the key being read was written by the reading process itself
+    # (or has been overwritten/deleted) — the binding hands out a view
+    # into the in-process store. The string API copies and is safe in
+    # every direction. On such clients every point read is routed
+    # through string-get first, falling back to bytes-get only for
+    # binary values — which in this framework are always written by a
+    # PEER process (pickled closures/results), the safe direction.
+
+    def _is_legacy(self, c) -> bool:
+        if self._legacy is None:
+            self._legacy = not hasattr(c, "key_value_try_get_bytes")
+        return self._legacy
+
+    @staticmethod
+    def _legacy_get_once(c, key: str, wait_ms: int) -> "bytes | None":
+        """One bounded point read on a legacy client; None when absent."""
+        try:
+            return c.blocking_key_value_get(key, wait_ms).encode()
+        except UnicodeDecodeError:
+            # present but binary: peer-written here, so bytes-get is safe
+            try:
+                return c.blocking_key_value_get_bytes(key, wait_ms)
+            except Exception:
+                return None
+        except Exception:
+            return None
 
     # -- identity ---------------------------------------------------------
     @property
@@ -159,9 +194,21 @@ class CoordinationServiceAgent:
 
     def key_value_get(self, key: str, timeout_s: float = 60.0) -> bytes:
         """Blocking get: waits until some process sets ``key``."""
+        faults.fire("coord.kv_get", tag=key, exc=CoordinationError,
+                    msg=f"injected fault: key_value_get({key!r})")
         c = self._client
         if c is None:
             return self._local.get(key, timeout_s)
+        if self._is_legacy(c):
+            deadline = time.monotonic() + timeout_s
+            while True:
+                v = self._legacy_get_once(c, key, 100)
+                if v is not None:
+                    return v
+                if time.monotonic() >= deadline:
+                    raise CoordinationError(
+                        f"key_value_get({key!r}) timed out "
+                        f"after {timeout_s}s")
         try:
             return c.blocking_key_value_get_bytes(key, int(timeout_s * 1000))
         except Exception as e:                      # XlaRuntimeError
@@ -172,6 +219,14 @@ class CoordinationServiceAgent:
         c = self._client
         if c is None:
             return self._local.try_get(key)
+        if self._is_legacy(c):
+            # No non-blocking get on this vintage: a short blocking
+            # string-get is semantically identical (None when absent).
+            # Without this the bare `except: return None` below would
+            # swallow the AttributeError and EVERY try_get-based poller
+            # (preemption signal, heartbeats, shutdown acks) would
+            # silently see nothing — the failure paths would never fire.
+            return self._legacy_get_once(c, key, 50)
         try:
             return c.key_value_try_get_bytes(key)
         except Exception:
@@ -198,7 +253,41 @@ class CoordinationServiceAgent:
         c = self._client
         if c is None:
             return self._local.increment(key, amount)
-        return c.key_value_increment(key, amount)
+        if hasattr(c, "key_value_increment"):
+            return c.key_value_increment(key, amount)
+        # Older TSL clients: emulate with dense slot claims.
+        # InsertKeyValue with allow_overwrite=False IS atomic on the
+        # service and each slot key is written exactly once (no
+        # mutation, no directory reads — both are landmines on this
+        # vintage). Probing forward from a per-process hint costs one
+        # fast RPC per taken slot; coordination counters (generations,
+        # incarnations) stay tiny. The final value is also published
+        # under ``key`` for plain readers; slot keys live under
+        # ``key/`` so a directory delete of ``key`` GCs them.
+        i = self._inc_hint.get(key, 0)
+        claimed = 0
+        limit = i + 100_000
+        while claimed < amount:
+            i += 1
+            if i > limit:
+                raise CoordinationError(
+                    f"key_value_increment({key!r}) fallback exhausted "
+                    f"{limit} slots")
+            try:
+                c.key_value_set_bytes(f"{key}/__c__/{i}", b"1",
+                                      allow_overwrite=False)
+                claimed += 1
+            except Exception as e:
+                if "ALREADY_EXISTS" not in str(e):
+                    raise CoordinationError(
+                        f"key_value_increment({key!r}) failed: {e}") from e
+        self._inc_hint[key] = i
+        try:            # value key for plain readers (write-only: safe)
+            c.key_value_set_bytes(key, str(i).encode(),
+                                  allow_overwrite=True)
+        except Exception:
+            pass
+        return i
 
     # -- barriers ---------------------------------------------------------
     def barrier(self, name: str, timeout_s: float = 120.0):
@@ -208,6 +297,8 @@ class CoordinationServiceAgent:
         behavior the reference's check_health/barrier path has
         (collective_all_reduce_strategy.py:990) rather than hanging.
         """
+        faults.fire("coord.barrier", tag=name, exc=BarrierTimeoutError,
+                    msg=f"injected barrier timeout at {name!r}")
         c = self._client
         if c is None:
             self._local.barrier(name, timeout_s, 1)

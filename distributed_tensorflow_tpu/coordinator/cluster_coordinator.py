@@ -34,6 +34,9 @@ import jax
 
 from distributed_tensorflow_tpu.coordinator import metric_utils
 from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience.health import WorkerHealthTracker
+from distributed_tensorflow_tpu.resilience.retry import RetryPolicy
 
 
 class WorkerPreemptionError(RuntimeError):
@@ -280,7 +283,13 @@ class Worker:
     def _process_queue(self):
         # ≙ Worker._process_queue (:1173)
         queue = self.cluster.closure_queue
+        health = self.cluster.health
         while not self._stop.is_set():
+            if health.is_quarantined(self.worker_index):
+                # benched after repeated failures: leave queued closures
+                # to healthy lanes until the quarantine window expires
+                self._stop.wait(0.1)
+                continue
             if self.lane is not None and not self.lane.alive():
                 # dead remote worker: don't pull work this lane can't run
                 # (≙ wait_on_failure backoff, :879); resumes if the
@@ -295,15 +304,22 @@ class Worker:
     def _process_closure(self, closure: Closure, queue):
         try:
             with self.cluster.coordinator_metrics.closure_execution.time():
+                faults.fire(
+                    "closure.execute", tag=self.worker_index,
+                    exc=WorkerPreemptionError,
+                    msg=f"injected preemption on worker {self.worker_index}")
                 if self.lane is not None:
                     closure.execute_remote(self)
                 else:
                     closure.execute_on(self)
             queue.mark_finished(closure)
+            self.cluster.health.record_success(self.worker_index)
         except WorkerPreemptionError:
             # ≙ WorkerPreemptionHandler.wait_on_failure (:879): transparent
-            # retry on another worker; this lane backs off
+            # retry on another worker; this lane backs off (and is
+            # quarantined by the health tracker if it keeps failing)
             self.failures += 1
+            self.cluster.health.record_failure(self.worker_index)
             queue.put_back(closure)
         except PSUnavailableError as e:
             closure.output._set_error(e)
@@ -324,9 +340,15 @@ class Cluster:
     across processes instead of local devices."""
 
     def __init__(self, num_workers: int, devices=None,
-                 remote_worker_ids: Sequence[int] | None = None):
+                 remote_worker_ids: Sequence[int] | None = None,
+                 health: WorkerHealthTracker | None = None):
         self.closure_queue = _CoordinatedClosureQueue()
         self.coordinator_metrics = metric_utils.CoordinatorMetrics()
+        self.health = health or WorkerHealthTracker()
+        n = (len(remote_worker_ids) if remote_worker_ids is not None
+             else num_workers)
+        for i in range(n):
+            self.health.register(i)
         if remote_worker_ids is not None:
             from distributed_tensorflow_tpu.coordinator.remote_dispatch \
                 import RemoteLane
@@ -399,7 +421,8 @@ class ClusterCoordinator:
 
     def __init__(self, strategy=None, num_workers: int | None = None,
                  devices=None, watchdog_timeout: float = 300.0,
-                 remote_worker_ids: Sequence[int] | None = None):
+                 remote_worker_ids: Sequence[int] | None = None,
+                 health: WorkerHealthTracker | None = None):
         self.strategy = strategy
         if num_workers is None:
             resolver = getattr(strategy, "cluster_resolver", None)
@@ -410,7 +433,8 @@ class ClusterCoordinator:
         if remote_worker_ids is not None:
             num_workers = len(remote_worker_ids)
         self.cluster = Cluster(num_workers, devices,
-                               remote_worker_ids=remote_worker_ids)
+                               remote_worker_ids=remote_worker_ids,
+                               health=health)
         self._per_worker_resources: list = []
         self._watchdog = WatchDog(timeout=watchdog_timeout)
 
@@ -455,26 +479,31 @@ class ClusterCoordinator:
                            timeout_s: float = 120.0) -> list:
         """Fan a pinned closure out to EVERY worker lane in parallel
         (publish all tasks, then gather), retrying per worker on
-        preemption — the transparent-retry contract, pinned rather than
-        re-routed (per-worker resources belong to a specific worker)."""
+        preemption under the shared RetryPolicy — the transparent-retry
+        contract, pinned rather than re-routed (per-worker resources
+        belong to a specific worker)."""
+        policy = RetryPolicy(max_attempts=attempts,
+                             retryable=(WorkerPreemptionError,))
         lanes = [w.lane for w in self.cluster.workers]
         seqs = [lane.submit(fn, args, {}) for lane in lanes]
         results: list = [None] * len(lanes)
         for i, (lane, seq) in enumerate(zip(lanes, seqs)):
-            last: BaseException | None = None
-            for _ in range(attempts):
-                try:
-                    results[i] = lane.wait(seq, timeout_s=timeout_s)
-                    last = None
-                    break
-                except WorkerPreemptionError as e:
-                    last = e          # worker may come back: resubmit
-                    seq = lane.submit(fn, args, {})
-            if last is not None:
+            pending = {"seq": seq}
+
+            def gather(lane=lane, pending=pending):
+                return lane.wait(pending["seq"], timeout_s=timeout_s)
+
+            def resubmit(exc, attempt, lane=lane, pending=pending):
+                # worker may come back: publish the task again
+                pending["seq"] = lane.submit(fn, args, {})
+
+            try:
+                results[i] = policy.call(gather, on_retry=resubmit)
+            except WorkerPreemptionError as e:
                 raise WorkerPreemptionError(
                     f"worker {lane.worker_id} unavailable after "
                     f"{attempts} attempts creating a per-worker "
-                    f"resource") from last
+                    f"resource") from e
         return results
 
     def create_per_worker_resource(self, resource_fn: Callable) -> PerWorkerValues:

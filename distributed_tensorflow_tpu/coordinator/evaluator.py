@@ -25,6 +25,7 @@ from typing import Any, Callable
 
 from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
+    CheckpointCorruptError,
     latest_checkpoint,
 )
 
@@ -126,10 +127,11 @@ class SidecarEvaluator:
                     step = self._step_of(path)
                     try:
                         self._checkpoint.restore_into(path)
-                    except (OSError, KeyError, ValueError):
-                        # rotation race: the trainer swept this
-                        # checkpoint mid-restore — skip it, the next
-                        # poll sees a newer one (tf_keras
+                    except (OSError, KeyError, ValueError,
+                            CheckpointCorruptError):
+                        # rotation race or torn shard: the trainer swept
+                        # (or half-wrote) this checkpoint — skip it, the
+                        # next poll sees a newer one (tf_keras
                         # SidecarEvaluator tolerates this the same way)
                         _log.info(
                             "SidecarEvaluator: checkpoint %r vanished "

@@ -54,9 +54,19 @@ from distributed_tensorflow_tpu.cluster.coordination import (
     CoordinationServiceAgent,
     coordination_service,
 )
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
 
 _ROOT = "dtx_coord"
 _HEARTBEAT_INTERVAL = 0.2
+
+#: Pacing for the fast-fail path inside :meth:`RemoteLane.wait` — a
+#: coordination-service error (not a timeout) backs off along this
+#: schedule instead of hot-spinning until the staleness window closes.
+#: Shared policy object (resilience/retry.py) rather than ad-hoc sleeps.
+_WAIT_BACKOFF_POLICY = RetryPolicy(initial_backoff_s=0.05,
+                                   backoff_multiplier=2.0,
+                                   max_backoff_s=0.1)
 
 #: Closure payloads ride the coordination service's KV store, which is a
 #: control plane. Anything bigger than this belongs in the SPMD data
@@ -192,8 +202,13 @@ class RemoteLane:
         of how many closures the job schedules."""
         from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
             import WorkerPreemptionError
+        faults.fire("dispatch.wait", tag=self.worker_id,
+                    exc=WorkerPreemptionError,
+                    msg=f"injected preemption: worker {self.worker_id}, "
+                        f"closure {seq}")
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         rkey = _result_key(self.generation, self.worker_id, seq)
+        backoff = Backoff(_WAIT_BACKOFF_POLICY)
         while True:
             # Blocking get in staleness-sized slices: wakes immediately
             # when the worker publishes, touches the service once per
@@ -212,7 +227,9 @@ class RemoteLane:
                 # until the heartbeat staleness window closes.
                 waited = time.monotonic() - t0
                 if waited < slice_s:
-                    time.sleep(min(0.1, slice_s - waited))
+                    backoff.sleep(max_s=slice_s - waited)
+                else:
+                    backoff.reset()      # full slice elapsed: not an error
             if not self.alive():
                 raise WorkerPreemptionError(
                     f"worker {self.worker_id} heartbeat stale "

@@ -48,7 +48,29 @@ class WatchDog:
                 except Exception:
                     pass
                 if self._on_triggered is not None:
-                    self._on_triggered()
+                    # a user callback that raises must not kill the
+                    # watch loop — the watchdog outlives its hooks
+                    try:
+                        self._on_triggered()
+                    except Exception:
+                        try:
+                            print("[dtx WatchDog] on_triggered raised "
+                                  "(ignored)", file=self._output,
+                                  flush=True)
+                        except Exception:
+                            pass
 
-    def stop(self):
+    def stop(self, timeout: float | None = 5.0):
+        """Stop AND join the watch thread, so no trigger can fire after
+        stop() returns (a dangling watch thread dumping stacks into a
+        closed test capture was the previous failure mode)."""
         self._stop.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,23 @@
+"""Resilience: deterministic fault injection + unified retry/health policies.
+
+Three parts (see each module's docstring):
+
+- :mod:`.faults` — seed-driven chaos layer; named injection sites in the
+  coordination, dispatch, and checkpoint stacks raise/delay/corrupt on a
+  reproducible schedule (zero overhead when no schedule is installed);
+- :mod:`.retry` — the single :class:`RetryPolicy` (exponential backoff,
+  jitter, deadline, retryable classification) behind every retry loop;
+- :mod:`.health` — per-worker failure tracking and quarantine feeding
+  the coordinator's closure re-scheduling.
+"""
+
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience.faults import (
+    FaultDecision,
+    FaultInjected,
+    FaultRegistry,
+    FaultRule,
+    FaultSchedule,
+)
+from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
+from distributed_tensorflow_tpu.resilience.health import WorkerHealthTracker

@@ -1,0 +1,38 @@
+"""Compatibility shims for older jax runtimes.
+
+The framework targets current jax (`jax.shard_map`, `check_vma`,
+`jax.sharding.AxisType`), but deployment containers sometimes pin an
+older jaxlib. Rather than gating every call site, `install()` — called
+once from the package `__init__` — backfills the missing surface when
+(and only when) it is absent:
+
+- ``jax.shard_map``: aliased from ``jax.experimental.shard_map``, with
+  the ``check_vma`` kwarg translated to its old name ``check_rep``;
+- ``jax.lax.axis_size``: emulated with ``psum(1, name)``, which
+  constant-folds to the static axis size under tracing on old jax.
+
+Version-sensitive sites that need more than an alias do their own
+feature detection in place (``cluster/topology.py`` for ``AxisType``,
+``cluster/coordination.py`` for the coordination-client vintage).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install():
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = lambda name: jax.lax.psum(1, name)

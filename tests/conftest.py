@@ -46,6 +46,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "multiprocess: spawns real OS processes (multi_process_runner)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection scenario (resilience/faults.py; "
+        "seed via DTX_CHAOS_SEED, sweep via tools/chaos_sweep.py)")
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy run excluded from tier-1 (-m 'not slow')")
 
 
 @pytest.fixture(scope="session")

@@ -147,6 +147,27 @@ def test_preemption_watcher():
     w.stop()
 
 
+def test_preemption_watcher_restores_sigterm_handler():
+    """stop() must restore the previous SIGTERM handler (stacked
+    watchers unwind LIFO) — handlers leaked across tests before."""
+    import signal
+    from distributed_tensorflow_tpu.checkpoint import PreemptionWatcher
+    before = signal.getsignal(signal.SIGTERM)
+    w1 = PreemptionWatcher()
+    h1 = signal.getsignal(signal.SIGTERM)
+    assert h1 is not before
+    w2 = PreemptionWatcher()
+    assert signal.getsignal(signal.SIGTERM) is not h1
+    w2.stop()
+    assert signal.getsignal(signal.SIGTERM) is h1    # w1 back on top
+    w1.stop()
+    assert signal.getsignal(signal.SIGTERM) is before
+    # context-manager form restores too
+    with PreemptionWatcher():
+        assert signal.getsignal(signal.SIGTERM) is not before
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
 def test_preemption_grace_period_keeps_training(tmp_path):
     """≙ failure_handling.py:1204: after the preemption checkpoint, the
     job keeps BANKING STEPS until the grace window closes (the reference

@@ -1,0 +1,127 @@
+"""Coordination-service failure paths (single-process).
+
+tests/test_multi_process.py covers the cross-process happy paths plus a
+real barrier timeout; these exercise the error surfaces — timeout, peer
+error, exception hierarchy, directory-delete semantics — against the
+in-process fallback, with fault injection standing in for the failures
+only a distributed run could produce organically (ISSUE 2 satellite:
+barrier-timeout and peer-error propagation coverage)."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster import coordination
+from distributed_tensorflow_tpu.cluster.coordination import (
+    BarrierTimeoutError,
+    CoordinationError,
+    CoordinationServiceAgent,
+)
+from distributed_tensorflow_tpu.resilience import (
+    FaultRule,
+    FaultSchedule,
+    RetryPolicy,
+    faults,
+)
+
+
+@pytest.fixture()
+def agent():
+    """Isolated local KV service per test."""
+    old = coordination._LOCAL
+    coordination._LOCAL = coordination._LocalService()
+    a = CoordinationServiceAgent()
+    a._local = coordination._LOCAL
+    yield a
+    coordination._LOCAL = old
+
+
+def test_kv_get_timeout_raises_coordination_error(agent):
+    t0 = time.monotonic()
+    with pytest.raises(CoordinationError, match="timed out"):
+        agent.key_value_get("never-set", timeout_s=0.2)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_kv_get_wakes_on_concurrent_set(agent):
+    def setter():
+        time.sleep(0.1)
+        agent.key_value_set("late", "v")
+
+    t = threading.Thread(target=setter)
+    t.start()
+    assert agent.key_value_get("late", timeout_s=10) == b"v"
+    t.join()
+
+
+def test_kv_set_no_overwrite_conflict(agent):
+    agent.key_value_set("k", "a", allow_overwrite=False)
+    with pytest.raises(CoordinationError, match="already exists"):
+        agent.key_value_set("k", "b", allow_overwrite=False)
+
+
+def test_kv_delete_is_directory_style(agent):
+    agent.key_value_set("d", "root")
+    agent.key_value_set("d/x", "1")
+    agent.key_value_set("d/y/z", "2")
+    agent.key_value_set("dz", "survives")     # prefix-sibling, not child
+    agent.key_value_delete("d")
+    assert agent.key_value_try_get("d") is None
+    assert agent.key_value_try_get("d/x") is None
+    assert agent.key_value_try_get("d/y/z") is None
+    assert agent.key_value_try_get("dz") == b"survives"
+
+
+def test_kv_increment_and_dir_get_sorted(agent):
+    assert agent.key_value_increment("n") == 1
+    assert agent.key_value_increment("n", 4) == 5
+    agent.key_value_set("p/b", "2")
+    agent.key_value_set("p/a", "1")
+    assert agent.key_value_dir_get("p/") == [("p/a", b"1"), ("p/b", b"2")]
+
+
+def test_barrier_timeout_is_coordination_error():
+    """The propagation contract: code catching CoordinationError (peer
+    death handling, e.g. the killed-worker survivors path in
+    test_multi_process.py) must also see barrier timeouts."""
+    assert issubclass(BarrierTimeoutError, CoordinationError)
+
+
+def test_injected_barrier_timeout_propagates(agent):
+    sched = FaultSchedule(rules=[
+        FaultRule(site="coord.barrier", tag="meet", hits=(1,))])
+    with faults.inject(sched):
+        with pytest.raises(BarrierTimeoutError, match="injected"):
+            agent.barrier("meet", timeout_s=5)
+        agent.barrier("other", timeout_s=5)   # untargeted barrier passes
+        agent.barrier("meet", timeout_s=5)    # second hit passes
+
+
+def test_injected_peer_error_on_kv_get(agent):
+    """A service-side failure (dead peer, teardown) surfaces as
+    CoordinationError from key_value_get — the class every caller
+    (RemoteLane.wait, preemption sync) keys its handling on."""
+    agent.key_value_set("k", "v")
+    sched = FaultSchedule(rules=[
+        FaultRule(site="coord.kv_get", tag="k", hits=(1,))])
+    with faults.inject(sched):
+        with pytest.raises(CoordinationError, match="injected"):
+            agent.key_value_get("k", timeout_s=5)
+        # try_get is NOT instrumented: liveness polling stays fault-free
+        assert agent.key_value_try_get("k") == b"v"
+    assert agent.key_value_get("k", timeout_s=5) == b"v"
+
+
+def test_barrier_retry_under_policy(agent):
+    """A transient barrier timeout retried by the shared RetryPolicy —
+    the composition the chaos suite leans on."""
+    sched = FaultSchedule(rules=[
+        FaultRule(site="coord.barrier", tag="flaky", hits=(1,))])
+    attempts = []
+    policy = RetryPolicy(max_attempts=3, retryable=(BarrierTimeoutError,))
+    with faults.inject(sched) as reg:
+        policy.call(lambda: (attempts.append(1),
+                             agent.barrier("flaky", timeout_s=5)))
+        assert len(attempts) == 2
+        assert [e[3] for e in reg.events()] == ["raise"]

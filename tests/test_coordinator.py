@@ -159,6 +159,33 @@ def test_watchdog_triggers():
     assert w.triggered_count >= 1
 
 
+def test_watchdog_stop_joins_thread():
+    import io
+    from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+    w = WatchDog(timeout=30.0, output=io.StringIO())
+    w.stop()
+    assert not w._thread.is_alive()    # no trigger can fire after stop
+
+
+def test_watchdog_on_triggered_exception_not_fatal():
+    """A raising on_triggered callback must not kill the watch loop."""
+    import io
+    from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("hook error")
+
+    with WatchDog(timeout=0.2, on_triggered=boom,
+                  output=io.StringIO()) as w:
+        deadline = time.time() + 10
+        while len(calls) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+    assert len(calls) >= 2             # loop survived the first raise
+    assert not w._thread.is_alive()    # context exit joined it
+
+
 def test_metrics():
     from distributed_tensorflow_tpu.coordinator.metric_utils import (
         Counter, Timer)

@@ -1,0 +1,298 @@
+"""Unit tests for the resilience subsystem: fault registry semantics,
+RetryPolicy behavior, and worker health/quarantine bookkeeping."""
+
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.resilience import (
+    Backoff,
+    FaultInjected,
+    FaultRule,
+    FaultSchedule,
+    RetryPolicy,
+    WorkerHealthTracker,
+    faults,
+)
+
+
+# ---------------------------------------------------------------------------
+# faults
+# ---------------------------------------------------------------------------
+
+def test_rule_fires_on_exact_hits():
+    sched = FaultSchedule(rules=[FaultRule(site="a.b", hits=(2, 4))])
+    with faults.inject(sched) as reg:
+        outcomes = []
+        for _ in range(5):
+            try:
+                reg.fire("a.b")
+                outcomes.append("ok")
+            except FaultInjected:
+                outcomes.append("boom")
+        assert outcomes == ["ok", "boom", "ok", "boom", "ok"]
+
+
+def test_rule_max_fires_and_every():
+    sched = FaultSchedule(rules=[FaultRule(site="s", every=2, max_fires=2)])
+    with faults.inject(sched) as reg:
+        fired = []
+        for i in range(1, 9):
+            try:
+                reg.fire("s")
+            except FaultInjected:
+                fired.append(i)
+        assert fired == [2, 4]            # every 2nd hit, capped at 2 fires
+
+
+def test_site_pattern_and_custom_exception():
+    sched = FaultSchedule(rules=[FaultRule(site="coord.*")])
+    with faults.inject(sched) as reg:
+        with pytest.raises(KeyError, match="injected"):
+            reg.fire("coord.kv_get", exc=KeyError, msg="injected")
+        reg.fire("dispatch.wait")          # pattern does not match: no-op
+
+
+def test_tagged_rule_counts_per_tag():
+    """A rule with tag fires on THAT lane's Nth hit, regardless of how
+    other lanes' hits interleave — the determinism contract."""
+    sched = FaultSchedule(rules=[
+        FaultRule(site="closure.execute", tag="1", hits=(2,))])
+    with faults.inject(sched) as reg:
+        # interleave tags; only tag 1's second hit fires
+        reg.fire("closure.execute", tag=0)
+        reg.fire("closure.execute", tag=1)
+        reg.fire("closure.execute", tag=0)
+        reg.fire("closure.execute", tag=0)
+        with pytest.raises(FaultInjected):
+            reg.fire("closure.execute", tag=1)
+        assert reg.events() == [("closure.execute", "1", 2, "raise", 0)]
+
+
+def test_probability_deterministic_from_seed():
+    sched = FaultSchedule(seed=123, rules=[
+        FaultRule(site="s", probability=0.5)])
+
+    def run():
+        with faults.inject(sched) as reg:
+            out = []
+            for _ in range(64):
+                try:
+                    reg.fire("s")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+            return out
+
+    a, b = run(), run()
+    assert a == b
+    assert 0 < sum(a) < 64                 # actually probabilistic
+
+
+def test_delay_action_sleeps():
+    sched = FaultSchedule(rules=[
+        FaultRule(site="s", action="delay", delay_s=0.05, hits=(1,))])
+    with faults.inject(sched) as reg:
+        t0 = time.monotonic()
+        d = reg.fire("s")
+        assert time.monotonic() - t0 >= 0.04
+        assert d is not None and d.action == "delay"
+
+
+def test_corrupt_and_signal_return_decision():
+    sched = FaultSchedule(rules=[
+        FaultRule(site="c", action="corrupt"),
+        FaultRule(site="g", action="signal")])
+    with faults.inject(sched) as reg:
+        assert reg.fire("c").action == "corrupt"
+        assert reg.fire("g").action == "signal"
+
+
+def test_disabled_fast_path():
+    assert not faults.active()
+    assert faults.fire("coord.kv_get") is None
+    assert faults.events() == []
+    # the disabled path is a None check: 100k calls in negligible time
+    t0 = time.monotonic()
+    for _ in range(100_000):
+        faults.fire("coord.kv_get", tag="k")
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_schedule_json_aliases_and_unknown_keys():
+    s = FaultSchedule.from_json(
+        '{"seed": 7, "rules": [{"site": "s", "p": 0.25}]}')
+    assert s.rules[0].probability == 0.25
+    with pytest.raises(ValueError, match="unknown fault rule keys"):
+        FaultSchedule.from_json('{"rules": [{"site": "s", "bogus": 1}]}')
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultRule(site="s", action="explode")
+
+
+def test_inject_restores_previous_schedule():
+    outer = FaultSchedule(rules=[FaultRule(site="outer")])
+    inner = FaultSchedule(rules=[FaultRule(site="inner")])
+    with faults.inject(outer):
+        with faults.inject(inner):
+            with pytest.raises(FaultInjected):
+                faults.fire("inner")
+            faults.fire("outer")           # inner schedule: no match
+        with pytest.raises(FaultInjected):
+            faults.fire("outer")           # outer restored
+    assert not faults.active()
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("nope")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, retryable=(ConnectionError,))
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhaustion_reraises_last():
+    policy = RetryPolicy(max_attempts=2, retryable=(ConnectionError,))
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError(f"attempt {len(calls)}")
+
+    with pytest.raises(ConnectionError, match="attempt 2"):
+        policy.call(always)
+
+
+def test_retry_nonretryable_raises_immediately():
+    policy = RetryPolicy(max_attempts=5, retryable=(ConnectionError,))
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("app error")
+
+    with pytest.raises(ValueError):
+        policy.call(boom)
+    assert len(calls) == 1
+
+
+def test_retry_on_retry_callback_gets_attempt_numbers():
+    seen = []
+    policy = RetryPolicy(max_attempts=3, retryable=(ConnectionError,))
+    with pytest.raises(ConnectionError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError()),
+                    on_retry=lambda e, n: seen.append(n))
+    assert seen == [1, 2]
+
+
+def test_retry_deadline_cuts_attempts_short():
+    policy = RetryPolicy(max_attempts=100, initial_backoff_s=0.05,
+                         backoff_multiplier=1.0, deadline_s=0.12,
+                         retryable=(ConnectionError,))
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError()
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        policy.call(always)
+    assert time.monotonic() - t0 < 1.0
+    assert 2 <= len(calls) < 100
+
+
+def test_backoff_schedule_exponential_capped():
+    policy = RetryPolicy(initial_backoff_s=0.1, backoff_multiplier=2.0,
+                         max_backoff_s=0.5)
+    assert [policy.backoff_s(n) for n in (1, 2, 3, 4, 5)] == \
+        [0.1, 0.2, 0.4, 0.5, 0.5]
+    assert RetryPolicy().backoff_s(3) == 0.0     # no-backoff default
+
+
+def test_backoff_jitter_bounded_and_seeded():
+    policy = RetryPolicy(initial_backoff_s=0.1, jitter=0.5, seed=7,
+                         max_backoff_s=10.0)
+    import random
+    a = [policy.backoff_s(1, random.Random(7)) for _ in range(3)]
+    b = [policy.backoff_s(1, random.Random(7)) for _ in range(3)]
+    assert a == b                                # seeded => deterministic
+    for d in a:
+        assert 0.05 <= d <= 0.15
+
+
+def test_backoff_pacer_clamps_and_resets():
+    pacer = Backoff(RetryPolicy(initial_backoff_s=0.2,
+                                backoff_multiplier=2.0, max_backoff_s=1.0))
+    assert pacer.next_s() == 0.2
+    assert pacer.next_s() == 0.4
+    pacer.reset()
+    assert pacer.next_s() == 0.2
+    t0 = time.monotonic()
+    slept = pacer.sleep(max_s=0.01)
+    assert slept <= 0.01 and time.monotonic() - t0 < 0.2
+
+
+# ---------------------------------------------------------------------------
+# health
+# ---------------------------------------------------------------------------
+
+def _tracker(**kw):
+    clock = {"t": 0.0}
+    kw.setdefault("time_fn", lambda: clock["t"])
+    return WorkerHealthTracker(**kw), clock
+
+
+def test_quarantine_after_threshold():
+    tr, _ = _tracker(failure_threshold=3, quarantine_s=5.0)
+    tr.register(0)
+    tr.register(1)
+    assert not tr.record_failure(0)
+    assert not tr.record_failure(0)
+    assert tr.record_failure(0)            # third consecutive: benched
+    assert tr.is_quarantined(0)
+    assert not tr.is_quarantined(1)
+    assert tr.healthy_workers() == [1]
+    assert tr.snapshot()[0]["quarantine_count"] == 1
+
+
+def test_quarantine_expires_with_time():
+    tr, clock = _tracker(failure_threshold=1, quarantine_s=5.0)
+    tr.register(0)
+    tr.register(1)
+    tr.record_failure(0)
+    assert tr.is_quarantined(0)
+    clock["t"] = 6.0
+    assert not tr.is_quarantined(0)
+    assert tr.healthy_workers() == [0, 1]
+
+
+def test_success_resets_failures_and_quarantine():
+    tr, _ = _tracker(failure_threshold=2)
+    tr.register(0)
+    tr.register(1)
+    tr.record_failure(0)
+    tr.record_success(0)                   # streak broken
+    assert not tr.record_failure(0)        # needs 2 consecutive again
+    assert tr.record_failure(0)
+    tr.record_success(0)                   # success lifts the bench
+    assert not tr.is_quarantined(0)
+
+
+def test_never_quarantines_last_healthy_worker():
+    tr, _ = _tracker(failure_threshold=1, quarantine_s=100.0)
+    tr.register(0)
+    tr.register(1)
+    assert tr.record_failure(0)            # 0 benched (1 still healthy)
+    for _ in range(10):
+        assert not tr.record_failure(1)    # refused: 1 is the last lane
+    assert tr.healthy_workers() == [1]

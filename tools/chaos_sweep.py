@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Chaos seed sweep: run the chaos suite across N seeds, report survival.
+
+Each seed runs ``tests/test_chaos.py`` in its own pytest process with
+``DTX_CHAOS_SEED=<seed>`` (the chaos tests derive every fault schedule
+from it, and probabilistic rules draw from per-site streams seeded by
+it — see resilience/faults.py). A seed "survives" when the whole suite
+passes; the survival rate is the headline robustness number.
+
+Usage::
+
+    python tools/chaos_sweep.py --seeds 10            # seeds 0..9
+    python tools/chaos_sweep.py --seeds 5 --base-seed 100 --slow
+    python tools/chaos_sweep.py --seeds 3 -- -k preemption
+
+Everything after ``--`` is forwarded to pytest. Exit code is non-zero
+if any seed fails (CI-friendly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_seed(seed: int, include_slow: bool, extra: list[str]) -> tuple[bool, float]:
+    env = dict(os.environ)
+    env["DTX_CHAOS_SEED"] = str(seed)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    marker = "chaos" if include_slow else "chaos and not slow"
+    cmd = [sys.executable, "-m", "pytest", "tests/test_chaos.py", "-q",
+           "-m", marker, "-p", "no:cacheprovider", *extra]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    dt = time.monotonic() - t0
+    ok = proc.returncode == 0
+    if not ok:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    return ok, dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=5,
+                    help="number of seeds to sweep (default 5)")
+    ap.add_argument("--base-seed", type=int, default=0,
+                    help="first seed (default 0)")
+    ap.add_argument("--slow", action="store_true",
+                    help="include slow (multi-process) chaos tests")
+    ap.add_argument("pytest_args", nargs="*",
+                    help="extra args forwarded to pytest (after --)")
+    args = ap.parse_args(argv)
+
+    results = []
+    for s in range(args.base_seed, args.base_seed + args.seeds):
+        ok, dt = run_seed(s, args.slow, args.pytest_args)
+        results.append((s, ok, dt))
+        print(f"seed {s:>4}: {'PASS' if ok else 'FAIL'}  ({dt:.1f}s)",
+              flush=True)
+
+    survived = sum(1 for _, ok, _ in results if ok)
+    rate = survived / len(results) if results else 0.0
+    print(f"\nsurvival: {survived}/{len(results)} seeds "
+          f"({100 * rate:.0f}%)")
+    if survived != len(results):
+        print("failing seeds:",
+              [s for s, ok, _ in results if not ok])
+    return 0 if survived == len(results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
