@@ -1,12 +1,14 @@
 """Distributed input pipeline (SURVEY.md §2.3 input layer)."""
 
 from distributed_tensorflow_tpu.input.dataset import (
+    AUTOTUNE,
     AutoShardPolicy,
     Dataset,
     DistributedDataset,
     InputContext,
     InputOptions,
 )
+from distributed_tensorflow_tpu.input import image_ops
 from distributed_tensorflow_tpu.input.example_parser import (
     FixedLenFeature,
     VarLenFeature,
@@ -17,7 +19,8 @@ from distributed_tensorflow_tpu.input.example_parser import (
 )
 
 __all__ = [
-    "AutoShardPolicy", "Dataset", "DistributedDataset", "InputContext",
-    "InputOptions", "FixedLenFeature", "VarLenFeature", "encode_example",
-    "example_reader", "parse_example", "parse_single_example",
+    "AUTOTUNE", "AutoShardPolicy", "Dataset", "DistributedDataset",
+    "InputContext", "InputOptions", "FixedLenFeature", "VarLenFeature",
+    "encode_example", "example_reader", "image_ops", "parse_example",
+    "parse_single_example",
 ]

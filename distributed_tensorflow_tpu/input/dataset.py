@@ -20,18 +20,60 @@ input_ops.py (SURVEY.md §2.3):
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import dataclasses
 import enum
 import itertools
 import math
+import os
 import queue
 import threading
+import time
 import weakref
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.utils import profiler
+
+#: ≙ tf.data.AUTOTUNE: pass as ``num_parallel_calls`` to size the worker
+#: pool from measured stage latency instead of hand-picking it.
+AUTOTUNE = -1
+
+_stage_counter = itertools.count(1)
+
+
+def _stage_name(kind: str, name: str | None = None) -> str:
+    return f"{kind}:{name}" if name else f"{kind}#{next(_stage_counter)}"
+
+
+def _worker_cap() -> int:
+    """Pool-size ceiling: cpu_count, floored at 2 so decode can overlap
+    device compute even on one-core CI hosts."""
+    return max(2, os.cpu_count() or 1)
+
+
+def _check_parallel_calls(num_parallel_calls: int) -> None:
+    if num_parallel_calls != AUTOTUNE and num_parallel_calls < 1:
+        raise ValueError(
+            f"num_parallel_calls must be >= 1 or AUTOTUNE, got "
+            f"{num_parallel_calls}")
+
+
+def _autotune_workers(src_s: float, fn_s: float) -> int:
+    """AUTOTUNE's steady-state answer (≙ tf.data's autotune model,
+    collapsed to its fixpoint): with upstream inter-arrival time
+    ``src_s`` and per-element stage latency ``fn_s``, ``fn_s / src_s``
+    concurrent calls keep the stage from being the bottleneck. Clamped
+    to [1, :func:`_worker_cap`]; an instant upstream (src_s -> 0) gives
+    the cap, an instant stage gives 1."""
+    cap = _worker_cap()
+    if fn_s <= 0:
+        return 1
+    return max(1, min(cap, round(fn_s / max(src_s, fn_s / cap, 1e-6))))
 
 
 class AutoShardPolicy(enum.Enum):
@@ -156,10 +198,29 @@ class Dataset:
         ds._op = op
         return ds
 
-    def map(self, fn: Callable) -> "Dataset":
+    def map(self, fn: Callable,
+            num_parallel_calls: int | None = None,
+            name: str | None = None) -> "Dataset":
+        """Apply ``fn`` per element. ``num_parallel_calls`` (int or
+        :data:`AUTOTUNE`) fans the calls out over an ordered thread
+        pool — element order stays BIT-IDENTICAL to the serial path at
+        any worker count (≙ tf.data's deterministic ParallelMap). The
+        serial default keeps today's zero-overhead generator chain."""
         src = self._gen_fn
-        return self._derive(lambda: (fn(x) for x in src()),
-                            self._element_count, op=lambda d: d.map(fn))
+        if num_parallel_calls is None:
+            return self._derive(lambda: (fn(x) for x in src()),
+                                self._element_count, op=lambda d: d.map(fn))
+        stats = profiler.StageStats(_stage_name("map", name))
+
+        def gen():
+            yield from _parallel_map_iter(src, fn, num_parallel_calls,
+                                          stats)
+
+        ds = self._derive(
+            gen, self._element_count,
+            op=lambda d: d.map(fn, num_parallel_calls, name))
+        ds._stage_stats = stats
+        return ds
 
     def filter(self, pred: Callable) -> "Dataset":
         src = self._gen_fn
@@ -370,17 +431,39 @@ class Dataset:
 
     def interleave(self, map_fn: Callable[..., "Dataset"],
                    cycle_length: int = 4,
-                   block_length: int = 1) -> "Dataset":
+                   block_length: int = 1,
+                   num_parallel_calls: int | None = None,
+                   name: str | None = None) -> "Dataset":
         """Round-robin interleave of ``cycle_length`` sub-datasets
         (≙ tf.data Dataset.interleave): ``map_fn(element)`` yields a
         Dataset per source element; ``block_length`` consecutive items
         are pulled from each open sub-iterator before rotating. This is
         the canonical many-files reading pattern together with
-        ``from_files``/``shard_files``."""
+        ``from_files``/``shard_files``.
+
+        ``num_parallel_calls`` (int or :data:`AUTOTUNE`) opens
+        sub-datasets and fetches their next blocks on a thread pool —
+        the round-robin output order stays bit-identical to the serial
+        path (≙ deterministic ParallelInterleave)."""
         if cycle_length < 1:
             raise ValueError(f"cycle_length must be >= 1, got "
                              f"{cycle_length}")
         src = self._gen_fn
+        if num_parallel_calls is not None:
+            stats = profiler.StageStats(_stage_name("interleave", name))
+
+            def pgen():
+                yield from _parallel_interleave_iter(
+                    src, map_fn, cycle_length, block_length,
+                    num_parallel_calls, stats)
+
+            ds = self._derive(
+                pgen, None,
+                op=lambda d: d.interleave(map_fn, cycle_length,
+                                          block_length,
+                                          num_parallel_calls, name))
+            ds._stage_stats = stats
+            return ds
 
         def gen():
             elements = src()
@@ -607,20 +690,193 @@ class Dataset:
         return self._derive(gen, self._element_count,
                             op=lambda d: d.cache())
 
-    def prefetch(self, buffer_size: int = 2) -> "Dataset":
+    def prefetch(self, buffer_size: int = 2,
+                 name: str | None = None) -> "Dataset":
+        """Decouple production from consumption: a background thread
+        fills a bounded queue ``buffer_size`` deep (≙ tf.data
+        Dataset.prefetch). Per-stage occupancy/wait counters register
+        with :mod:`utils.profiler` (``pipeline_stats()``); the
+        ``input.prefetch`` fault site fires per element so chaos tests
+        can inject upstream decode failures."""
         src = self._gen_fn
+        stats = profiler.StageStats(_stage_name("prefetch", name))
 
         def gen():
-            yield from _BackgroundIterator(src(), buffer_size)
+            yield from _BackgroundIterator(src(), buffer_size,
+                                           stats=stats)
 
-        return self._derive(gen, self._element_count,
-                            op=lambda d: d.prefetch(buffer_size))
+        ds = self._derive(gen, self._element_count,
+                          op=lambda d: d.prefetch(buffer_size, name))
+        ds._stage_stats = stats
+        return ds
 
     def cardinality(self) -> int | None:
         return self._element_count
 
+    def pipeline_stats(self) -> "list[dict]":
+        """Snapshots of this pipeline's instrumented stages (parallel
+        map/interleave, prefetch), root → here. Serial stages carry no
+        counters (they are plain generators). For a process-wide view
+        across pipelines use ``utils.profiler.pipeline_stats()``."""
+        out = []
+        node = self
+        while node is not None:
+            s = getattr(node, "_stage_stats", None)
+            if s is not None:
+                out.append(s.snapshot())
+            node = getattr(node, "_parent", None)
+        return list(reversed(out))
+
     def __iter__(self) -> Iterator:
         return self._gen_fn()
+
+
+def _parallel_map_iter(src_fn: Callable[[], Iterator], fn: Callable,
+                       num_parallel_calls: int,
+                       stats: "profiler.StageStats") -> Iterator:
+    """Ordered thread-pool fan-out for Dataset.map.
+
+    A bounded window of futures keeps ``workers + 2`` elements in
+    flight; results are yielded strictly in submission order, so the
+    output is bit-identical to the serial path at any worker count.
+    AUTOTUNE calibrates on the first elements (run serially) before the
+    pool spins up. Exceptions from ``fn`` surface at the failing
+    element's ordinal position; abandoning the iterator cancels the
+    in-flight window.
+    """
+    _check_parallel_calls(num_parallel_calls)
+    src = src_fn()
+    calibrated: list = []
+    if num_parallel_calls == AUTOTUNE:
+        src_s = fn_s = 0.0
+        n = 0
+        for _ in range(3):
+            t0 = time.monotonic()
+            try:
+                x = next(src)
+            except StopIteration:
+                break
+            t1 = time.monotonic()
+            y = fn(x)
+            t2 = time.monotonic()
+            src_s += t1 - t0
+            fn_s += t2 - t1
+            n += 1
+            stats.record(elements=1, busy_s=t2 - t1,
+                         producer_wait_s=t1 - t0)
+            calibrated.append(y)
+        workers = (_autotune_workers(src_s / n, fn_s / n) if n
+                   else 1)
+    else:
+        workers = int(num_parallel_calls)
+    stats.workers = workers
+
+    def timed_fn(x):
+        t0 = time.monotonic()
+        y = fn(x)
+        stats.record(elements=1, busy_s=time.monotonic() - t0)
+        return y
+
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix=f"dtx-{stats.name}")
+    pending: collections.deque = collections.deque()
+    in_flight = workers + 2
+    try:
+        yield from calibrated
+        exhausted = False
+        while not exhausted or pending:
+            while not exhausted and len(pending) < in_flight:
+                t0 = time.monotonic()
+                try:
+                    x = next(src)
+                except StopIteration:
+                    exhausted = True
+                    break
+                stats.record(producer_wait_s=time.monotonic() - t0)
+                pending.append(ex.submit(timed_fn, x))
+            if not pending:
+                break
+            t0 = time.monotonic()
+            y = pending.popleft().result()
+            stats.record(consumer_wait_s=time.monotonic() - t0,
+                         queue_depth=len(pending))
+            yield y
+    finally:
+        for f in pending:
+            f.cancel()
+        ex.shutdown(wait=False)
+
+
+def _parallel_interleave_iter(src_fn: Callable[[], Iterator],
+                              map_fn: Callable, cycle_length: int,
+                              block_length: int, num_parallel_calls: int,
+                              stats: "profiler.StageStats") -> Iterator:
+    """Deterministic parallel interleave: the round-robin rotation (and
+    therefore the output order) is exactly the serial algorithm's, but
+    each open slot's NEXT block — and the sub-dataset open itself — is
+    fetched ahead on a thread pool while earlier slots drain."""
+    _check_parallel_calls(num_parallel_calls)
+    workers = (min(cycle_length, _worker_cap())
+               if num_parallel_calls == AUTOTUNE
+               else min(int(num_parallel_calls), cycle_length))
+    stats.workers = workers
+
+    def fetch_block(it):
+        t0 = time.monotonic()
+        out = []
+        alive = True
+        for _ in range(block_length):
+            try:
+                out.append(next(it))
+            except StopIteration:
+                alive = False
+                break
+        stats.record(elements=len(out), busy_s=time.monotonic() - t0)
+        return out, alive
+
+    def open_and_fetch(element):
+        t0 = time.monotonic()
+        it = iter(map_fn(element))
+        stats.record(busy_s=time.monotonic() - t0)
+        out, alive = fetch_block(it)
+        return it, out, alive
+
+    ex = concurrent.futures.ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix=f"dtx-{stats.name}")
+    elements = src_fn()
+    slots: list[dict] = []
+    try:
+        exhausted = False
+        while True:
+            while not exhausted and len(slots) < cycle_length:
+                t0 = time.monotonic()
+                try:
+                    el = next(elements)
+                except StopIteration:
+                    exhausted = True
+                    break
+                stats.record(producer_wait_s=time.monotonic() - t0)
+                slots.append({"fut": ex.submit(open_and_fetch, el),
+                              "it": None})
+            if not slots:
+                return
+            keep = []
+            for slot in slots:
+                t0 = time.monotonic()
+                if slot["it"] is None:
+                    slot["it"], items, alive = slot["fut"].result()
+                else:
+                    items, alive = slot["fut"].result()
+                stats.record(consumer_wait_s=time.monotonic() - t0)
+                yield from items
+                if alive:
+                    slot["fut"] = ex.submit(fetch_block, slot["it"])
+                    keep.append(slot)
+            slots = keep
+    finally:
+        for slot in slots:
+            slot["fut"].cancel()
+        ex.shutdown(wait=False)
 
 
 class _BackgroundIterator:
@@ -634,7 +890,8 @@ class _BackgroundIterator:
 
     _SENTINEL = object()
 
-    def __init__(self, it: Iterator, buffer_size: int):
+    def __init__(self, it: Iterator, buffer_size: int,
+                 stats: "profiler.StageStats | None" = None):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, buffer_size))
         # One-element holder, NOT an attribute: the worker closure must
         # hold no reference to self, or the finalizer's strong args
@@ -643,13 +900,34 @@ class _BackgroundIterator:
         self._err_box: list[BaseException] = []
         self._done = False
         self._stop = threading.Event()
+        self._stats = stats
         q, stop, sentinel = self._q, self._stop, self._SENTINEL
         err_box = self._err_box
+        tag = stats.name if stats is not None else None
 
         def worker():
             try:
-                for x in it:
-                    if not _put_unless_stopped(q, stop, x):
+                src = iter(it)
+                while True:
+                    t0 = time.monotonic()
+                    try:
+                        x = next(src)
+                    except StopIteration:
+                        return
+                    busy = time.monotonic() - t0
+                    # Chaos site: a schedule can make the prefetch
+                    # worker fail like a bad decode would — the
+                    # exception lands in err_box and surfaces on the
+                    # consumer's next() instead of hanging the queue.
+                    faults.fire("input.prefetch", tag=tag)
+                    t1 = time.monotonic()
+                    ok = _put_unless_stopped(q, stop, x)
+                    if stats is not None:
+                        stats.record(
+                            elements=1, busy_s=busy,
+                            blocked_put_s=time.monotonic() - t1,
+                            queue_depth=q.qsize())
+                    if not ok:
                         return
             except BaseException as e:  # propagate to consumer
                 err_box.append(e)
@@ -676,7 +954,10 @@ class _BackgroundIterator:
             if self._err_box:
                 raise self._err_box[0]
             raise StopIteration
+        t0 = time.monotonic()
         x = self._q.get()
+        if self._stats is not None:
+            self._stats.record(consumer_wait_s=time.monotonic() - t0)
         if x is self._SENTINEL:
             self._done = True
             if self._err_box:
@@ -817,7 +1098,8 @@ class DistributedIterator:
             place = self._strategy.shard_batch
             buffered = _BackgroundIterator(
                 map(place, src),
-                options.experimental_per_replica_buffer_size)
+                options.experimental_per_replica_buffer_size,
+                stats=profiler.StageStats(_stage_name("device_put")))
             self._it = iter(buffered)
         else:
             self._it = src

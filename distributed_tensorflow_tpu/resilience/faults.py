@@ -31,6 +31,11 @@ Instrumented sites:
                           shard file after the index commits)
 ``preemption.signal``     PreemptionCheckpointHandler.run (tag=process id;
                           ``signal`` delivers a synthetic preemption notice)
+``input.prefetch``        Dataset.prefetch / fetch-to-device background
+                          worker, once per element (tag=stage name) — a
+                          ``raise`` here models a decode/IO failure inside
+                          the host input pipeline; it must surface on the
+                          consumer, never hang the queue
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

@@ -888,19 +888,20 @@ class Sequential(Model):
             self.build(jnp.zeros((1, *shape), jnp.float32))
 
     def add(self, layer: Layer):
-        """≙ keras Sequential.add: incremental construction. Adding to
-        an already-built stack re-initializes ALL parameters (tf_keras
-        preserves existing weights); warn loudly so a migrated script
-        that adds layers after fit() cannot silently lose training."""
+        """≙ keras Sequential.add: incremental construction, tf_keras
+        semantics — adding to an already-built stack PRESERVES the
+        existing layers' weights (flax auto-names are call-order
+        stable, so appending a layer never renames earlier ones; the
+        rebuilt parameter tree is re-seeded only for the new layer and
+        the old subtrees are copied back in). The optimizer state is
+        re-initialized for the grown parameter set on the next
+        compile/fit, matching keras's lazy slot creation."""
         layer = self._as_layer(layer)
+        old_params = old_model_state = rebuild_sample = None
         if self._built and self._state is not None:
-            import warnings
-            warnings.warn(
-                "Sequential.add() after the model was built "
-                "re-initializes ALL parameters in this framework "
-                "(tf_keras would keep the existing weights); add every "
-                "layer before training, or rebuild and reload weights",
-                UserWarning, stacklevel=2)
+            old_params = self._state["params"]
+            old_model_state = self._state.get("model_state", {})
+            rebuild_sample = getattr(self, "_build_sample", None)
         self.layers.append(layer)
         stack = tuple(self.layers)
         self.module = _SequentialModule(layer_stack=stack, train=True)
@@ -910,8 +911,27 @@ class Sequential(Model):
         self._train_fn = self._eval_fn = self._predict_fn = None
         shape = next((lyr.compute_input_shape() for lyr in stack
                       if lyr.compute_input_shape()), None)
-        if shape is not None:
+        if rebuild_sample is not None:
+            self.build(rebuild_sample)
+        elif shape is not None:
             self.build(jnp.zeros((1, *shape), jnp.float32))
+        if old_params is not None and self._built:
+            merged = dict(self._state["params"])
+            for k in old_params:
+                if k in merged:
+                    merged[k] = old_params[k]
+            self._state["params"] = merged
+            new_ms = dict(self._state.get("model_state", {}))
+            for coll, sub in dict(old_model_state or {}).items():
+                cur = dict(new_ms.get(coll, {}))
+                for k in sub:
+                    if k in cur:
+                        cur[k] = sub[k]
+                new_ms[coll] = cur
+            self._state["model_state"] = new_ms
+            if self._compiled:
+                self._state["opt_state"] = self.strategy.init_state(
+                    lambda: self._tx.init(self._state["params"]))
 
 
 # keras.layers.Input is the same symbolic-tensor factory as keras.Input

@@ -116,6 +116,7 @@ class Model:
         variables = self.strategy.init_state(init_vars)
         params = variables.pop("params", {})   # parameter-less models OK
         variables.pop("reg_losses", None)      # recomputed per step
+        self._build_sample = sample        # Sequential.add rebuilds with it
         self._state = {"params": params, "step": jnp.zeros((), jnp.int32),
                        "model_state": variables}
         if self._compiled:

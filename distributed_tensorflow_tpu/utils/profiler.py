@@ -25,6 +25,8 @@ import contextlib
 import dataclasses
 import functools
 import threading
+import time
+import weakref
 
 import jax
 
@@ -254,3 +256,121 @@ def op_profile(logdir: str, top: int = 20,
     raise ValueError(
         f"no plane matching {device_substr!r} with XLA op events found "
         f"(planes: {[p.name for p in xs.planes]})")
+
+
+# ---------------------------------------------------------------------------
+# Host input-pipeline telemetry (≙ tf.data's iterator/autotune stats,
+# TF/python/data/experimental/ops/stats_ops.py): every concurrent pipeline
+# stage (parallel map/interleave, prefetch, infeed) owns a StageStats and
+# registers it here, so the bottleneck stage is attributable from counters
+# instead of guessed. The four wait channels answer the only question that
+# matters — WHO is blocking WHOM:
+#
+# - ``busy_s``          time the stage spent doing its own work (map fn,
+#                       decode, upstream next() for prefetch)
+# - ``producer_wait_s`` stage blocked pulling from upstream (upstream is
+#                       the bottleneck)
+# - ``blocked_put_s``   stage blocked handing off downstream (downstream
+#                       is the bottleneck; bounded queue full)
+# - ``consumer_wait_s`` the CONSUMER blocked on this stage (THIS stage is
+#                       the bottleneck)
+# ---------------------------------------------------------------------------
+
+_stage_registry: "list[weakref.ref]" = []
+_stage_lock = threading.Lock()
+
+
+class StageStats:
+    """Thread-safe counters for one concurrent pipeline stage."""
+
+    def __init__(self, name: str, *, workers: int | None = None,
+                 register: bool = True):
+        self.name = name
+        self.workers = workers
+        self._lock = threading.Lock()
+        self._elements = 0
+        self._busy_s = 0.0
+        self._producer_wait_s = 0.0
+        self._blocked_put_s = 0.0
+        self._consumer_wait_s = 0.0
+        self._queue_depth_sum = 0
+        self._queue_samples = 0
+        self._first_t: float | None = None
+        self._last_t: float | None = None
+        if register:
+            register_stage(self)
+
+    def record(self, *, elements: int = 0, busy_s: float = 0.0,
+               producer_wait_s: float = 0.0, blocked_put_s: float = 0.0,
+               consumer_wait_s: float = 0.0,
+               queue_depth: int | None = None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._first_t is None:
+                self._first_t = now
+            self._last_t = now
+            self._elements += elements
+            self._busy_s += busy_s
+            self._producer_wait_s += producer_wait_s
+            self._blocked_put_s += blocked_put_s
+            self._consumer_wait_s += consumer_wait_s
+            if queue_depth is not None:
+                self._queue_depth_sum += queue_depth
+                self._queue_samples += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            wall = ((self._last_t - self._first_t)
+                    if self._first_t is not None else 0.0)
+            return {
+                "name": self.name,
+                "workers": self.workers,
+                "elements": self._elements,
+                "busy_s": round(self._busy_s, 6),
+                "producer_wait_s": round(self._producer_wait_s, 6),
+                "blocked_put_s": round(self._blocked_put_s, 6),
+                "consumer_wait_s": round(self._consumer_wait_s, 6),
+                "mean_queue_depth": (
+                    round(self._queue_depth_sum / self._queue_samples, 3)
+                    if self._queue_samples else None),
+                "elements_per_sec": (
+                    round(self._elements / wall, 2) if wall > 0 else None),
+            }
+
+
+def register_stage(stats: StageStats) -> None:
+    """Add a stage to the process-wide telemetry registry (weakly held —
+    an abandoned pipeline's stages disappear with it)."""
+    with _stage_lock:
+        _stage_registry.append(weakref.ref(stats))
+
+
+def pipeline_stats(prefix: str | None = None) -> "list[dict]":
+    """Snapshots of every live registered stage, registration order.
+    ``prefix`` filters on the stage name (e.g. ``"map"``)."""
+    out = []
+    with _stage_lock:
+        live = []
+        for ref in _stage_registry:
+            s = ref()
+            if s is not None:
+                live.append(ref)
+                if prefix is None or s.name.startswith(prefix):
+                    out.append(s.snapshot())
+        _stage_registry[:] = live
+    return out
+
+
+def clear_pipeline_stats() -> None:
+    """Drop all registered stages (test isolation)."""
+    with _stage_lock:
+        _stage_registry.clear()
+
+
+def bottleneck_stage() -> dict | None:
+    """The stage its consumer waited on the longest — the pipeline's
+    measured bottleneck (None when nothing is registered)."""
+    snaps = pipeline_stats()
+    if not snaps:
+        return None
+    return max(snaps, key=lambda s: s["consumer_wait_s"])

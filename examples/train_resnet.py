@@ -9,22 +9,75 @@ batch; ONE compiled SPMD step runs on the global mesh and GSPMD inserts
 the gradient allreduce over ICI/DCN — no per-tensor RPC, no collective
 executor.
 
-    # single process, all local devices
+Input: either synthetic device-resident batches (the perf-isolated
+default) or REAL on-disk JPEGs through the parallel host pipeline
+(input/image_ops.py + Dataset.map(num_parallel_calls=AUTOTUNE) +
+prefetch + InfeedLoop double-buffered device_put), with per-step
+infeed-wait reported so host-boundedness is a number, not a guess:
+
+    # single process, all local devices, synthetic batches
     python examples/train_resnet.py --steps 30
 
-    # real multi-process sync DP on one box (3 workers, CPU backend),
-    # TF_CONFIG injected per process exactly like a cluster launch:
-    python examples/train_resnet.py --spawn 3 --steps 10
+    # REAL JPEG path: generate 512 JPEGs on disk, then train from them
+    python examples/train_resnet.py --steps 30 --gen-jpegs 512
 
-    # on a real cluster: launch one process per host with TF_CONFIG set
-    # (TFConfigClusterResolver semantics) and no --spawn flag.
+    # ... or from an existing directory (img_*_cls<label>.jpg layout)
+    python examples/train_resnet.py --steps 30 --data-dir /data/jpegs
+
+    # real multi-process sync DP on one box (3 workers, CPU backend),
+    # TF_CONFIG injected per process exactly like a cluster launch;
+    # JPEG files are FILE-auto-sharded across the workers:
+    python examples/train_resnet.py --spawn 3 --steps 10 --gen-jpegs 512
 """
 
 import argparse
 import time
 
 
-def worker_main(steps: int, global_batch: int, image_size: int):
+def _jpeg_infeed(data_dir: str, runtime, mesh, per_process_batch: int,
+                 image_size: int, num_classes: int):
+    """files -> FILE-sharded parallel decode pipeline -> InfeedLoop
+    staging global jax.Arrays (the host data plane of this example)."""
+    import glob
+    import os
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.input.dataset import AUTOTUNE
+    from distributed_tensorflow_tpu.input.image_ops import jpeg_pipeline
+    from distributed_tensorflow_tpu.training.loops import InfeedLoop
+
+    files = sorted(glob.glob(os.path.join(data_dir, "*.jpg")))
+    if len(files) < runtime.num_processes:
+        raise SystemExit(
+            f"{data_dir} has {len(files)} JPEGs; FILE sharding needs at "
+            f"least one per process ({runtime.num_processes})")
+    ds = jpeg_pipeline(
+        files, batch_size=per_process_batch, image_size=image_size,
+        num_parallel_calls=AUTOTUNE, prefetch_depth=4,
+        num_shards=runtime.num_processes,
+        shard_index=runtime.process_id)
+
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def place(batch):
+        if int(batch["label"].max()) >= num_classes:
+            raise ValueError(
+                f"label {int(batch['label'].max())} >= num_classes "
+                f"{num_classes}; generate the data with matching classes")
+        return {
+            "image": jax.make_array_from_process_local_data(
+                sharding, batch["image"]),
+            "label": jax.make_array_from_process_local_data(
+                sharding, batch["label"]),
+        }
+
+    return InfeedLoop(iter(ds), place_fn=place, buffer_size=3), ds
+
+
+def worker_main(steps: int, global_batch: int, image_size: int,
+                data_dir: str | None = None):
     import jax
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -56,24 +109,34 @@ def worker_main(steps: int, global_batch: int, image_size: int):
     # each process materializes ONLY its slice of the global batch and
     # assembles the global jax.Array from process-local shards.
     sharding = NamedSharding(mesh, P("dp"))
-    local = resnet.synthetic_images(
-        global_batch // runtime.num_processes, image_size,
-        cfg.num_classes, seed=runtime.process_id)
+    per_process = global_batch // runtime.num_processes
 
-    def global_batch_arrays():
-        return {
+    infeed = None
+    if data_dir is not None:
+        infeed, _ds = _jpeg_infeed(data_dir, runtime, mesh, per_process,
+                                   image_size, cfg.num_classes)
+        next_batch = infeed.next
+    else:
+        local = resnet.synthetic_images(
+            per_process, image_size, cfg.num_classes,
+            seed=runtime.process_id)
+        static = {
             "image": jax.make_array_from_process_local_data(
                 sharding, local["image"]),
             "label": jax.make_array_from_process_local_data(
                 sharding, local["label"]),
         }
+        next_batch = lambda: static
 
-    batch = global_batch_arrays()
     t0, imgs = None, 0
     for i in range(steps):
+        batch = next_batch()
         state, metrics = step_fn(state, batch)
         if i == 0:                      # skip compile in the rate
             jax.block_until_ready(metrics["loss"])
+            if infeed is not None:      # spin-up wait is not steady state
+                infeed.total_wait_s = 0.0
+                infeed.batches = 0
             t0 = time.time()
         else:
             imgs += global_batch
@@ -87,7 +150,15 @@ def worker_main(steps: int, global_batch: int, image_size: int):
         print(f"throughput: {imgs / dt:,.1f} images/sec "
               f"({runtime.num_processes} processes, "
               f"{len(jax.devices())} devices)", flush=True)
+        if infeed is not None:
+            frac = infeed.wait_fraction(dt)
+            print(f"infeed wait: {infeed.total_wait_s * 1e3:.1f} ms over "
+                  f"{infeed.batches} steps = {frac:.1%} of wall time "
+                  f"({'host-bound' if frac >= 0.05 else 'device-bound'})",
+                  flush=True)
     final_loss = float(metrics["loss"])
+    if infeed is not None:
+        infeed.stop()
     bootstrap.shutdown()
     return final_loss
 
@@ -98,23 +169,47 @@ def main():
     ap.add_argument("--global-batch", type=int, default=32)
     ap.add_argument("--image-size", type=int, default=32,
                     help="32 = tiny config for CPU demo; 224 = ResNet-50")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory of img_*_cls<label>.jpg files; train "
+                         "on REAL decoded JPEGs through the parallel "
+                         "host pipeline")
+    ap.add_argument("--gen-jpegs", type=int, default=0,
+                    help="generate N JPEGs on disk first and train from "
+                         "them (implies the real-data path)")
     ap.add_argument("--spawn", type=int, default=0,
                     help="spawn N local worker processes with TF_CONFIG "
                          "(multi-worker demo on one box)")
     args = ap.parse_args()
 
+    data_dir = args.data_dir
+    if args.gen_jpegs:
+        import tempfile
+
+        from distributed_tensorflow_tpu.input.image_ops import (
+            generate_jpeg_directory)
+        num_classes = 1000 if args.image_size >= 128 else 10
+        data_dir = tempfile.mkdtemp(prefix="dtx_jpegs_")
+        # sources ~25% larger than the train crop (RandomCrop headroom)
+        generate_jpeg_directory(data_dir, args.gen_jpegs,
+                                image_size=args.image_size * 5 // 4,
+                                num_classes=num_classes)
+        print(f"generated {args.gen_jpegs} JPEGs in {data_dir}",
+              flush=True)
+
     if args.spawn > 1:
         from distributed_tensorflow_tpu.testing import multi_process_runner
         result = multi_process_runner.run(
             worker_main, num_workers=args.spawn,
-            args=(args.steps, args.global_batch, args.image_size),
+            args=(args.steps, args.global_batch, args.image_size,
+                  data_dir),
             timeout=900)
         losses = result.return_values
         print(f"all {len(losses)} workers done; final losses {losses}")
         assert len(set(round(x, 5) for x in losses)) == 1, \
             "sync DP must keep workers bit-identical"
     else:
-        worker_main(args.steps, args.global_batch, args.image_size)
+        worker_main(args.steps, args.global_batch, args.image_size,
+                    data_dir)
 
 
 if __name__ == "__main__":

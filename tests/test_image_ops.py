@@ -1,0 +1,155 @@
+"""Parity tests for input/image_ops.py against the installed tf.image
+(≙ TF/python/ops/image_ops_impl.py) — the contract behind the real-JPEG
+ResNet path: geometry ops bit-exact, resize at float32 round-off, JPEG
+decode toleranced (PIL and TF may use different IDCT implementations),
+and stateless augmentation deterministic at any parallelism."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.input import image_ops
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(7)
+    # structured + noise: JPEG-realistic content, odd sizes on purpose
+    yy, xx = np.mgrid[0:61, 0:47].astype(np.float32)
+    base = np.sin(xx / 9.0) + np.cos(yy / 7.0)
+    arr = np.stack([base * (c + 1) for c in range(3)], -1)
+    arr = arr + rng.normal(0, 0.2, arr.shape)
+    return ((arr - arr.min()) / (np.ptp(arr) + 1e-6) * 255).astype(
+        np.uint8)
+
+
+def test_jpeg_roundtrip_and_decode_parity_vs_tf(img):
+    data = image_ops.encode_jpeg(img, quality=92)
+    ours = image_ops.decode_jpeg(data)
+    assert ours.shape == img.shape and ours.dtype == np.uint8
+    # lossy codec, structured content: close to the original...
+    assert np.mean(np.abs(ours.astype(int) - img.astype(int))) < 6.0
+    # ...and within a few counts of TF's decoder on the same bytes
+    theirs = tf.io.decode_jpeg(data, channels=3).numpy()
+    diff = np.abs(ours.astype(int) - theirs.astype(int))
+    assert diff.mean() < 2.0 and np.percentile(diff, 99) <= 8
+
+
+def test_flip_crop_central_crop_bit_exact_vs_tf(img):
+    np.testing.assert_array_equal(
+        image_ops.flip_left_right(img),
+        tf.image.flip_left_right(img).numpy())
+    np.testing.assert_array_equal(
+        image_ops.crop_to_bounding_box(img, 3, 5, 40, 30),
+        tf.image.crop_to_bounding_box(img, 3, 5, 40, 30).numpy())
+    for frac in (0.5, 0.7, 0.875, 1.0):
+        a = image_ops.central_crop(img, frac)
+        b = tf.image.central_crop(img, frac).numpy()
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError):
+        image_ops.crop_to_bounding_box(img, 0, 0, 100, 100)
+
+
+def test_resize_bilinear_parity_vs_tf(img):
+    for hw in ((17, 29), (80, 100), (61, 47), (21, 90)):
+        ours = image_ops.resize_bilinear(img, *hw)
+        theirs = tf.image.resize(img, hw, method="bilinear").numpy()
+        assert ours.dtype == np.float32
+        np.testing.assert_allclose(ours, theirs, atol=1e-3)
+
+
+def test_rescaling_matches_tf_keras(img):
+    tf_keras = pytest.importorskip("tf_keras")
+    layer = image_ops.Rescaling(1.0 / 127.5, offset=-1.0)
+    ref = tf_keras.layers.Rescaling(1.0 / 127.5, offset=-1.0)
+    np.testing.assert_allclose(
+        layer(img), ref(img[None].astype("float32")).numpy()[0],
+        rtol=1e-6, atol=1e-6)
+
+
+def test_stateless_random_ops_deterministic_and_valid(img):
+    flip = image_ops.RandomFlip(seed=3)
+    crop = image_ops.RandomCrop(32, 24, seed=3)
+    for es in (0, 1, 7, 12345):
+        np.testing.assert_array_equal(flip(img, seed=es),
+                                      flip(img, seed=es))
+        np.testing.assert_array_equal(crop(img, seed=es),
+                                      crop(img, seed=es))
+        out = flip(img, seed=es)
+        assert (np.array_equal(out, img)
+                or np.array_equal(out, image_ops.flip_left_right(img)))
+        c = crop(img, seed=es)
+        assert c.shape == (32, 24, 3)
+    # both branches of the coin occur over many element seeds
+    flips = [not np.array_equal(flip(img, seed=s), img)
+             for s in range(40)]
+    assert any(flips) and not all(flips)
+    # undersized input: upsized then cropped, never an error
+    small = img[:16, :16]
+    assert crop(small, seed=0).shape == (32, 24, 3)
+
+
+def test_generate_decode_pipeline_elements(tmp_path):
+    files = image_ops.generate_jpeg_directory(
+        str(tmp_path), 6, image_size=40, num_classes=4, seed=1)
+    assert len(files) == 6 and all(os.path.exists(f) for f in files)
+    labels = [image_ops.label_from_path(f) for f in files]
+    assert all(0 <= l < 4 for l in labels)
+    fn = image_ops.make_decode_fn(32, seed=0)
+    el = fn(files[0])
+    assert el["image"].shape == (32, 32, 3)
+    assert el["image"].dtype == np.float32
+    assert 0.0 <= el["image"].min() and el["image"].max() <= 1.0
+    assert el["label"] == labels[0]
+    # stateless: the same path decodes identically every time (the
+    # parallel-map determinism contract for augmented elements)
+    np.testing.assert_array_equal(el["image"], fn(files[0])["image"])
+
+
+def test_jpeg_pipeline_order_matches_serial(tmp_path):
+    from distributed_tensorflow_tpu.input.dataset import AUTOTUNE
+
+    files = image_ops.generate_jpeg_directory(
+        str(tmp_path), 8, image_size=40, num_classes=4, seed=2)
+    kw = dict(batch_size=4, image_size=32, repeat=False, seed=5)
+    serial = list(image_ops.jpeg_pipeline(files, num_parallel_calls=None,
+                                          prefetch_depth=0, **kw))
+    parallel = list(image_ops.jpeg_pipeline(
+        files, num_parallel_calls=AUTOTUNE, prefetch_depth=2, **kw))
+    assert len(serial) == len(parallel) == 2
+    for s, p in zip(serial, parallel):
+        np.testing.assert_array_equal(s["image"], p["image"])
+        np.testing.assert_array_equal(s["label"], p["label"])
+
+
+def test_jpeg_tfrecord_pipeline_native_loader_route(tmp_path):
+    """JPEGs packed as tf.train.Examples in TFRecord framing, read by
+    the native C++ loader, decoded in the parallel map — the full
+    native-route data plane, with parallel == serial determinism."""
+    from distributed_tensorflow_tpu.input.dataset import AUTOTUNE
+
+    files = image_ops.generate_jpeg_directory(
+        str(tmp_path / "jpegs"), 8, image_size=40, num_classes=4, seed=3)
+    shard = str(tmp_path / "train.tfrecord")
+    n = image_ops.write_jpeg_tfrecords(shard, files)
+    assert n == 8
+
+    kw = dict(batch_size=4, image_size=32, repeat=False, seed=9)
+    serial = list(image_ops.jpeg_tfrecord_pipeline(
+        shard, num_parallel_calls=None, prefetch_depth=0, **kw))
+    parallel = list(image_ops.jpeg_tfrecord_pipeline(
+        shard, num_parallel_calls=AUTOTUNE, prefetch_depth=2, **kw))
+    assert len(serial) == len(parallel) == 2
+    for s, p in zip(serial, parallel):
+        assert s["image"].shape == (4, 32, 32, 3)
+        assert s["label"].dtype == np.int32
+        np.testing.assert_array_equal(s["image"], p["image"])
+        np.testing.assert_array_equal(s["label"], p["label"])
+    # record order is file order when shuffle=False: labels roundtrip
+    got = np.concatenate([b["label"] for b in serial])
+    np.testing.assert_array_equal(
+        got, [image_ops.label_from_path(f) for f in files])

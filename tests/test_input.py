@@ -485,3 +485,166 @@ def test_bucket_by_sequence_length_pads_trailing_dims():
     assert shapes == [(2, 3, 3), (2, 5, 5)]
     total = sum(float(b.sum()) for b in batches)
     assert total == sum(float(e.sum()) for e in els)   # zero padding only
+
+
+# ---------------------------------------------------------------------------
+# Parallel host pipeline (ISSUE 3 tentpole): ordered fan-out determinism,
+# clean shutdown, fault injection, telemetry
+# ---------------------------------------------------------------------------
+
+def _jittered_square(x):
+    # latency varies per element so out-of-order completion is the NORM:
+    # any reorder bug shows up immediately
+    import time
+    time.sleep(0.0015 * ((int(x) * 7) % 3))
+    return int(x) * int(x)
+
+
+@pytest.mark.parametrize("workers", [2, 5])
+def test_parallel_map_order_bit_identical_vs_serial(workers):
+    serial = list(Dataset.range(60).map(_jittered_square))
+    par = Dataset.range(60).map(_jittered_square,
+                                num_parallel_calls=workers)
+    assert list(par) == serial
+    # re-iteration of the same pipeline stays deterministic too
+    assert list(par) == serial
+
+
+def test_parallel_map_autotune_order_and_stats():
+    from distributed_tensorflow_tpu.input.dataset import AUTOTUNE
+    serial = list(Dataset.range(40).map(_jittered_square))
+    ds = Dataset.range(40).map(_jittered_square,
+                               num_parallel_calls=AUTOTUNE)
+    assert list(ds) == serial
+    (snap,) = ds.pipeline_stats()
+    assert snap["name"].startswith("map")
+    assert snap["workers"] >= 1
+    assert snap["elements"] == 40
+    assert snap["busy_s"] > 0
+
+
+def test_parallel_map_invalid_worker_count():
+    ds = Dataset.range(4).map(lambda x: x, num_parallel_calls=0)
+    with pytest.raises(ValueError, match="num_parallel_calls"):
+        list(ds)
+
+
+def test_parallel_map_error_at_failing_ordinal():
+    def bad(x):
+        if x == 5:
+            raise ValueError("boom at 5")
+        return x
+
+    it = iter(Dataset.range(10).map(bad, num_parallel_calls=3))
+    got = []
+    with pytest.raises(ValueError, match="boom at 5"):
+        for v in it:
+            got.append(v)
+    assert got == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.parametrize("workers", [2, 4])
+def test_parallel_interleave_order_bit_identical_vs_serial(workers):
+    def mk(x):
+        return Dataset.range(int(x) * 10, int(x) * 10 + 1 + int(x) % 3)
+
+    kw = dict(cycle_length=3, block_length=2)
+    serial = list(Dataset.range(9).interleave(mk, **kw))
+    par = list(Dataset.range(9).interleave(
+        mk, num_parallel_calls=workers, **kw))
+    assert par == serial
+
+
+def test_parallel_interleave_autotune_matches_serial():
+    from distributed_tensorflow_tpu.input.dataset import AUTOTUNE
+
+    def mk(x):
+        return Dataset.range(int(x), int(x) + 4)
+
+    serial = list(Dataset.range(7).interleave(mk, cycle_length=4))
+    par = list(Dataset.range(7).interleave(
+        mk, cycle_length=4, num_parallel_calls=AUTOTUNE))
+    assert par == serial
+
+
+def test_parallel_stages_shut_down_on_early_abandonment():
+    import gc
+    import threading
+    import time as _time
+
+    before = {t.name for t in threading.enumerate()}
+    it = iter(Dataset.range(10_000)
+              .map(lambda x: x + 1, num_parallel_calls=3)
+              .prefetch(2))
+    assert next(it) == 1
+    assert next(it) == 2
+    del it
+    gc.collect()
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        alive = {t.name for t in threading.enumerate()} - before
+        if not alive:
+            break
+        _time.sleep(0.05)
+    assert not alive, f"pipeline threads leaked: {alive}"
+
+
+def test_prefetch_fault_site_surfaces_instead_of_hanging():
+    from distributed_tensorflow_tpu.resilience import faults
+
+    sched = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="input.prefetch", hits=(3,)),))
+    with faults.inject(sched) as registry:
+        it = iter(Dataset.range(100).prefetch(2))
+        got = []
+        with pytest.raises(faults.FaultInjected):
+            for v in it:
+                got.append(v)
+        # failed at element 3: everything before it was delivered, and
+        # the pipeline is DEAD afterwards (no hang, no silent resume —
+        # the generator closed when the fault propagated)
+        assert got == [0, 1]
+        with pytest.raises(StopIteration):
+            next(it)
+    assert [e[0] for e in registry.events()] == ["input.prefetch"]
+
+
+def test_prefetch_and_pipeline_stats_expose_bottleneck():
+    from distributed_tensorflow_tpu.utils import profiler
+
+    ds = (Dataset.range(30)
+          .map(_jittered_square, num_parallel_calls=2, name="sq")
+          .prefetch(4, name="pf"))
+    assert list(ds) == [x * x for x in range(30)]
+    snaps = ds.pipeline_stats()
+    assert [s["name"] for s in snaps] == ["map:sq", "prefetch:pf"]
+    pf = snaps[1]
+    assert pf["elements"] == 30
+    assert pf["mean_queue_depth"] is not None
+    # the same stages are visible process-wide for telemetry
+    names = [s["name"] for s in profiler.pipeline_stats()]
+    assert "map:sq" in names and "prefetch:pf" in names
+    assert profiler.bottleneck_stage() is not None
+
+
+def test_infeed_loop_records_wait_time():
+    import time as _time
+
+    from distributed_tensorflow_tpu.training.loops import InfeedLoop
+
+    def slow_source():
+        for i in range(5):
+            _time.sleep(0.02)
+            yield np.full((2,), i, np.float32)
+
+    loop = InfeedLoop(slow_source(), buffer_size=2)
+    out = [loop.next() for _ in range(5)]
+    assert [int(b[0]) for b in out] == list(range(5))
+    # a 20ms/element producer against an instant consumer: the loop
+    # must have measured real wait
+    assert loop.batches == 5
+    assert loop.total_wait_s > 0.01
+    assert loop.mean_wait_s > 0
+    assert 0 < loop.wait_fraction(0.2) 
+    with pytest.raises(StopIteration):
+        loop.next()
