@@ -136,6 +136,60 @@ def ce_grad_parity_smoke() -> str:
         return f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def telemetry_overhead(step, state, batch, iters=30):
+    """Same-run telemetry on/off overhead on a HOST-driven step loop
+    (the loop shape telemetry actually instruments — the fori_loop
+    headline stays on-device and telemetry-free by construction).
+
+    Off is measured twice, interleaved around the on measurement, and
+    the min taken — the same noise discipline as the headline's
+    min-of-reps. Returns the dict attached to the transformer row;
+    acceptance bar: overhead_frac <= 0.02.
+    """
+    import shutil
+    import tempfile
+
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.training.loops import StepTelemetry
+
+    @jax.jit
+    def one(s, b):
+        s2, _metrics = step(s, b)
+        return s2
+
+    jax.block_until_ready(one(state, batch))
+
+    def run(with_telemetry):
+        st = StepTelemetry() if with_telemetry else None
+        s = state
+        t0 = time.perf_counter()
+        for i in range(iters):
+            s = one(s, batch)
+            if st is not None:
+                st.step_completed(i)
+        jax.block_until_ready(s)
+        return (time.perf_counter() - t0) / iters
+
+    tmp = tempfile.mkdtemp(prefix="dtx_bench_telemetry_")
+    try:
+        on, off = float("inf"), float("inf")
+        for _ in range(3):              # interleaved min-of-reps
+            off = min(off, run(False))
+            telemetry.configure(tmp, process_id=0)
+            try:
+                on = min(on, run(True))
+            finally:
+                telemetry.shutdown()
+        n_events = len(telemetry.read_events(
+            telemetry.event_log_path(tmp, 0)))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {"overhead_frac": round(max(0.0, on - off) / off, 4),
+            "on_step_ms": round(on * 1e3, 3),
+            "off_step_ms": round(off * 1e3, 3),
+            "events_logged": n_events}
+
+
 def _timed_loop(step, state, batch, n_iters, reps):
     """Shared fori-loop delta timing (see module docstring): identical
     methodology for every workload so README rows are comparable."""
@@ -446,6 +500,9 @@ def main():
             "seq_len": cfg.max_seq_len,
         },
     }
+    result["extra"]["telemetry"] = telemetry_overhead(
+        step, state, {"tokens": tokens},
+        iters=30 if on_tpu else 8)
     if on_tpu:
         result["extra"]["sp_mosaic_smoke"] = sp_kernel_smoke()
         result["extra"]["ce_grad_parity"] = ce_grad_parity_smoke()

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.parallel.values import DistributedVariable
 from distributed_tensorflow_tpu.resilience import faults
 
@@ -107,6 +108,14 @@ class Checkpoint:
         return path
 
     def write(self, path: str, *, async_write: bool = False) -> str:
+        # span covers the BLOCKING portion (device->host + commit when
+        # sync; device->host + thread handoff when async) — the async
+        # file IO reports separately via the checkpoint.commit event
+        with telemetry.span("checkpoint.save", path=path,
+                            async_write=async_write):
+            return self._write_impl(path, async_write=async_write)
+
+    def _write_impl(self, path: str, *, async_write: bool) -> str:
         flat = _flatten(self._objects)
         proc = jax.process_index()
         tmp = f"{path}.tmp.{proc}"
@@ -141,12 +150,13 @@ class Checkpoint:
             # fsync BEFORE the rename into place: an OS crash after the
             # rename must not leave a shard whose data pages never hit
             # disk (rename is only atomic for the directory entry).
-            shard = os.path.join(tmp, f"shard_{proc}.npz")
-            with open(shard, "wb") as f:
-                np.savez(f, **host_arrays)
-                f.flush()
-                os.fsync(f.fileno())
-            self._commit(tmp, path, index)
+            with telemetry.span("checkpoint.commit", path=path):
+                shard = os.path.join(tmp, f"shard_{proc}.npz")
+                with open(shard, "wb") as f:
+                    np.savez(f, **host_arrays)
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._commit(tmp, path, index)
 
         def finish_async():
             try:
@@ -334,6 +344,10 @@ class Checkpoint:
         """Restore from ``path``. DistributedVariables are assigned in
         place (re-placed with their sharding); plain leaves are returned in
         the result pytree."""
+        with telemetry.span("checkpoint.restore", path=path):
+            return self._restore_impl(path)
+
+    def _restore_impl(self, path: str) -> dict:
         self._join_pending()
         index_path = os.path.join(path, _INDEX_FILE)
         if not os.path.exists(index_path):

@@ -32,6 +32,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.coordinator import metric_utils
 from distributed_tensorflow_tpu.coordinator.watchdog import WatchDog
 from distributed_tensorflow_tpu.resilience import faults
@@ -178,8 +179,25 @@ class _CoordinatedClosureQueue:
         self._cancelled = False
         self._max = max_pending
         self._cv = threading.Condition()
-        self.closures_queued = metric_utils.Counter("queued_closures")
-        self.closures_done = metric_utils.Counter("done_closures")
+        self.closures_queued = metric_utils.Counter("closures_queued_total")
+        self.closures_done = metric_utils.Counter("closures_done_total")
+        self._gauge_queued = None       # attach_gauges wires these to the
+        self._gauge_inflight = None     # CoordinatorMetrics gauge cells
+
+    def attach_gauges(self, queued: "metric_utils.Gauge",
+                      inflight: "metric_utils.Gauge"):
+        """Wire the queued/inflight CoordinatorMetrics cells to this
+        queue's live depth (read by snapshots/fleet rollups)."""
+        with self._cv:
+            self._gauge_queued = queued
+            self._gauge_inflight = inflight
+            self._update_gauges_locked()
+
+    def _update_gauges_locked(self):
+        if self._gauge_queued is not None:
+            self._gauge_queued.set(len(self._queue))
+        if self._gauge_inflight is not None:
+            self._gauge_inflight.set(self._inflight)
 
     def _raise_if_error(self):
         if self._error is not None:
@@ -195,6 +213,7 @@ class _CoordinatedClosureQueue:
             self._raise_if_error()
             self._queue.append(closure)
             self.closures_queued.increment()
+            self._update_gauges_locked()
             self._cv.notify_all()
 
     def get(self, timeout: float | None = None) -> Closure | None:
@@ -206,6 +225,7 @@ class _CoordinatedClosureQueue:
                 return None
             closure = self._queue.pop(0)
             self._inflight += 1
+            self._update_gauges_locked()
             self._cv.notify_all()
             return closure
 
@@ -216,12 +236,14 @@ class _CoordinatedClosureQueue:
                 closure.mark_cancelled()
             else:
                 self._queue.insert(0, closure)
+            self._update_gauges_locked()
             self._cv.notify_all()
 
     def mark_finished(self, closure: Closure):
         with self._cv:
             self._inflight -= 1
             self.closures_done.increment()
+            self._update_gauges_locked()
             self._cv.notify_all()
 
     def mark_failed(self, err: BaseException):
@@ -231,6 +253,7 @@ class _CoordinatedClosureQueue:
             for c in self._queue:
                 c.mark_cancelled()
             self._queue.clear()
+            self._update_gauges_locked()
             self._cv.notify_all()
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -314,18 +337,27 @@ class Worker:
                     closure.execute_on(self)
             queue.mark_finished(closure)
             self.cluster.health.record_success(self.worker_index)
-        except WorkerPreemptionError:
+        except WorkerPreemptionError as e:
             # ≙ WorkerPreemptionHandler.wait_on_failure (:879): transparent
             # retry on another worker; this lane backs off (and is
             # quarantined by the health tracker if it keeps failing)
             self.failures += 1
             self.cluster.health.record_failure(self.worker_index)
+            telemetry.counter("coordinator/dispatch_retries",
+                              "closures re-queued after worker "
+                              "preemption").increment()
+            telemetry.event("dispatch.retry", worker=self.worker_index,
+                            error=str(e)[:200])
             queue.put_back(closure)
         except PSUnavailableError as e:
             closure.output._set_error(e)
+            telemetry.event("dispatch.failure", worker=self.worker_index,
+                            kind="ps_unavailable", error=str(e)[:200])
             queue.mark_failed(e)
         except BaseException as e:  # application error -> surface to user
             closure.output._set_error(e)
+            telemetry.event("dispatch.failure", worker=self.worker_index,
+                            kind=type(e).__name__, error=str(e)[:200])
             queue.mark_failed(e)
 
     def stop(self):
@@ -344,6 +376,8 @@ class Cluster:
                  health: WorkerHealthTracker | None = None):
         self.closure_queue = _CoordinatedClosureQueue()
         self.coordinator_metrics = metric_utils.CoordinatorMetrics()
+        self.closure_queue.attach_gauges(self.coordinator_metrics.queued,
+                                         self.coordinator_metrics.inflight)
         self.health = health or WorkerHealthTracker()
         n = (len(remote_worker_ids) if remote_worker_ids is not None
              else num_workers)

@@ -2,85 +2,53 @@
 
 ≙ tensorflow/python/distribute/coordinator/metric_utils.py (SURVEY.md §2.5,
 :89 ``monitored_timer``) and the tf.monitoring gauges in distribute_lib
-(SURVEY §5.5). Plain-Python instruments: thread-safe, inspectable, no
-backend dependency.
+(SURVEY §5.5). Since the telemetry subsystem landed these are thin
+back-compat shims over :mod:`distributed_tensorflow_tpu.telemetry`
+instruments: the classes keep their historical constructor/property
+surface (``Counter(name).value``, ``Timer(name).time()``,
+``total_seconds``/``average_seconds``) and additionally self-register in
+the process-wide MetricsRegistry under ``coordinator/<name>`` — so
+coordinator activity shows up in registry snapshots, fleet rollups, and
+``tools/obs_report.py`` without any caller changing.
+
+Instances own their storage (one closure queue per Cluster keeps its own
+counts); registration is latest-wins, so the registry always reads the
+live instance.
 """
 
 from __future__ import annotations
 
-import contextlib
-import threading
-import time
+from distributed_tensorflow_tpu.telemetry import registry as _telemetry
+
+_NAMESPACE = "coordinator"
 
 
-class Counter:
-    def __init__(self, name: str):
-        self.name = name
-        self._value = 0
-        self._lock = threading.Lock()
-
-    def increment(self, n: int = 1):
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
+def _register(instrument, name: str):
+    _telemetry.get_registry().register(instrument,
+                                       f"{_NAMESPACE}/{name}")
+    return instrument
 
 
-class Gauge:
+class Counter(_telemetry.Counter):
+    def __init__(self, name: str, description: str = ""):
+        super().__init__(name, description)
+        _register(self, name)
+
+
+class Gauge(_telemetry.Gauge):
     """≙ tf.monitoring StringGauge/IntGauge (distribution_strategy_gauge)."""
 
-    def __init__(self, name: str):
-        self.name = name
-        self._value = None
-        self._lock = threading.Lock()
-
-    def set(self, value):
-        with self._lock:
-            self._value = value
-
-    @property
-    def value(self):
-        with self._lock:
-            return self._value
+    def __init__(self, name: str, description: str = ""):
+        super().__init__(name, description)
+        _register(self, name)
 
 
-class Timer:
+class Timer(_telemetry.Timer):
     """Accumulating timer (≙ monitored_timer, metric_utils.py:89)."""
 
-    def __init__(self, name: str):
-        self.name = name
-        self._total = 0.0
-        self._count = 0
-        self._lock = threading.Lock()
-
-    @contextlib.contextmanager
-    def time(self):
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - start
-            with self._lock:
-                self._total += dt
-                self._count += 1
-
-    @property
-    def total_seconds(self) -> float:
-        with self._lock:
-            return self._total
-
-    @property
-    def count(self) -> int:
-        with self._lock:
-            return self._count
-
-    @property
-    def average_seconds(self) -> float:
-        with self._lock:
-            return self._total / self._count if self._count else 0.0
+    def __init__(self, name: str, description: str = ""):
+        super().__init__(name, description)
+        _register(self, name)
 
 
 class CoordinatorMetrics:

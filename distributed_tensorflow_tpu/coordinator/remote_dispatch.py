@@ -56,6 +56,8 @@ from distributed_tensorflow_tpu.cluster.coordination import (
 )
 from distributed_tensorflow_tpu.resilience import faults
 from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
+from distributed_tensorflow_tpu.telemetry import events as telemetry_events
+from distributed_tensorflow_tpu.telemetry import registry as telemetry_registry
 
 _ROOT = "dtx_coord"
 _HEARTBEAT_INTERVAL = 0.2
@@ -202,13 +204,28 @@ class RemoteLane:
         of how many closures the job schedules."""
         from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
             import WorkerPreemptionError
-        faults.fire("dispatch.wait", tag=self.worker_id,
-                    exc=WorkerPreemptionError,
-                    msg=f"injected preemption: worker {self.worker_id}, "
-                        f"closure {seq}")
-        deadline = (time.monotonic() + timeout_s) if timeout_s else None
-        rkey = _result_key(self.generation, self.worker_id, seq)
-        backoff = Backoff(_WAIT_BACKOFF_POLICY)
+        # Stall attribution: while this lane blocks (including inside an
+        # injected dispatch.wait chaos delay), the telemetry stall
+        # detector can see WHICH worker the coordinator is waiting on
+        # (telemetry/stall.suspect_worker reads these gauges).
+        wait_gauge = telemetry_registry.gauge(
+            f"coordinator/dispatch/waiting/{self.worker_id}")
+        wait_gauge.set(time.monotonic())
+        try:
+            faults.fire("dispatch.wait", tag=self.worker_id,
+                        exc=WorkerPreemptionError,
+                        msg=f"injected preemption: worker "
+                            f"{self.worker_id}, closure {seq}")
+            deadline = (time.monotonic() + timeout_s) if timeout_s else None
+            rkey = _result_key(self.generation, self.worker_id, seq)
+            backoff = Backoff(_WAIT_BACKOFF_POLICY)
+            return self._wait_inner(seq, rkey, deadline, backoff)
+        finally:
+            wait_gauge.set(None)
+
+    def _wait_inner(self, seq: int, rkey: str, deadline, backoff):
+        from distributed_tensorflow_tpu.coordinator.cluster_coordinator \
+            import WorkerPreemptionError
         while True:
             # Blocking get in staleness-sized slices: wakes immediately
             # when the worker publishes, touches the service once per
@@ -231,6 +248,9 @@ class RemoteLane:
                 else:
                     backoff.reset()      # full slice elapsed: not an error
             if not self.alive():
+                telemetry_events.event("dispatch.preempted",
+                                       worker=self.worker_id, closure=seq,
+                                       staleness_s=self.staleness_s)
                 raise WorkerPreemptionError(
                     f"worker {self.worker_id} heartbeat stale "
                     f"(>{self.staleness_s}s) while closure {seq} in flight")
@@ -268,6 +288,8 @@ class RemoteLane:
         status, data = pickle.loads(res)
         if status == "ok":
             return data
+        telemetry_events.event("dispatch.closure_error",
+                               worker=self.worker_id, closure=seq)
         raise RemoteClosureError(
             f"closure failed on worker {self.worker_id}:\n{data}")
 
@@ -411,15 +433,23 @@ class RemoteWorkerService:
                     continue             # no task yet: re-check shutdown
                 fn, args, kwargs = pickle.loads(payload)
                 try:
-                    args = resolve_resources(args, self.resources)
-                    kwargs = resolve_resources(kwargs, self.resources)
-                    # the service instance is discoverable by closures
-                    # that create worker-side resources
-                    _CURRENT_SERVICE.service = self
-                    result = fn(*args, **kwargs)
+                    with telemetry_registry.timer(
+                            "worker/closure_execution").time():
+                        args = resolve_resources(args, self.resources)
+                        kwargs = resolve_resources(kwargs, self.resources)
+                        # the service instance is discoverable by closures
+                        # that create worker-side resources
+                        _CURRENT_SERVICE.service = self
+                        result = fn(*args, **kwargs)
                     resp = pickle.dumps(("ok", result))
+                    telemetry_registry.counter(
+                        "worker/closures_executed").increment()
                 except BaseException:
                     resp = pickle.dumps(("error", traceback.format_exc()))
+                    telemetry_registry.counter(
+                        "worker/closures_failed").increment()
+                    telemetry_events.event("worker.closure_error",
+                                           worker=self.worker_id, seq=seq)
                 # The coordinator (sole watermark writer) advances
                 # done/<w> as it consumes; the worker only publishes the
                 # result and moves on.

@@ -31,6 +31,12 @@ class WatchDog:
     def report_activity(self):
         self._last_activity = time.time()
 
+    def set_timeout(self, timeout: float):
+        """Re-arm with a new inactivity budget (the telemetry stall
+        detector tracks a multiple of the trailing median step time).
+        Takes effect within the current 1s wait slice."""
+        self._timeout = timeout
+
     @property
     def triggered_count(self) -> int:
         return self._triggered_count

@@ -31,6 +31,9 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.utils.jax_compat import (
+    safe_donate_argnums)
 import optax
 from flax import linen as nn
 from flax.linen import partitioning as nn_partitioning
@@ -780,7 +783,7 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
             step,
             in_shardings=(state_shardings, batch_shardings),
             out_shardings=(state_shardings, replicated),
-            donate_argnums=(0,))
+            donate_argnums=safe_donate_argnums((0,)))
 
     def wrapped_step(state, batch):
         with mesh, nn_partitioning.axis_rules(rules):
@@ -909,7 +912,7 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
         step_jit = jax.jit(train_step,
                            in_shardings=(state_shardings, batch_shardings),
                            out_shardings=(state_shardings, replicated),
-                           donate_argnums=(0,))
+                           donate_argnums=safe_donate_argnums((0,)))
 
     def wrapped(state, batch):
         with mesh:

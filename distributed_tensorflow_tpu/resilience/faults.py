@@ -231,6 +231,15 @@ class FaultRegistry:
                 break
         if decision is None:
             return None
+        # Telemetry: every firing is visible in the run's structured
+        # event log + fleet metric rollups (chaos runs are exactly the
+        # runs an operator later reconstructs from telemetry).
+        from distributed_tensorflow_tpu.telemetry import events as _tv_events
+        from distributed_tensorflow_tpu.telemetry import registry as _tv_reg
+        _tv_reg.counter("resilience/faults_fired",
+                        "chaos-layer fault firings").increment()
+        _tv_events.event("fault.fired", site=site, tag=tag,
+                         hit=decision.hit, action=decision.action)
         if decision.action == "delay":
             time.sleep(decision.delay_s)
             return decision

@@ -18,6 +18,8 @@ import dataclasses
 import threading
 import time
 
+from distributed_tensorflow_tpu.telemetry import registry as _telemetry
+
 
 @dataclasses.dataclass
 class _WorkerHealth:
@@ -44,6 +46,21 @@ class WorkerHealthTracker:
         self._now = time_fn
         self._lock = threading.Lock()
         self._workers: dict[int, _WorkerHealth] = {}
+        # registry export: counters are process-cumulative (shared across
+        # tracker instances); the per-worker detail rides a snapshot
+        # collector (latest tracker wins — one live tracker per process)
+        reg = _telemetry.get_registry()
+        self._failures_total = reg.counter(
+            "resilience/worker_failures_total")
+        self._quarantines_total = reg.counter(
+            "resilience/quarantines_total")
+        reg.register_collector("resilience/health", self._collect)
+
+    def _collect(self) -> dict:
+        snap = self.snapshot()
+        return {"healthy_workers": len(self.healthy_workers()),
+                "quarantined_workers": sum(
+                    1 for h in snap.values() if h["quarantined"])}
 
     def register(self, worker_id: int):
         with self._lock:
@@ -56,6 +73,7 @@ class WorkerHealthTracker:
 
     def record_failure(self, worker_id: int) -> bool:
         """Returns True if this failure newly quarantined the worker."""
+        self._failures_total.increment()
         with self._lock:
             h = self._workers.setdefault(worker_id, _WorkerHealth())
             h.consecutive_failures += 1
@@ -68,7 +86,8 @@ class WorkerHealthTracker:
             h.quarantined_until = self._now() + self.quarantine_s
             h.quarantine_count += 1
             h.consecutive_failures = 0
-            return True
+        self._quarantines_total.increment()
+        return True
 
     def record_success(self, worker_id: int):
         with self._lock:

@@ -1,0 +1,85 @@
+"""Unified telemetry: metrics registry, structured events, fleet rollups.
+
+The observability backbone (≙ the reference's tf.monitoring gauges +
+coordinator monitored_timer metrics + tf.summary event files, SURVEY.md
+§2.5/§5.5), in four pieces:
+
+- :mod:`registry`  — MetricsRegistry: namespaced Counter / Gauge /
+  Histogram / Timer instruments with snapshot/delta export. Every
+  existing instrument set (coordinator/metric_utils.py, utils/summary.py
+  gauges, resilience/health.py, input pipeline stage stats,
+  resilience/faults.py firings) registers through it.
+- :mod:`events`    — structured run events: ``span``/``event`` API
+  writing append-only JSONL with monotonic timestamps; rendered by
+  ``tools/obs_report.py``.
+- :mod:`aggregate` — cross-host aggregation: workers publish snapshots
+  through the coordination KV store; the coordinator merges fleet
+  rollups (sum/max/p50/p95) and emits them to TensorBoard.
+- :mod:`stall`     — StallDetector layered on coordinator/watchdog.py:
+  no step within ``factor`` x trailing median -> ``stall.suspected``
+  naming the slowest worker, non-fatal.
+
+Quick start::
+
+    from distributed_tensorflow_tpu import telemetry
+
+    telemetry.configure("/tmp/run1/telemetry")     # per-process JSONL
+    step_t = telemetry.timer("training/step_time")
+    with telemetry.span("train.step", step=i), step_t.time():
+        state, metrics = step_fn(state, batch)
+
+Telemetry is OFF by default: with no event log configured and no
+publisher started, instrumented call sites cost one None check.
+"""
+
+from distributed_tensorflow_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Timer,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+    timer,
+)
+from distributed_tensorflow_tpu.telemetry.events import (
+    ENV_TELEMETRY_DIR,
+    EventLog,
+    EventLogCorruptError,
+    configure,
+    enabled,
+    event,
+    event_log_path,
+    get_event_log,
+    read_events,
+    read_run,
+    shutdown,
+    span,
+)
+from distributed_tensorflow_tpu.telemetry.aggregate import (
+    FleetAggregator,
+    MetricsPublisher,
+    collect_rollup,
+    merge_rollup,
+    publish_snapshot,
+    read_snapshots,
+    rollup_scalars,
+)
+from distributed_tensorflow_tpu.telemetry.stall import (
+    StallDetector,
+    suspect_worker,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Timer",
+    "counter", "gauge", "get_registry", "histogram", "timer",
+    "ENV_TELEMETRY_DIR", "EventLog", "EventLogCorruptError", "configure",
+    "enabled", "event", "event_log_path", "get_event_log", "read_events",
+    "read_run", "shutdown", "span",
+    "FleetAggregator", "MetricsPublisher", "collect_rollup",
+    "merge_rollup", "publish_snapshot", "read_snapshots",
+    "rollup_scalars",
+    "StallDetector", "suspect_worker",
+]

@@ -1,0 +1,279 @@
+"""Cross-host metric aggregation over the coordination KV store.
+
+Workers publish periodic registry snapshots; the coordinator merges
+them into fleet rollups and emits them to TensorBoard:
+
+    worker p                         coordinator (process 0)
+    --------                         -----------------------
+    MetricsPublisher thread          FleetAggregator thread
+    snap = registry.snapshot()       for p in worker_ids:
+    kv[telemetry/snap/p] = json        read kv[telemetry/snap/p]
+      (every interval_s)             rollup = merge(snapshots)
+                                     SummaryWriter <- fleet/<name>/<stat>
+
+Legacy-jaxlib discipline (see cluster/coordination.py and the memory
+notes): snapshots are JSON **strings** (the string KV API is the only
+one safe in every read direction on jaxlib<=0.4.36), the coordinator
+reads them with enumerated per-process point reads (``try_get`` per
+worker id — NEVER a directory read, which hangs off-host on that
+vintage), and keys are overwritten in place, never deleted-and-recreated.
+
+Rollup semantics per instrument type:
+
+- counter    -> ``sum`` across processes, ``max``, per-worker values
+- gauge      -> per-worker values (+ ``max``/``mean`` when numeric)
+- histogram/timer -> ``count``/``sum`` summed; ``max`` of maxes;
+  ``p50`` = count-weighted median of per-worker p50s (approximate —
+  workers export percentiles, not samples); ``p95`` = max of per-worker
+  p95s (conservative: fleet tail latency is at least the worst worker's)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from distributed_tensorflow_tpu.telemetry import registry as _registry
+
+_SNAP_PREFIX = "dtx_telemetry/snap"
+
+
+def _snap_key(process_id: int) -> str:
+    return f"{_SNAP_PREFIX}/{process_id}"
+
+
+def publish_snapshot(agent=None, reg=None,
+                     process_id: int | None = None, seq: int = 0) -> dict:
+    """Publish this process's registry snapshot to the coordination KV.
+    Returns the published payload."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        coordination_service)
+    agent = agent or coordination_service()
+    reg = reg or _registry.get_registry()
+    pid = process_id if process_id is not None else agent.process_id
+    payload = {"pid": pid, "seq": seq, "wall": time.time(),
+               "metrics": reg.snapshot()}
+    agent.key_value_set(_snap_key(pid), json.dumps(payload))
+    return payload
+
+
+def read_snapshots(agent=None, worker_ids=None) -> dict:
+    """Enumerated point reads of every process's latest snapshot:
+    ``{pid: payload}`` (absent processes omitted)."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        coordination_service)
+    agent = agent or coordination_service()
+    if worker_ids is None:
+        worker_ids = range(agent.num_processes)
+    out: dict[int, dict] = {}
+    for pid in worker_ids:
+        raw = agent.key_value_try_get(_snap_key(pid))
+        if raw is None:
+            continue
+        try:
+            out[pid] = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue                    # torn publish: take the next one
+    return out
+
+
+def _weighted_median(pairs: "list[tuple[float, float]]") -> float | None:
+    """(value, weight) pairs -> weighted median."""
+    pairs = sorted(p for p in pairs if p[0] is not None)
+    if not pairs:
+        return None
+    total = sum(w for _, w in pairs) or len(pairs)
+    acc = 0.0
+    for v, w in pairs:
+        acc += w if w else 1.0
+        if acc * 2 >= total:
+            return v
+    return pairs[-1][0]
+
+
+def merge_rollup(snapshots: "dict[int, dict]") -> dict:
+    """Merge per-process snapshot payloads into one fleet rollup:
+    ``{"workers": {...}, "metrics": {name: {stat: value}}}``."""
+    per_metric: dict[str, dict[int, dict]] = {}
+    workers: dict[int, dict] = {}
+    for pid, payload in snapshots.items():
+        workers[pid] = {"seq": payload.get("seq"),
+                        "wall": payload.get("wall")}
+        for name, entry in (payload.get("metrics") or {}).items():
+            per_metric.setdefault(name, {})[pid] = entry
+
+    metrics: dict[str, dict] = {}
+    for name, by_pid in sorted(per_metric.items()):
+        kinds = {e.get("type") for e in by_pid.values()}
+        kind = kinds.pop() if len(kinds) == 1 else "gauge"
+        out: dict = {"type": kind}
+        if kind == "counter":
+            vals = {p: e.get("value", 0) for p, e in by_pid.items()}
+            out["sum"] = sum(vals.values())
+            out["max"] = max(vals.values())
+            out["per_worker"] = vals
+        elif kind in ("histogram", "timer"):
+            counts = {p: e.get("count", 0) for p, e in by_pid.items()}
+            out["count"] = sum(counts.values())
+            out["sum"] = round(sum(e.get("sum") or 0.0
+                                   for e in by_pid.values()), 9)
+            maxes = [e.get("max") for e in by_pid.values()
+                     if e.get("max") is not None]
+            if maxes:
+                out["max"] = max(maxes)
+            p50 = _weighted_median(
+                [(e.get("p50"), counts[p]) for p, e in by_pid.items()
+                 if e.get("p50") is not None])
+            if p50 is not None:
+                out["p50"] = p50
+            p95s = [e.get("p95") for e in by_pid.values()
+                    if e.get("p95") is not None]
+            if p95s:
+                out["p95"] = max(p95s)
+            out["per_worker_count"] = counts
+        else:                            # gauge
+            vals = {p: e.get("value") for p, e in by_pid.items()}
+            out["per_worker"] = vals
+            nums = [v for v in vals.values()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)]
+            if nums:
+                out["max"] = max(nums)
+                out["mean"] = sum(nums) / len(nums)
+        metrics[name] = out
+    return {"workers": workers, "metrics": metrics}
+
+
+def collect_rollup(agent=None, worker_ids=None) -> dict:
+    """One-shot: read every process's snapshot and merge."""
+    return merge_rollup(read_snapshots(agent, worker_ids))
+
+
+def rollup_scalars(rollup: dict) -> dict:
+    """Flatten a rollup into TensorBoard scalar tags:
+    ``fleet/<metric>/<stat> -> float``."""
+    out: dict[str, float] = {}
+    for name, entry in rollup.get("metrics", {}).items():
+        for stat in ("sum", "max", "mean", "p50", "p95", "count"):
+            v = entry.get(stat)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"fleet/{name}/{stat}"] = float(v)
+    return out
+
+
+class MetricsPublisher:
+    """Worker-side background thread publishing registry snapshots on a
+    period. ``stop()`` publishes one final snapshot so short runs are
+    never invisible to the coordinator."""
+
+    def __init__(self, agent=None, reg=None,
+                 interval_s: float = 2.0, process_id: int | None = None):
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        self.agent = agent or coordination_service()
+        self.reg = reg or _registry.get_registry()
+        self.interval_s = interval_s
+        self.process_id = (process_id if process_id is not None
+                           else self.agent.process_id)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtx-telemetry-publish")
+        self._thread.start()
+
+    def _publish(self):
+        self._seq += 1
+        try:
+            publish_snapshot(self.agent, self.reg,
+                             process_id=self.process_id, seq=self._seq)
+        except Exception:
+            pass                        # service going down mid-run
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self._publish()
+
+    def stop(self):
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._publish()             # final flush
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class FleetAggregator:
+    """Coordinator-side background thread: collect per-process
+    snapshots, merge into a fleet rollup, emit scalars to TensorBoard
+    (utils/summary.SummaryWriter). ``last_rollup`` is the stall
+    detector's source for naming the slowest worker."""
+
+    def __init__(self, worker_ids, agent=None, interval_s: float = 2.0,
+                 summary_writer=None, step_metric: str =
+                 "training/steps_completed"):
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        self.agent = agent or coordination_service()
+        self.worker_ids = list(worker_ids)
+        self.interval_s = interval_s
+        self.writer = summary_writer
+        self.step_metric = step_metric
+        self._rollup_lock = threading.Lock()
+        self._last_rollup: dict | None = None
+        self._n = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dtx-telemetry-aggregate")
+        self._thread.start()
+
+    @property
+    def last_rollup(self) -> dict | None:
+        with self._rollup_lock:
+            return self._last_rollup
+
+    def collect_once(self) -> dict:
+        rollup = collect_rollup(self.agent, self.worker_ids)
+        with self._rollup_lock:
+            self._last_rollup = rollup
+            self._n += 1
+            n = self._n
+        if self.writer is not None and rollup.get("metrics"):
+            # global step for the scalar series: the fleet-max completed
+            # step when published, else the rollup ordinal
+            step_entry = rollup["metrics"].get(self.step_metric, {})
+            step = int(step_entry.get("max", n) or n)
+            try:
+                self.writer.scalars(rollup_scalars(rollup), step=step)
+                self.writer.flush()
+            except Exception:
+                pass
+        return rollup
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.collect_once()
+            except Exception:
+                pass                    # service teardown mid-run
+
+    def stop(self):
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            try:
+                self.collect_once()     # final rollup
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

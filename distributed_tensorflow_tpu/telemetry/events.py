@@ -1,0 +1,269 @@
+"""Structured run events: append-only JSONL spans/events.
+
+The run-level "what happened" record: every process of a job writes an
+append-only JSONL file of structured events with monotonic timestamps —
+step completions, dispatch retries, checkpoint spans, fault firings.
+``tools/obs_report.py`` renders a finished run's files into a
+human-readable report; the stall detector and cross-host aggregation
+consume the same stream live.
+
+Record format (one JSON object per line)::
+
+    {"ev": "train.step", "t": 12.034561, "wall": 1755312000.2,
+     "pid": 0, "dur_s": 0.0312, "step": 7, "loss": 2.31}
+
+- ``ev``    event name, dotted namespace (``train.step``,
+  ``dispatch.retry``, ``checkpoint.save``, ``stall.suspected``)
+- ``t``     monotonic seconds since this process's log was opened —
+  strictly ordered within a file regardless of wall-clock steps
+- ``wall``  wall time (cross-host correlation, human display)
+- ``pid``   the process id in the cluster (jax.process_index vintage)
+- ``dur_s`` present for span-end events: the span's duration
+
+API::
+
+    telemetry.configure(logdir)          # or env DTX_TELEMETRY_DIR
+    telemetry.event("dispatch.retry", worker=3)
+    with telemetry.span("checkpoint.save", path=p):
+        ...                              # emits dur_s on exit
+
+With no log configured — the production default — ``event``/``span``
+are a single module-global None check: zero overhead, no allocation
+(same contract as resilience/faults.fire).
+
+Reading back: :func:`read_events` parses a file, tolerating a torn
+final line (a crashed process mid-write) but refusing mid-file
+corruption — the distinction ``obs_report --check`` enforces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import threading
+import time
+
+
+class EventLogCorruptError(ValueError):
+    """A JSONL event file is corrupt before its final line (torn tails
+    are expected from crashed writers; mid-file damage is not)."""
+
+
+class EventLog:
+    """Append-only JSONL event writer for one process.
+
+    One file handle per process, all writes serialized under a lock and
+    written as complete lines (a reader can never observe a half
+    record except the final line of a crashed writer).
+    """
+
+    def __init__(self, path: str, process_id: int | None = None,
+                 run_id: str | None = None):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.process_id = process_id if process_id is not None else 0
+        self._lock = threading.Lock()
+        self._f: io.TextIOBase | None = open(path, "a",
+                                             encoding="utf-8")
+        self._t0 = time.monotonic()
+        self._last_t = 0.0
+        if run_id:
+            self.event("run.start", run_id=run_id)
+
+    # -- write ------------------------------------------------------------
+    def event(self, name: str, **fields):
+        """Append one structured event; returns the record written."""
+        rec = {"ev": name}
+        with self._lock:
+            if self._f is None:
+                return None
+            # monotonic within the file even if time.monotonic were to
+            # be adjusted (it can't go backwards, but clamp anyway so
+            # the file-level invariant is unconditional)
+            t = time.monotonic() - self._t0
+            if t < self._last_t:
+                t = self._last_t
+            self._last_t = t
+            rec["t"] = round(t, 6)
+            rec["wall"] = round(time.time(), 6)
+            rec["pid"] = self.process_id
+            rec.update(fields)
+            self._f.write(json.dumps(rec) + "\n")
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        """Scoped span: emits ``<name>`` at exit with ``dur_s`` (and
+        ``error`` when the body raised). Yields a dict the body may add
+        result fields to (e.g. ``sp["bytes"] = n``)."""
+        extra: dict = {}
+        t0 = time.perf_counter()
+        try:
+            yield extra
+        except BaseException as e:
+            extra["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            merged = {"dur_s": round(time.perf_counter() - t0, 6)}
+            merged.update(fields)
+            merged.update(extra)        # body-added fields win; never a
+            self.event(name, **merged)  # duplicate-kwarg TypeError here
+
+    def flush(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                self._f.close()
+                self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Process-wide log (the faults.py activation pattern: a single global,
+# None = disabled = zero overhead).
+# ---------------------------------------------------------------------------
+
+_LOG: EventLog | None = None
+_LOG_LOCK = threading.Lock()
+
+#: Env var children of multi_process_runner inherit: a directory to
+#: write per-process event logs into (file name carries the process id).
+ENV_TELEMETRY_DIR = "DTX_TELEMETRY_DIR"
+
+
+def _default_process_id() -> int:
+    # jax.process_index() without forcing backend init in processes that
+    # never initialize jax.distributed (single-host tools, tests).
+    try:
+        import jax
+        if jax._src.distributed.global_state.client is not None:
+            return jax.process_index()
+    except Exception:
+        pass
+    try:
+        return int(os.environ.get("DTX_TASK_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def event_log_path(logdir: str, process_id: int) -> str:
+    return os.path.join(logdir, f"events-{process_id}.jsonl")
+
+
+def configure(logdir: str, process_id: int | None = None,
+              run_id: str | None = None) -> EventLog:
+    """Open (or replace) the process-wide event log under ``logdir``.
+    Each process writes its own ``events-<pid>.jsonl``."""
+    global _LOG
+    pid = process_id if process_id is not None else _default_process_id()
+    with _LOG_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+        _LOG = EventLog(event_log_path(logdir, pid), process_id=pid,
+                        run_id=run_id)
+        return _LOG
+
+
+def shutdown():
+    """Close and detach the process-wide log (back to zero-overhead)."""
+    global _LOG
+    with _LOG_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+        _LOG = None
+
+
+def get_event_log() -> EventLog | None:
+    return _LOG
+
+
+def enabled() -> bool:
+    """True when a process-wide event log is configured. Call sites with
+    non-trivial field construction guard on this; plain sites just call
+    :func:`event` (a no-op without a log)."""
+    return _LOG is not None
+
+
+def event(name: str, **fields):
+    """Module-level event against the process-wide log; no-op (one
+    None check) when telemetry is off."""
+    log = _LOG
+    if log is None:
+        return None
+    return log.event(name, **fields)
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Module-level span; a plain passthrough when telemetry is off."""
+    log = _LOG
+    if log is None:
+        yield {}
+        return
+    with log.span(name, **fields) as extra:
+        yield extra
+
+
+# Env activation (≙ faults.DTX_FAULT_SCHEDULE): spawned multi-process
+# children inherit the telemetry directory for free.
+_env = os.environ.get(ENV_TELEMETRY_DIR)
+if _env:
+    configure(_env)
+del _env
+
+
+# ---------------------------------------------------------------------------
+# Reading back
+# ---------------------------------------------------------------------------
+
+def read_events(path: str, *, tolerate_torn_tail: bool = True) -> list[dict]:
+    """Parse one JSONL event file.
+
+    A torn FINAL line (crashed writer) is dropped when
+    ``tolerate_torn_tail`` (the default); malformed content anywhere
+    before the final line raises :class:`EventLogCorruptError` —
+    mid-file corruption means the file cannot be trusted at all.
+    """
+    out: list[dict] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        lines = f.read().split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()                     # trailing newline artifact
+    for i, line in enumerate(lines):
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("event record is not an object")
+        except ValueError as e:
+            if i == len(lines) - 1 and tolerate_torn_tail:
+                break                   # torn tail: crashed mid-write
+            raise EventLogCorruptError(
+                f"{path}:{i + 1}: malformed event line: {e}") from e
+        out.append(rec)
+    return out
+
+
+def read_run(logdir: str, *, tolerate_torn_tail: bool = True) -> dict:
+    """All per-process event files under ``logdir``:
+    ``{process_id: [events...]}`` keyed by the id in the file name."""
+    import glob
+    import re
+    out: dict[int, list[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(logdir, "events-*.jsonl"))):
+        m = re.search(r"events-(\d+)\.jsonl$", path)
+        pid = int(m.group(1)) if m else len(out)
+        out[pid] = read_events(path, tolerate_torn_tail=tolerate_torn_tail)
+    return out

@@ -1,0 +1,339 @@
+"""MetricsRegistry: one namespaced home for every instrument.
+
+The framework grew four disconnected instrument sets — coordinator
+counters/timers (coordinator/metric_utils.py), tf.monitoring-style
+gauges (utils/summary.py), worker-health bookkeeping
+(resilience/health.py), and input-pipeline stage stats
+(utils/profiler.py via input/dataset.py). This registry unifies them
+under one namespaced API (``"coordinator/closure_execution"``,
+``"input/prefetch/elements"``) with four typed instruments:
+
+- :class:`Counter`    — monotonically increasing int
+- :class:`Gauge`      — latest value (any JSON-serializable type)
+- :class:`Histogram`  — streaming value distribution with bounded
+  reservoir percentiles (p50/p95/p99) plus exact count/sum/min/max
+- :class:`Timer`      — accumulating duration timer whose samples also
+  feed a histogram (so rollups can report duration percentiles)
+
+Export is via :meth:`MetricsRegistry.snapshot` (a plain JSON-ready
+dict) and :meth:`MetricsRegistry.delta` (what changed since a previous
+snapshot — the unit workers publish cross-host, keeping repeated
+publishes O(changed), not O(all)).
+
+External instrument sets that keep their own storage (pipeline stage
+stats, health trackers) join through **collectors**: a callable
+returning ``{name: gauge-like value}`` merged into every snapshot
+(:meth:`register_collector`). This keeps the hot paths of those
+subsystems untouched — the registry reads them only at export time.
+
+Everything is thread-safe; instrument handles are cheap to hold and
+get-or-create is idempotent (same name + same type returns the same
+instrument; same name + different type raises).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Counter:
+    """Monotonic counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    inc = increment
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def export(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Latest-value cell (numbers, strings — anything JSON-ready)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = None
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def export(self) -> dict:
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Streaming distribution: exact count/sum/min/max + reservoir
+    percentiles.
+
+    The reservoir keeps the most recent ``window`` samples (a trailing
+    window, not uniform sampling): telemetry questions are about what a
+    run is doing NOW — trailing p50/p95 step time is the stall
+    detector's reference signal — so recency beats whole-run uniformity.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 window: int = 512):
+        self.name = name
+        self.description = description
+        self._window = window
+        self._samples: list[float] = []
+        self._next = 0                   # ring-buffer write cursor
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def record(self, value: float):
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+            if len(self._samples) < self._window:
+                self._samples.append(value)
+            else:
+                self._samples[self._next] = value
+                self._next = (self._next + 1) % self._window
+
+    observe = record
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, q: float) -> float | None:
+        """Trailing-window percentile, q in [0, 100]."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, int(round(q / 100 * (len(s) - 1)))))
+        return s[idx]
+
+    def export(self) -> dict:
+        with self._lock:
+            s = sorted(self._samples)
+            out = {"type": "histogram", "count": self._count,
+                   "sum": round(self._sum, 9), "min": self._min,
+                   "max": self._max}
+        if s:
+            def pct(q):
+                return s[min(len(s) - 1,
+                             max(0, int(round(q / 100 * (len(s) - 1)))))]
+            out.update(p50=pct(50), p95=pct(95), p99=pct(99))
+        return out
+
+
+class Timer:
+    """Accumulating duration timer; samples feed an internal histogram
+    so exports carry duration percentiles (≙ monitored_timer)."""
+
+    kind = "timer"
+
+    def __init__(self, name: str, description: str = "",
+                 window: int = 512):
+        self.name = name
+        self.description = description
+        self._hist = Histogram(name, window=window)
+
+    @contextlib.contextmanager
+    def time(self):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._hist.record(time.perf_counter() - start)
+
+    def record(self, seconds: float):
+        self._hist.record(seconds)
+
+    @property
+    def count(self) -> int:
+        return self._hist.count
+
+    @property
+    def total_seconds(self) -> float:
+        with self._hist._lock:
+            return self._hist._sum
+
+    @property
+    def average_seconds(self) -> float:
+        with self._hist._lock:
+            return self._hist._sum / self._hist._count \
+                if self._hist._count else 0.0
+
+    def export(self) -> dict:
+        out = self._hist.export()
+        out["type"] = "timer"
+        return out
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
+          "timer": Timer}
+
+
+class MetricsRegistry:
+    """Named, typed instrument store with snapshot/delta export."""
+
+    def __init__(self):
+        self._instruments: dict[str, object] = {}
+        self._collectors: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    # -- get-or-create ----------------------------------------------------
+    def _instrument(self, cls, name: str, description: str = "", **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if not isinstance(inst, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.kind}, requested {cls.kind}")
+                return inst
+            inst = cls(name, description, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, description: str = "") -> Counter:
+        return self._instrument(Counter, name, description)
+
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        return self._instrument(Gauge, name, description)
+
+    def histogram(self, name: str, description: str = "",
+                  window: int = 512) -> Histogram:
+        return self._instrument(Histogram, name, description,
+                                window=window)
+
+    def timer(self, name: str, description: str = "",
+              window: int = 512) -> Timer:
+        return self._instrument(Timer, name, description, window=window)
+
+    def register(self, instrument, name: str | None = None):
+        """Adopt an externally constructed instrument (back-compat shims
+        in coordinator/metric_utils.py construct instruments directly).
+        Re-registering a name replaces the previous instrument — the
+        newest instance is the live one a snapshot reads (per-object
+        lifecycles, e.g. one closure queue per Cluster, stay intact).
+        """
+        with self._lock:
+            self._instruments[name or instrument.name] = instrument
+        return instrument
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._instruments.pop(name, None)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._instruments.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._instruments)
+
+    # -- collectors -------------------------------------------------------
+    def register_collector(self, prefix: str, fn):
+        """``fn() -> {name: value}``; merged into every snapshot under
+        ``<prefix>/<name>`` as gauge entries. For instrument sets that
+        keep their own storage (pipeline stage stats, health trackers).
+        """
+        with self._lock:
+            self._collectors[prefix] = fn
+
+    def unregister_collector(self, prefix: str):
+        with self._lock:
+            self._collectors.pop(prefix, None)
+
+    # -- export -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """All instruments as one JSON-ready dict {name: export-dict}."""
+        with self._lock:
+            instruments = dict(self._instruments)
+            collectors = dict(self._collectors)
+        out = {name: inst.export() for name, inst in instruments.items()}
+        for prefix, fn in collectors.items():
+            try:
+                collected = fn()
+            except Exception:          # a broken collector must not
+                continue               # take down metric export
+            for name, value in collected.items():
+                out[f"{prefix}/{name}"] = {"type": "gauge", "value": value}
+        return out
+
+    def delta(self, previous: dict | None) -> dict:
+        """Entries that changed since ``previous`` (a prior snapshot).
+        Workers publish deltas on their periodic schedule so repeat
+        publishes cost O(changed). Returns the full snapshot when
+        ``previous`` is None."""
+        snap = self.snapshot()
+        if not previous:
+            return snap
+        return {k: v for k, v in snap.items() if previous.get(k) != v}
+
+    def reset(self):
+        """Drop every instrument and collector (test isolation)."""
+        with self._lock:
+            self._instruments.clear()
+            self._collectors.clear()
+
+
+_default = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry every subsystem registers in."""
+    return _default
+
+
+# module-level conveniences against the default registry
+def counter(name: str, description: str = "") -> Counter:
+    return _default.counter(name, description)
+
+
+def gauge(name: str, description: str = "") -> Gauge:
+    return _default.gauge(name, description)
+
+
+def histogram(name: str, description: str = "",
+              window: int = 512) -> Histogram:
+    return _default.histogram(name, description, window=window)
+
+
+def timer(name: str, description: str = "", window: int = 512) -> Timer:
+    return _default.timer(name, description, window=window)

@@ -145,8 +145,17 @@ def _child_main(env: dict, payload: bytes, task_type: str, task_id: int,
         import jax
         jax.config.update("jax_platforms",
                           env.get("JAX_PLATFORMS", "cpu"))
-        with contextlib.suppress(Exception):
-            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if task_type != "evaluator":
+            # gloo stands in for DCN on the CPU backend — but ONLY for
+            # tasks that join the distributed world. The evaluator runs
+            # in its own single-task world by design (≙ the reference's
+            # sidecar evaluator), never calls jax.distributed.initialize,
+            # and on jaxlib<=0.4.36 building a gloo-configured CPU client
+            # with no distributed client is a hard TypeError
+            # (make_gloo_tcp_collectives rejects distributed_client=None).
+            with contextlib.suppress(Exception):
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
         fn, args, kwargs = pickle.loads(payload)
         value = fn(*args, **kwargs)
         try:

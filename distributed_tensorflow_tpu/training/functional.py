@@ -113,7 +113,9 @@ class _FunctionalModule(nn.Module):
     input_nodes: tuple
     nodes: tuple
     output_nodes: tuple
-    layer_names: Any        # dict id(layer) -> name (static)
+    layer_names: Any        # dict str(id(layer)) -> name (static; string
+                            # keys — flax 0.10 serialization walks Module
+                            # attribute dicts and asserts on non-str keys)
     train: bool
 
     @nn.compact
@@ -136,7 +138,7 @@ class _FunctionalModule(nn.Module):
             if key not in mods:
                 mods[key] = _LayerModule(layer=node.layer,
                                          train=self.train,
-                                         name=self.layer_names[key])
+                                         name=self.layer_names[str(key)])
             args = jax.tree_util.tree_map(
                 lambda s: resolve(s) if isinstance(s, SymbolicTensor)
                 else s,
@@ -219,7 +221,7 @@ class Model(_TrainModel):
         # INSTANCE (reused layers keep one name = one parameter set).
         counters, names = {}, {}
         for node in nodes:
-            key = id(node.layer)
+            key = str(id(node.layer))
             if key in names:
                 continue
             base = _keras_auto_name(node.layer)

@@ -25,6 +25,7 @@ from typing import Callable, Iterable, Iterator
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.utils import profiler
 
 
@@ -70,6 +71,71 @@ def stack_batches(batches: Iterable):
         lambda *xs: jnp.stack(xs), *batches)
 
 
+class StepTelemetry:
+    """Per-step telemetry for a host-driven step loop.
+
+    One object per training run; call :meth:`step_completed` after each
+    step. Feeds the unified instruments every export path reads —
+    ``training/step_time`` (histogram percentiles), ``training/
+    steps_completed`` (the counter fleet rollups and the stall detector
+    key on), ``training/last_loss`` — emits a ``train.step`` event per
+    step into the structured log (step time, infeed wait, loss), and
+    re-arms an attached :class:`telemetry.StallDetector`.
+
+        steps = StepTelemetry(infeed=loop, stall_detector=detector)
+        for i in range(n):
+            state, metrics = step_fn(state, loop.next())
+            steps.step_completed(i, loss=metrics["loss"])
+
+    With telemetry off (no event log configured) the per-step cost is
+    three instrument updates; the event write is skipped.
+    """
+
+    def __init__(self, infeed: "InfeedLoop | None" = None,
+                 stall_detector=None, reg=None):
+        reg = reg or telemetry.get_registry()
+        self._timer = reg.histogram("training/step_time",
+                                    "host-observed train step seconds")
+        self._steps = reg.counter("training/steps_completed")
+        self._loss = reg.gauge("training/last_loss")
+        self._infeed = infeed
+        self._stall = stall_detector
+        self._last_t = time.monotonic()
+        self._last_wait = 0.0
+
+    def step_completed(self, step=None, loss=None,
+                       dur_s: float | None = None):
+        now = time.monotonic()
+        if dur_s is None:
+            dur_s = now - self._last_t
+        self._last_t = now
+        self._timer.record(dur_s)
+        self._steps.increment()
+        wait_s = None
+        if self._infeed is not None:
+            total = self._infeed.total_wait_s
+            wait_s = total - self._last_wait
+            self._last_wait = total
+        if loss is not None:
+            try:
+                loss = float(loss)
+            except (TypeError, ValueError):
+                loss = None
+        if loss is not None:
+            self._loss.set(loss)
+        if telemetry.enabled():
+            fields = {"dur_s": round(dur_s, 6)}
+            if step is not None:
+                fields["step"] = int(step)
+            if loss is not None:
+                fields["loss"] = loss
+            if wait_s is not None:
+                fields["infeed_wait_s"] = round(wait_s, 6)
+            telemetry.event("train.step", **fields)
+        if self._stall is not None:
+            self._stall.step_completed(step=step, dur_s=dur_s)
+
+
 class InfeedLoop:
     """Host-streamed stepping with background device staging.
 
@@ -104,6 +170,9 @@ class InfeedLoop:
         self.total_wait_s = 0.0
         self.batches = 0
         self._stats = profiler.StageStats(name or "infeed")
+        self._wait_timer = telemetry.timer(
+            "training/infeed_wait",
+            "per-step time the step loop blocked on the infeed")
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
@@ -159,6 +228,7 @@ class InfeedLoop:
         self.total_wait_s += waited
         self.batches += 1
         self._stats.record(consumer_wait_s=waited)
+        self._wait_timer.record(waited)
         return batch
 
     @property

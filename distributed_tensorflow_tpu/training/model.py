@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.input.dataset import Dataset
 from distributed_tensorflow_tpu.training import callbacks as callbacks_lib
 from distributed_tensorflow_tpu.training import losses as losses_lib
@@ -437,6 +438,13 @@ class Model:
             start_epoch = max(start_epoch, self._restored_initial_epoch)
             self._restored_initial_epoch = None
 
+        # Unified telemetry: per-step train.step events + step-time
+        # histogram + steps_completed counter (loss stays device-side
+        # per step — the gauge/event carries it at epoch granularity to
+        # avoid forcing a host sync every batch).
+        from distributed_tensorflow_tpu.training.loops import StepTelemetry
+        step_telemetry = StepTelemetry()
+        global_step = 0
         for epoch in range(start_epoch, epochs):
             cb_list.on_epoch_begin(epoch)
             mstate = self._metric_init()
@@ -453,12 +461,17 @@ class Model:
                         steps, self._metric_results(mstate))
                 else:
                     cb_list.on_train_batch_end(steps, None)
+                step_telemetry.step_completed(global_step)
+                global_step += 1
                 steps += 1
                 if steps_per_epoch and steps >= steps_per_epoch:
                     break
                 if self.stop_training:      # e.g. TerminateOnNaN
                     break
             logs = self._metric_results(mstate)
+            telemetry.event("train.epoch", epoch=epoch,
+                            **{k: float(v) for k, v in logs.items()
+                               if isinstance(v, (int, float))})
             if validation_data is not None:
                 # 2-tuple (x, y) or keras's 3-tuple (x, y, sample_weight)
                 vx, vy = validation_data[0], validation_data[1]

@@ -19,8 +19,40 @@ feature detection in place (``cluster/topology.py`` for ``AxisType``,
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
+
+
+def safe_donate_argnums(argnums: tuple) -> tuple:
+    """``donate_argnums`` value that is safe on this jax vintage.
+
+    jax<=0.4.37 (probed via the missing ``jax.sharding.AxisType``, the
+    repo's standard vintage gate): an executable DESERIALIZED from the
+    persistent compilation cache mis-applies input-output aliasing for
+    donated sharded CPU programs — outputs that should carry fresh
+    values read back as the (dead) donated input buffer, and repeated
+    host reads of the same output disagree. Root-caused in ISSUE 4 from
+    the ``test_resnet_via_fit_under_tpu_strategy`` flake: BN batch_stats
+    froze exactly when conftest's persistent cache had the entry
+    (first-ever run compiles fresh and passes; every warm run fails).
+    Minimal repro: jit(donate_argnums=0) over NamedSharding state +
+    ``jnp.where`` carry, 8 virtual CPU devices — run twice with
+    JAX_COMPILATION_CACHE_DIR set.
+
+    Donation is disabled ONLY in the unsafe configuration (legacy
+    vintage AND persistent cache active) — TPU/real runs keep the HBM
+    saving.
+    """
+    if hasattr(jax.sharding, "AxisType"):
+        return argnums
+    cache_dir = None
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        pass
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    return () if cache_dir else argnums
 
 
 def install():

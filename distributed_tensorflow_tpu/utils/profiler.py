@@ -374,3 +374,30 @@ def bottleneck_stage() -> dict | None:
     if not snaps:
         return None
     return max(snaps, key=lambda s: s["consumer_wait_s"])
+
+
+# -- telemetry bridge -------------------------------------------------------
+# Every live pipeline stage (input/dataset.py map/interleave/prefetch,
+# training/loops.py infeed) exports through the unified MetricsRegistry:
+# registry snapshots — and therefore cross-host fleet rollups and
+# tools/obs_report.py — carry the input pipeline's counters without the
+# stages giving up their own (weakly-registered) storage.
+
+def _pipeline_collector() -> dict:
+    out = {}
+    for snap in pipeline_stats():
+        stage = snap.get("name", "?")
+        for k, v in snap.items():
+            if k in ("name", "workers") or v is None:
+                continue
+            out[f"{stage}/{k}"] = v
+    return out
+
+
+def _register_telemetry_collector():
+    from distributed_tensorflow_tpu.telemetry import registry as _treg
+    _treg.get_registry().register_collector("input/pipeline",
+                                            _pipeline_collector)
+
+
+_register_telemetry_collector()

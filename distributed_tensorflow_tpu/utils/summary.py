@@ -162,21 +162,67 @@ _tfrecord = tfrecord_frame   # internal alias
 # Writer
 # ---------------------------------------------------------------------------
 
+class _SharedEventFile:
+    """One physical event file, shared by every SummaryWriter a process
+    opens on the same (logdir, suffix). Two writers created within the
+    same wall second used to collide on the timestamped file name with
+    independent handles — interleaved TFRecord frames through separate
+    buffers tear the file. One handle per process + one lock makes
+    concurrent writers safe by construction."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.lock = threading.Lock()
+        self.refs = 0
+        self._f = open(path, "ab")
+        self.write(_encode_file_version(time.time()))
+
+    def write(self, event: bytes):
+        with self.lock:
+            self._f.write(_tfrecord(event))
+
+    def flush(self):
+        with self.lock:
+            self._f.flush()
+
+    def close_handle(self):
+        with self.lock:
+            self._f.flush()
+            self._f.close()
+
+
 class SummaryWriter:
-    """Append-only scalar summary writer (TensorBoard event file)."""
+    """Append-only scalar summary writer (TensorBoard event file).
+
+    Concurrency: all writers a process opens on the same ``logdir`` (and
+    suffix) share ONE file handle with locked, whole-frame writes; use
+    as a context manager or call :meth:`close` when done.
+    """
+
+    _OPEN: "dict[tuple[str, str], _SharedEventFile]" = {}
+    _OPEN_LOCK = threading.Lock()
 
     def __init__(self, logdir: str, filename_suffix: str = ""):
         os.makedirs(logdir, exist_ok=True)
-        fname = (f"events.out.tfevents.{int(time.time())}."
-                 f"{os.uname().nodename}.{os.getpid()}{filename_suffix}")
-        self.path = os.path.join(logdir, fname)
-        self._f = open(self.path, "ab")
-        self._lock = threading.Lock()
-        self._write(_encode_file_version(time.time()))
+        key = (os.path.realpath(logdir), filename_suffix)
+        with SummaryWriter._OPEN_LOCK:
+            shared = SummaryWriter._OPEN.get(key)
+            if shared is None:
+                fname = (f"events.out.tfevents.{int(time.time())}."
+                         f"{os.uname().nodename}.{os.getpid()}"
+                         f"{filename_suffix}")
+                shared = _SharedEventFile(os.path.join(logdir, fname))
+                SummaryWriter._OPEN[key] = shared
+            shared.refs += 1
+        self._key = key
+        self._shared = shared
+        self._closed = False
+        self.path = shared.path
 
     def _write(self, event: bytes):
-        with self._lock:
-            self._f.write(_tfrecord(event))
+        if self._closed:
+            raise ValueError(f"SummaryWriter for {self.path} is closed")
+        self._shared.write(event)
 
     def scalar(self, tag: str, value: float, step: int,
                wall_time: float | None = None):
@@ -197,12 +243,24 @@ class SummaryWriter:
             time.time() if wall_time is None else wall_time, bins=bins))
 
     def flush(self):
-        with self._lock:
-            self._f.flush()
+        self._shared.flush()
 
     def close(self):
-        self.flush()
-        self._f.close()
+        """Release this writer's reference; the underlying file handle
+        closes when the last writer on the (logdir, suffix) closes.
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with SummaryWriter._OPEN_LOCK:
+            self._shared.refs -= 1
+            last = self._shared.refs == 0
+            if last:
+                SummaryWriter._OPEN.pop(self._key, None)
+        if last:
+            self._shared.close_handle()
+        else:
+            self._shared.flush()
 
     def __enter__(self):
         return self
@@ -217,7 +275,14 @@ class SummaryWriter:
 # ---------------------------------------------------------------------------
 
 class Gauge:
-    """Named cell set to the latest value (≙ monitoring.StringGauge)."""
+    """Named cell set to the latest value (≙ monitoring.StringGauge).
+
+    Also exported through the unified telemetry MetricsRegistry (under
+    ``monitoring<name>``), so tf.monitoring-style gauges appear in
+    registry snapshots and cross-host fleet rollups.
+    """
+
+    kind = "gauge"
 
     _REGISTRY: dict = {}
     _LOCK = threading.Lock()
@@ -228,6 +293,10 @@ class Gauge:
         self._value = None
         with Gauge._LOCK:
             Gauge._REGISTRY[name] = self
+        from distributed_tensorflow_tpu.telemetry import registry as _treg
+        _treg.get_registry().register(self, f"monitoring{name}"
+                                      if name.startswith("/")
+                                      else f"monitoring/{name}")
 
     def set(self, value):
         self._value = value
@@ -235,10 +304,112 @@ class Gauge:
     def value(self):
         return self._value
 
+    def export(self) -> dict:
+        return {"type": "gauge", "value": self._value}
+
     @classmethod
     def all_gauges(cls) -> dict:
         with cls._LOCK:
             return {k: g.value() for k, g in cls._REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Reading event files back (the reverse of the writer above): scalar
+# series for tests and tools/obs_report.py — no TensorBoard dependency.
+# ---------------------------------------------------------------------------
+
+def _decode_fields(buf: bytes):
+    """Iterate (field_number, wire_type, value) over one proto message.
+    value is raw bytes for len-delimited fields, int for varint/fixed."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            tag |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:                     # varint
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, v
+        elif wire == 1:                   # fixed64
+            yield field, wire, buf[i:i + 8]
+            i += 8
+        elif wire == 2:                   # len-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 5:                   # fixed32
+            yield field, wire, buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def read_event_records(path: str):
+    """Iterate raw TFRecord payloads from an event file, verifying the
+    masked crc32c of each frame."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if _masked_crc(header) != hcrc:
+                raise ValueError(f"{path}: corrupt record header")
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if len(payload) < length or _masked_crc(payload) != pcrc:
+                raise ValueError(f"{path}: corrupt record payload")
+            yield payload
+
+
+def read_scalars(path: str) -> "list[tuple[str, int, float]]":
+    """All scalar summaries in an event file as (tag, step, value)."""
+    out = []
+    for payload in read_event_records(path):
+        step = 0
+        summary = None
+        for field, wire, v in _decode_fields(payload):
+            if field == 2 and wire == 0:          # Event.step
+                step = v
+            elif field == 5 and wire == 2:        # Event.summary
+                summary = v
+        if summary is None:
+            continue
+        for field, wire, v in _decode_fields(summary):
+            if field != 1 or wire != 2:
+                continue                          # Summary.value entries
+            tag, value = None, None
+            for f2, w2, v2 in _decode_fields(v):
+                if f2 == 1 and w2 == 2:
+                    tag = v2.decode("utf-8", "replace")
+                elif f2 == 2 and w2 == 5:
+                    (value,) = struct.unpack("<f", v2)
+            if tag is not None and value is not None:
+                out.append((tag, step, value))
+    return out
 
 
 # ≙ distribute_lib.py:190 distribution_strategy_gauge: records which

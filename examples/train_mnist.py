@@ -15,6 +15,7 @@ import argparse
 import jax
 import jax.numpy as jnp
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.input.dataset import Dataset
 from distributed_tensorflow_tpu.models.mnist_cnn import (
     create_train_state, make_train_step, synthetic_data)
@@ -26,7 +27,13 @@ def main():
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--global-batch", type=int, default=64)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="enable telemetry: per-step train.step events "
+                         "(JSONL) land here; render with "
+                         "tools/obs_report.py")
     args = ap.parse_args()
+    if args.telemetry_dir:
+        telemetry.configure(args.telemetry_dir)
 
     strategy = MirroredStrategy()
     print(f"devices: {strategy.num_replicas_in_sync} replicas on "
@@ -44,13 +51,19 @@ def main():
     state = strategy.replicate(state)
     step_fn = strategy.compile_step(make_train_step(model, tx))
 
+    from distributed_tensorflow_tpu.training.loops import StepTelemetry
+    steps_telemetry = StepTelemetry()
     it = iter(dist_ds)
     for step in range(args.steps):
         state, metrics = step_fn(state, next(it))
-        if step % 20 == 0 or step == args.steps - 1:
+        log_step = step % 20 == 0 or step == args.steps - 1
+        steps_telemetry.step_completed(
+            step, loss=metrics["loss"] if log_step else None)
+        if log_step:
             print(f"step {step}: loss={float(metrics['loss']):.4f} "
                   f"acc={float(metrics['accuracy']):.3f}")
     print("done")
+    telemetry.shutdown()
 
 
 if __name__ == "__main__":

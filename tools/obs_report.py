@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Render a run's telemetry (JSONL event logs + fleet rollups) into a
+human-readable observability report.
+
+Usage::
+
+    python tools/obs_report.py RUN_DIR            # text report
+    python tools/obs_report.py RUN_DIR --json     # machine-readable
+    python tools/obs_report.py RUN_DIR --check    # validate event logs
+
+``RUN_DIR`` is the directory passed to ``telemetry.configure`` (or
+``DTX_TELEMETRY_DIR``): it holds one ``events-<pid>.jsonl`` per process
+and, when a FleetAggregator ran, TensorBoard event files with the
+``fleet/*`` scalar rollups. A single ``.jsonl`` file also works.
+
+The report answers the operator questions the event schema was designed
+for: step-time p50/p95/p99, infeed-wait fraction of step time, dispatch
+retries/failures by worker, chaos fault firings by site, checkpoint
+save/restore durations, and any ``stall.suspected`` events.
+
+``--check`` is the CI gate: exit 0 when every event file parses (a torn
+FINAL line — a crashed writer — is tolerated and reported), non-zero on
+malformed or mid-file-corrupt JSONL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_tpu.telemetry.events import (  # noqa: E402
+    EventLogCorruptError, read_events)
+
+
+def _event_files(target: str) -> list[str]:
+    if os.path.isfile(target):
+        return [target]
+    files = sorted(glob.glob(os.path.join(target, "events-*.jsonl")))
+    return files
+
+
+def _percentiles(values: list[float]) -> dict:
+    if not values:
+        return {}
+    s = sorted(values)
+
+    def pct(q):
+        return s[min(len(s) - 1, max(0, int(round(q / 100 * (len(s) - 1)))))]
+
+    return {"count": len(s), "mean": sum(s) / len(s),
+            "p50": pct(50), "p95": pct(95), "p99": pct(99),
+            "max": s[-1]}
+
+
+def _torn_tail(path: str) -> bool:
+    """True when the file's final line is malformed (torn by a crashed
+    writer) — tolerated, but worth reporting."""
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        if not lines:
+            return False
+        json.loads(lines[-1])
+        return False
+    except ValueError:
+        return True
+
+
+def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
+    """Aggregate a run's events into the report structure."""
+    steps: list[float] = []
+    infeed_wait = 0.0
+    step_time_total = 0.0
+    retries = collections.Counter()
+    failures = collections.Counter()
+    faults_by_site = collections.Counter()
+    ckpt = collections.defaultdict(list)
+    stalls: list[dict] = []
+    per_pid: dict[int, dict] = {}
+
+    for pid, events in sorted(events_by_pid.items()):
+        pid_steps: list[float] = []
+        pid_wait = 0.0
+        for ev in events:
+            name = ev.get("ev")
+            if name == "train.step":
+                d = ev.get("dur_s")
+                if isinstance(d, (int, float)):
+                    pid_steps.append(d)
+                    step_time_total += d
+                w = ev.get("infeed_wait_s")
+                if isinstance(w, (int, float)):
+                    pid_wait += w
+            elif name == "dispatch.retry":
+                retries[f"worker {ev.get('worker')}"] += 1
+            elif name in ("dispatch.failure", "dispatch.closure_error",
+                          "worker.closure_error"):
+                failures[name] += 1
+            elif name == "dispatch.preempted":
+                retries[f"worker {ev.get('worker')} (preempted)"] += 1
+            elif name == "fault.fired":
+                faults_by_site[ev.get("site", "?")] += 1
+            elif name in ("checkpoint.save", "checkpoint.restore",
+                          "checkpoint.commit"):
+                d = ev.get("dur_s")
+                if isinstance(d, (int, float)):
+                    ckpt[name].append(d)
+            elif name == "stall.suspected":
+                stalls.append({k: ev.get(k) for k in
+                               ("pid", "stalled_s", "median_step_s",
+                                "suspect_worker", "suspect_reason")})
+        steps.extend(pid_steps)
+        infeed_wait += pid_wait
+        per_pid[pid] = {"events": len(events),
+                        "steps": len(pid_steps),
+                        "step_time": _percentiles(pid_steps),
+                        "infeed_wait_s": round(pid_wait, 6)}
+
+    return {
+        "processes": per_pid,
+        "step_time": _percentiles(steps),
+        "infeed_wait_fraction": (round(infeed_wait / step_time_total, 4)
+                                 if step_time_total > 0 else None),
+        "retries": dict(retries),
+        "failures": dict(failures),
+        "fault_firings": dict(faults_by_site),
+        "checkpoint_durations": {
+            k: _percentiles(v) for k, v in sorted(ckpt.items())},
+        "stalls_suspected": stalls,
+    }
+
+
+def read_rollup_scalars(target: str) -> dict:
+    """Latest value of every ``fleet/*`` scalar in the run directory's
+    TensorBoard event files (absent aggregator -> {})."""
+    if not os.path.isdir(target):
+        return {}
+    from distributed_tensorflow_tpu.utils.summary import read_scalars
+    latest: dict[str, tuple[int, float]] = {}
+    for path in sorted(glob.glob(os.path.join(target,
+                                              "events.out.tfevents.*"))):
+        try:
+            for tag, step, value in read_scalars(path):
+                if not tag.startswith("fleet/"):
+                    continue
+                if tag not in latest or step >= latest[tag][0]:
+                    latest[tag] = (step, value)
+        except ValueError:
+            continue                    # torn event file: skip it
+    return {tag: v for tag, (s, v) in sorted(latest.items())}
+
+
+def _fmt_ms(seconds) -> str:
+    return f"{seconds * 1e3:.2f}ms" if seconds is not None else "-"
+
+
+def render_text(report: dict, rollup: dict) -> str:
+    out = []
+    st = report["step_time"]
+    out.append("== telemetry report ==")
+    out.append(f"processes: {len(report['processes'])}  "
+               f"steps: {st.get('count', 0)}")
+    if st:
+        out.append(f"step time   p50 {_fmt_ms(st['p50'])}  "
+                   f"p95 {_fmt_ms(st['p95'])}  p99 {_fmt_ms(st['p99'])}  "
+                   f"max {_fmt_ms(st['max'])}")
+    if report["infeed_wait_fraction"] is not None:
+        out.append(f"infeed wait {report['infeed_wait_fraction']:.1%} "
+                   f"of step time")
+    for pid, info in sorted(report["processes"].items()):
+        p = info["step_time"]
+        out.append(f"  [p{pid}] {info['events']} events, "
+                   f"{info['steps']} steps"
+                   + (f", step p50 {_fmt_ms(p['p50'])}" if p else ""))
+    if report["retries"]:
+        out.append("retries:")
+        for site, n in sorted(report["retries"].items()):
+            out.append(f"  {site}: {n}")
+    if report["failures"]:
+        out.append("failures:")
+        for kind, n in sorted(report["failures"].items()):
+            out.append(f"  {kind}: {n}")
+    if report["fault_firings"]:
+        out.append("chaos fault firings:")
+        for site, n in sorted(report["fault_firings"].items()):
+            out.append(f"  {site}: {n}")
+    for kind, p in report["checkpoint_durations"].items():
+        out.append(f"{kind}: n={p['count']} p50 {_fmt_ms(p['p50'])} "
+                   f"max {_fmt_ms(p['max'])}")
+    for s in report["stalls_suspected"]:
+        out.append(f"STALL suspected (p{s.get('pid')}): "
+                   f"{s.get('stalled_s')}s without a step "
+                   f"(median {s.get('median_step_s')}s) — suspect "
+                   f"worker {s.get('suspect_worker')}: "
+                   f"{s.get('suspect_reason')}")
+    if rollup:
+        out.append("fleet rollup (latest TensorBoard scalars):")
+        for tag, v in rollup.items():
+            out.append(f"  {tag} = {v:.6g}")
+    return "\n".join(out)
+
+
+def check(target: str) -> int:
+    """Validate every event file; 0 = ok (torn tails reported but
+    tolerated), 1 = corrupt/malformed, 2 = nothing to check."""
+    files = _event_files(target)
+    if not files:
+        print(f"obs_report --check: no events-*.jsonl under {target}",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in files:
+        try:
+            events = read_events(path, tolerate_torn_tail=True)
+        except EventLogCorruptError as e:
+            print(f"CORRUPT  {path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        torn = _torn_tail(path)
+        note = "  (torn tail line tolerated)" if torn else ""
+        print(f"ok       {path}: {len(events)} events{note}")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("target", help="telemetry run directory (or one "
+                                   "events-*.jsonl file)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="validate event logs; non-zero exit on "
+                         "malformed/torn-mid-file JSONL")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        return check(args.target)
+
+    files = _event_files(args.target)
+    if not files:
+        print(f"obs_report: no events-*.jsonl under {args.target}",
+              file=sys.stderr)
+        return 2
+    events_by_pid = {}
+    import re
+    for path in files:
+        m = re.search(r"events-(\d+)\.jsonl$", path)
+        pid = int(m.group(1)) if m else len(events_by_pid)
+        try:
+            events_by_pid[pid] = read_events(path)
+        except EventLogCorruptError as e:
+            print(f"obs_report: {e}", file=sys.stderr)
+            return 1
+    report = summarize(events_by_pid)
+    rollup = read_rollup_scalars(args.target)
+    if args.json:
+        print(json.dumps({"report": report, "fleet_rollup": rollup},
+                         indent=2))
+    else:
+        print(render_text(report, rollup))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
