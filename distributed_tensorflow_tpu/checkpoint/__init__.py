@@ -10,8 +10,10 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     CheckpointManager,
 )
 from distributed_tensorflow_tpu.checkpoint.failure_handling import (
+    EXIT_PREEMPTED,
     PreemptionCheckpointHandler,
     TerminationConfig,
+    TrainingPreempted,
 )
 from distributed_tensorflow_tpu.checkpoint.preemption_watcher import (
     PreemptionWatcher,

@@ -35,22 +35,55 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
     CheckpointManager,
 )
+from distributed_tensorflow_tpu.cluster import elastic
 from distributed_tensorflow_tpu.resilience import faults
+
+#: Process exit code meaning "preempted after a clean checkpoint —
+#: restart me" (≙ the reference's restart-the-job convention). The
+#: recovery supervisor classifies this code as a preemption, not a crash.
+EXIT_PREEMPTED = 42
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised (instead of exiting the process from library code) by
+    :class:`PreemptionCheckpointHandler` in ``restart`` exit mode, after
+    the preemption checkpoint has committed. The owner of the training
+    loop — an elastic worker shell or the recovery supervisor's spawned
+    task — catches it and tears down for restart, typically exiting
+    with :data:`EXIT_PREEMPTED`."""
 
 
 @dataclasses.dataclass
 class TerminationConfig:
-    """≙ failure_handling.py:75 ``TerminationConfig``."""
+    """≙ failure_handling.py:75 ``TerminationConfig``.
+
+    ``exit_mode`` selects what happens once the preemption checkpoint is
+    committed and no ``exit_fn`` is injected:
+
+    - ``"exit"`` (default): raise ``SystemExit(EXIT_PREEMPTED)`` so the
+      platform restarts the job — the reference's behavior;
+    - ``"restart"``: raise :class:`TrainingPreempted` instead, keeping
+      process teardown OUT of library code — the mode elastic/supervised
+      jobs use (``for_platform`` picks it automatically when a recovery
+      supervisor owns this process).
+    """
 
     termination_watcher_fn: Callable[[], bool] | None = None
     exit_fn: Callable[[], None] | None = None
     grace_period: float = 0.0
     save_fn: Callable[[], None] | None = None
+    exit_mode: str = "exit"
+
+    def __post_init__(self):
+        if self.exit_mode not in ("exit", "restart"):
+            raise ValueError(f"exit_mode must be 'exit' or 'restart', "
+                             f"got {self.exit_mode!r}")
 
     @classmethod
     def for_platform(cls) -> "TerminationConfig":
         """Platform sniffing (≙ failure_handling.py:245): on GCE/TPU-VM,
-        watch the maintenance-event metadata; default is signal-only."""
+        watch the maintenance-event metadata; default is signal-only.
+        Under a recovery supervisor the exit mode is ``restart``."""
         watcher = None
         event_file = os.environ.get("DTX_MAINTENANCE_EVENT_FILE")
         if event_file:
@@ -60,7 +93,9 @@ class TerminationConfig:
                         return "TERMINATE" in f.read().upper()
                 except OSError:
                     return False
-        return cls(termination_watcher_fn=watcher)
+        return cls(termination_watcher_fn=watcher,
+                   exit_mode="restart" if elastic.under_supervisor()
+                   else "exit")
 
 
 class PreemptionCheckpointHandler:
@@ -102,6 +137,8 @@ class PreemptionCheckpointHandler:
         self._sync_error: BaseException | None = None
         self._grace_deadline: float | None = None
         self._finalizing = False
+        self._sigterm_handler = None
+        self._prev_sigterm = None
 
         # restore first (≙ failure_handling.py:647 restore-on-init)
         latest = self._manager.restore_or_initialize()
@@ -131,8 +168,28 @@ class PreemptionCheckpointHandler:
                     prev(signum, frame)
 
             signal.signal(signal.SIGTERM, handler)
+            # kept for _restore_signal_handler(): stacked handlers must
+            # unwind LIFO without leaking across handler lifetimes (the
+            # PreemptionWatcher.stop() discipline)
+            self._sigterm_handler = handler
+            self._prev_sigterm = prev
         except (ValueError, OSError):
             pass  # non-main thread / restricted env
+
+    def _restore_signal_handler(self):
+        """Put back the SIGTERM handler that was installed before this
+        handler (only if ours is still the current one — an out-of-order
+        teardown must not break a newer handler's chain)."""
+        if (self._sigterm_handler is None
+                or threading.current_thread()
+                is not threading.main_thread()):
+            return
+        try:
+            if signal.getsignal(signal.SIGTERM) is self._sigterm_handler:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+                self._sigterm_handler = None
+        except (ValueError, OSError):
+            pass
 
     def _poll(self):
         while not self._received.is_set():
@@ -160,7 +217,16 @@ class PreemptionCheckpointHandler:
         preemption was signalled but the agreed save step was never
         reached (the loop ran out first — e.g. the signal landed on the
         last step), checkpoint NOW so the progress isn't lost. No-op
-        otherwise."""
+        otherwise. Either way the SIGTERM handler installed at
+        construction is restored (LIFO unwind, the way
+        ``PreemptionWatcher.stop()`` already does) — the training loop
+        is over, so this handler's watch is too."""
+        try:
+            self._finalize_impl()
+        finally:
+            self._restore_signal_handler()
+
+    def _finalize_impl(self):
         if self._exited:
             return
         from distributed_tensorflow_tpu.cluster.coordination import (
@@ -402,12 +468,25 @@ class PreemptionCheckpointHandler:
         self._exit()
 
     def _exit(self):
+        """Leave the training loop after the preemption checkpoint
+        committed. Injectable (``TerminationConfig.exit_fn``) and
+        overridable; with no injection the behavior is mode-selected
+        (see :class:`TerminationConfig`) but always *raises* — library
+        code never hard-exits the process."""
         self._exited = True
+        self._restore_signal_handler()
+        from distributed_tensorflow_tpu.telemetry import events as _events
+        _events.event("preemption.exit", step=self._step,
+                      save_at=self._save_at, mode=self._config.exit_mode)
         if self._config.exit_fn is not None:
             self._config.exit_fn()
+        elif self._config.exit_mode == "restart":
+            raise TrainingPreempted(
+                f"preempted at step {self._step}; checkpoint saved at "
+                f"step {self._save_at} — restart to resume")
         else:
-            raise SystemExit(42)  # platform restarts the job
+            raise SystemExit(EXIT_PREEMPTED)  # platform restarts the job
 
 
 def _default_exit():
-    os._exit(42)
+    os._exit(EXIT_PREEMPTED)

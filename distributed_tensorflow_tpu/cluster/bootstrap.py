@@ -18,6 +18,7 @@ import threading
 
 import jax
 
+from distributed_tensorflow_tpu.cluster import elastic
 from distributed_tensorflow_tpu.cluster.resolver import (
     ClusterResolver,
     TFConfigClusterResolver,
@@ -35,6 +36,9 @@ class DistributedRuntime:
     num_processes: int
     process_id: int
     initialized_jax_distributed: bool
+    #: Elastic cluster generation (cluster/elastic.py): 0 for a job that
+    #: has never been reformed by a recovery supervisor.
+    generation: int = 0
 
     @property
     def is_chief(self) -> bool:
@@ -96,6 +100,7 @@ def initialize(resolver: ClusterResolver | None = None,
             num_processes=num_processes,
             process_id=process_id,
             initialized_jax_distributed=did_init,
+            generation=elastic.generation(),
         )
         return _RUNTIME
 

@@ -22,6 +22,7 @@ import re
 import threading
 import time
 
+from distributed_tensorflow_tpu.cluster import elastic
 from distributed_tensorflow_tpu.resilience import faults
 
 
@@ -183,8 +184,17 @@ class CoordinationServiceAgent:
         return self.process_id == 0
 
     # -- KV store ---------------------------------------------------------
+    # Every key and barrier name is namespaced with the elastic cluster
+    # generation (cluster/elastic.py): a supervisor-reformed cluster gets
+    # disjoint coordination state from every dead incarnation's, so a
+    # straggler's half-written keys / half-met barriers can never leak
+    # into the new generation. Generation 0 (the non-elastic default) is
+    # unprefixed. Chaos sites fire on the RAW names — fault schedules
+    # target logical keys, not incarnation-specific ones.
+
     def key_value_set(self, key: str, value: bytes | str, *,
                       allow_overwrite: bool = True):
+        key = elastic.namespace(key)
         data = value.encode() if isinstance(value, str) else bytes(value)
         c = self._client
         if c is None:
@@ -196,6 +206,7 @@ class CoordinationServiceAgent:
         """Blocking get: waits until some process sets ``key``."""
         faults.fire("coord.kv_get", tag=key, exc=CoordinationError,
                     msg=f"injected fault: key_value_get({key!r})")
+        key = elastic.namespace(key)
         c = self._client
         if c is None:
             return self._local.get(key, timeout_s)
@@ -216,6 +227,7 @@ class CoordinationServiceAgent:
                 f"key_value_get({key!r}) failed: {e}") from e
 
     def key_value_try_get(self, key: str) -> bytes | None:
+        key = elastic.namespace(key)
         c = self._client
         if c is None:
             return self._local.try_get(key)
@@ -233,6 +245,7 @@ class CoordinationServiceAgent:
             return None
 
     def key_value_dir_get(self, prefix: str) -> list[tuple[str, bytes]]:
+        prefix = elastic.namespace(prefix)
         c = self._client
         if c is None:
             return self._local.dir_get(prefix)
@@ -242,6 +255,7 @@ class CoordinationServiceAgent:
             return []
 
     def key_value_delete(self, key: str):
+        key = elastic.namespace(key)
         c = self._client
         if c is None:
             self._local.delete(key)
@@ -250,6 +264,7 @@ class CoordinationServiceAgent:
 
     def key_value_increment(self, key: str, amount: int = 1) -> int:
         """Atomic fetch-add; returns the post-increment value."""
+        key = elastic.namespace(key)
         c = self._client
         if c is None:
             return self._local.increment(key, amount)
@@ -299,6 +314,7 @@ class CoordinationServiceAgent:
         """
         faults.fire("coord.barrier", tag=name, exc=BarrierTimeoutError,
                     msg=f"injected barrier timeout at {name!r}")
+        name = elastic.namespace(name)
         c = self._client
         if c is None:
             self._local.barrier(name, timeout_s, 1)

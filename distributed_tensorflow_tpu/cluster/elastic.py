@@ -1,0 +1,98 @@
+"""Elastic cluster generations.
+
+A *generation* is one incarnation of the cluster. The recovery
+supervisor (resilience/supervisor.py) increments it every time it
+reforms the cluster after a worker death, preemption, or stall; the new
+id reaches every restarted process through the environment
+(:data:`ENV_GENERATION`), the same route ``TF_CONFIG`` travels.
+
+What the generation id buys (≙ Elastic Horovod's rendezvous version /
+the reference failure-handling module's restart counter):
+
+- **Fresh coordination namespaces.** Every KV key and barrier name the
+  :class:`~distributed_tensorflow_tpu.cluster.coordination.
+  CoordinationServiceAgent` touches is prefixed with ``gen<N>/`` (via
+  :func:`namespace`), so a reformed cluster can never collide with a
+  dead generation's half-written keys or half-met barriers — even if
+  some coordination state survived the reform (a pooled service, a
+  straggler that died late). Generation 0 is unprefixed, so
+  non-elastic jobs are byte-identical to before.
+- **Restart awareness.** Library code can ask :func:`generation` ("how
+  many times has this job been reformed?") and
+  :func:`under_supervisor` ("is someone going to restart me?") — the
+  latter is how ``TerminationConfig.for_platform`` picks
+  restart-instead-of-exit preemption handling.
+- **Liveness signal.** :func:`heartbeat` writes this task's current
+  step to a per-task file under :data:`ENV_SUPERVISOR_DIR`; the
+  supervisor reads the files for stall detection and for step-targeted
+  chaos kills. A no-op (one env lookup) outside a supervised run.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: Cluster generation id, injected by the recovery supervisor.
+ENV_GENERATION = "DTX_CLUSTER_GENERATION"
+
+#: Scratch directory shared with the supervisor (heartbeat files).
+ENV_SUPERVISOR_DIR = "DTX_SUPERVISOR_DIR"
+
+_GENERATION: int | None = None
+
+
+def generation() -> int:
+    """The current cluster generation (0 for a never-reformed job).
+
+    An explicit :func:`set_generation` wins; otherwise the value comes
+    from the environment on every call (no caching — pooled test
+    processes swap env between runs)."""
+    if _GENERATION is not None:
+        return _GENERATION
+    try:
+        return int(os.environ.get(ENV_GENERATION, "0"))
+    except ValueError:
+        return 0
+
+
+def set_generation(gen: int | None):
+    """Pin the generation programmatically (tests, embedded supervisors);
+    ``None`` reverts to the environment."""
+    global _GENERATION
+    _GENERATION = None if gen is None else int(gen)
+
+
+def namespace(name: str) -> str:
+    """Namespace a coordination key/barrier name with the generation.
+
+    Generation 0 returns ``name`` unchanged (non-elastic jobs keep their
+    historical key layout); generation N prefixes ``gen<N>/`` so the
+    reformed cluster's coordination state is disjoint from every prior
+    incarnation's."""
+    g = generation()
+    return name if g == 0 else f"gen{g}/{name}"
+
+
+def under_supervisor() -> bool:
+    """True when a recovery supervisor owns this process's lifecycle."""
+    return bool(os.environ.get(ENV_SUPERVISOR_DIR))
+
+
+def heartbeat(step: int | None = None):
+    """Report liveness (and optionally the current step) to the
+    supervisor. Call once per training step; outside a supervised run
+    this is a single env lookup."""
+    d = os.environ.get(ENV_SUPERVISOR_DIR)
+    if not d:
+        return
+    task = os.environ.get("DTX_MPR_TASK_INDEX", "0")
+    try:
+        with open(os.path.join(d, f"heartbeat-{task}"), "w") as f:
+            f.write("" if step is None else str(int(step)))
+    except OSError:
+        pass                      # supervisor dir raced away: non-fatal
+
+
+def heartbeat_path(supervisor_dir: str, task_index: int) -> str:
+    """Supervisor-side: the heartbeat file a task writes."""
+    return os.path.join(supervisor_dir, f"heartbeat-{task_index}")

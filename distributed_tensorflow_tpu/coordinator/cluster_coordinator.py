@@ -487,6 +487,17 @@ class ClusterCoordinator:
     def done(self) -> bool:
         return self.cluster.done()
 
+    def worker_restarted(self, worker_id: int):
+        """Tell the dispatch layer a worker's PROCESS was restarted by a
+        recovery supervisor (new cluster generation): the lane's
+        quarantine and failure streak no longer describe the fresh
+        process, so it goes straight back into rotation instead of
+        sitting out a quarantine window it inherited from its dead
+        predecessor."""
+        self.cluster.health.worker_restarted(worker_id)
+        from distributed_tensorflow_tpu.telemetry import events as _events
+        _events.event("dispatch.worker_restarted", worker=worker_id)
+
     def fetch(self, values, timeout: float | None = None):
         """Fetch RemoteValue(s) (structure-preserving)."""
         return jax.tree_util.tree_map(

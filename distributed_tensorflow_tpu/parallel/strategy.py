@@ -621,8 +621,14 @@ class Strategy:
         return NamedSharding(self.mesh, P())
 
     def data_sharding(self, batch_axis: int = 0) -> NamedSharding:
+        names = self.data_axis_names
+        if isinstance(names, (tuple, list)) and len(names) == 1:
+            # single data axis: use the bare name — identical sharding,
+            # but P('dp') (the canonical form newer jax normalizes to)
+            # instead of the vintage-dependent P(('dp',))
+            names = names[0]
         spec = [None] * (batch_axis + 1)
-        spec[batch_axis] = self.data_axis_names
+        spec[batch_axis] = names
         return NamedSharding(self.mesh, P(*spec))
 
     def shard_batch(self, batch):

@@ -1,6 +1,6 @@
-"""Resilience: deterministic fault injection + unified retry/health policies.
+"""Resilience: fault injection, retry/health policies, recovery.
 
-Three parts (see each module's docstring):
+Four parts (see each module's docstring):
 
 - :mod:`.faults` — seed-driven chaos layer; named injection sites in the
   coordination, dispatch, and checkpoint stacks raise/delay/corrupt on a
@@ -8,7 +8,10 @@ Three parts (see each module's docstring):
 - :mod:`.retry` — the single :class:`RetryPolicy` (exponential backoff,
   jitter, deadline, retryable classification) behind every retry loop;
 - :mod:`.health` — per-worker failure tracking and quarantine feeding
-  the coordinator's closure re-scheduling.
+  the coordinator's closure re-scheduling;
+- :mod:`.supervisor` — the recovery supervisor closing the loop: it
+  restarts dead workers, reforms the cluster under a fresh generation
+  (cluster/elastic.py), and resumes from the last intact checkpoint.
 """
 
 from distributed_tensorflow_tpu.resilience import faults
@@ -21,3 +24,10 @@ from distributed_tensorflow_tpu.resilience.faults import (
 )
 from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
 from distributed_tensorflow_tpu.resilience.health import WorkerHealthTracker
+from distributed_tensorflow_tpu.resilience.supervisor import (
+    KillSpec,
+    RecoveryFailedError,
+    RecoverySupervisor,
+    WorkerFailure,
+    seeded_kill_plan,
+)

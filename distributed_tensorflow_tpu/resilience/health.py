@@ -96,6 +96,18 @@ class WorkerHealthTracker:
             h.total_successes += 1
             h.quarantined_until = None
 
+    def worker_restarted(self, worker_id: int):
+        """Supervisor-confirmed restart (a new cluster generation): the
+        process behind this lane is fresh, so the quarantine and the
+        consecutive-failure streak no longer describe it — clear both.
+        Lifetime totals (``total_failures``, ``quarantine_count``) are
+        kept: they describe the lane's history, not its current
+        incarnation."""
+        with self._lock:
+            h = self._workers.setdefault(worker_id, _WorkerHealth())
+            h.consecutive_failures = 0
+            h.quarantined_until = None
+
     def is_quarantined(self, worker_id: int) -> bool:
         with self._lock:
             h = self._workers.get(worker_id)

@@ -1,0 +1,384 @@
+"""Recovery supervisor: detect worker failure → reform → resume.
+
+The piece that turns the detection stack (chaos injection, RetryPolicy,
+WorkerHealthTracker, checkpoint integrity, StallDetector, structured
+telemetry) into an actual fault-tolerance story: a controlling process
+that runs a multi-worker training job, watches it, and — when a worker
+dies, is preempted, or stalls — executes a bounded recovery instead of
+letting the run end (≙ Elastic Horovod's driver / the reference
+failure-handling module's restart-the-job contract, closed-loop).
+
+The recovery protocol, per failure:
+
+1. **Detect.** Poll task exit codes (SIGKILL → negative signal code,
+   preemption → :data:`~distributed_tensorflow_tpu.checkpoint.
+   failure_handling.EXIT_PREEMPTED`, crash → anything else) and, when
+   configured, per-task heartbeat staleness (stall — the supervisor-side
+   complement of the in-process StallDetector).
+2. **Kill stragglers.** Survivors of a dead peer are typically wedged
+   in a collective or barrier against it; they are SIGKILLed rather
+   than waited out.
+3. **Reform.** The cluster *generation id* is incremented and every
+   task is respawned (``multi_process_runner.MultiProcessRunner.reform``:
+   per-worker restart under a fresh cluster spec — fresh
+   coordination-service ports) with ``DTX_CLUSTER_GENERATION`` bumped,
+   so the new incarnation's KV keys and barriers live in a fresh
+   namespace (cluster/elastic.py).
+4. **Resume.** Restarted workers restore from the latest *intact*
+   checkpoint (torn checkpoints are already skipped by
+   ``CheckpointManager.latest_checkpoint``) and re-enter their step
+   loop. Restart pacing follows a :class:`RetryPolicy` backoff; the
+   restart budget is bounded, and exhaustion raises
+   :class:`RecoveryFailedError` carrying the full failure history.
+
+Every transition emits ``recovery.*`` telemetry events (plus a
+``recovery.recover`` span around each reform), written both to the
+supervisor's own ``events-supervisor.jsonl`` under ``telemetry_dir``
+and to the process-wide event log when one is configured —
+``tools/obs_report.py`` renders them as a recovery timeline.
+
+Chaos: ``kill_plan`` schedules seed-driven SIGKILLs through the
+supervisor itself (fired when the victim's heartbeat reaches a target
+step), which is how ``tools/chaos_sweep.py --kill`` and the elastic
+end-to-end tests drive worker death deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import tempfile
+import time
+from typing import Callable, Mapping, Sequence
+
+from distributed_tensorflow_tpu.checkpoint.failure_handling import (
+    EXIT_PREEMPTED,
+)
+from distributed_tensorflow_tpu.cluster import elastic
+from distributed_tensorflow_tpu.resilience.health import WorkerHealthTracker
+from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
+from distributed_tensorflow_tpu.telemetry import events as _events
+from distributed_tensorflow_tpu.testing import multi_process_runner as mpr
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerFailure:
+    """One detected failure (an entry of the recovery history)."""
+
+    generation: int
+    task: tuple[str, int]
+    kind: str                     # "killed" | "preempted" | "crash" | "stall"
+    exitcode: int | None = None
+    wall: float = 0.0
+    detail: str = ""
+
+    def describe(self) -> str:
+        code = "" if self.exitcode is None else f" exit={self.exitcode}"
+        extra = f" ({self.detail})" if self.detail else ""
+        return (f"gen{self.generation} {self.task[0]}:{self.task[1]} "
+                f"{self.kind}{code}{extra}")
+
+
+class RecoveryFailedError(RuntimeError):
+    """The restart budget is exhausted (or recovery is disabled) and the
+    job still cannot finish. Carries the full failure ``history`` so the
+    operator sees every death that led here, not just the last."""
+
+    def __init__(self, msg: str, history: Sequence[WorkerFailure]):
+        super().__init__(msg)
+        self.history: list[WorkerFailure] = list(history)
+
+
+@dataclasses.dataclass(frozen=True)
+class KillSpec:
+    """One scheduled chaos kill: SIGKILL ``worker`` once its heartbeat
+    reports a step >= ``after_step``."""
+
+    worker: int
+    after_step: int
+
+
+def seeded_kill_plan(seed: int, num_workers: int, *, kills: int = 1,
+                     step_range: tuple[int, int] = (3, 12)) -> list[KillSpec]:
+    """Deterministic kill schedule from a chaos seed (the
+    resilience/faults.py seeding discipline: a string-seeded stream that
+    is a pure function of the seed, stable across processes/runs)."""
+    rng = random.Random(f"dtx-kill:{seed}")
+    return [KillSpec(worker=rng.randrange(num_workers),
+                     after_step=rng.randrange(*step_range))
+            for _ in range(kills)]
+
+
+class RecoverySupervisor:
+    """Run ``worker_fn`` as an elastic multi-worker job that survives
+    worker death.
+
+    ``worker_fn`` is one cluster task's whole life for one generation:
+    it must be restartable — bootstrap from ``TF_CONFIG``, restore from
+    the latest checkpoint, train, checkpoint periodically — and should
+    call :func:`cluster.elastic.heartbeat` once per step so the
+    supervisor can see progress (stall detection, step-targeted chaos
+    kills). Spawn semantics are those of
+    :class:`testing.multi_process_runner.MultiProcessRunner`: the fn
+    must be module-level (picklable by reference).
+
+    ::
+
+        sup = RecoverySupervisor(worker_fn, num_workers=2,
+                                 args=(ckpt_dir, total_steps),
+                                 max_restarts=3,
+                                 telemetry_dir=run_dir)
+        result = sup.run()            # or raises RecoveryFailedError
+        values = result.return_values # final generation's returns
+    """
+
+    def __init__(self, worker_fn: Callable, *,
+                 num_workers: int = 2,
+                 args: tuple = (), kwargs: dict | None = None,
+                 env: Mapping[str, str] | None = None,
+                 devices_per_process: int = 1,
+                 max_restarts: int = 3,
+                 retry_policy: RetryPolicy | None = None,
+                 health: WorkerHealthTracker | None = None,
+                 stall_timeout_s: float | None = None,
+                 generation_timeout_s: float = 600.0,
+                 poll_interval_s: float = 0.05,
+                 kill_plan: Sequence[KillSpec] = (),
+                 telemetry_dir: str | None = None,
+                 work_dir: str | None = None):
+        self._fn = worker_fn
+        self._num_workers = num_workers
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._env = dict(env or {})
+        self._devices = devices_per_process
+        self.max_restarts = max_restarts
+        self._policy = retry_policy or RetryPolicy(
+            max_attempts=max_restarts + 1, initial_backoff_s=0.2,
+            backoff_multiplier=2.0, max_backoff_s=10.0)
+        self.health = health or WorkerHealthTracker()
+        self._stall_timeout_s = stall_timeout_s
+        self._generation_timeout_s = generation_timeout_s
+        self._poll_s = poll_interval_s
+        self._pending_kills: list[KillSpec] = list(kill_plan)
+        self._telemetry_dir = telemetry_dir
+        self._dir = work_dir or tempfile.mkdtemp(prefix="dtx_supervisor_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._log: _events.EventLog | None = None
+        if telemetry_dir:
+            self._log = _events.EventLog(
+                os.path.join(telemetry_dir, "events-supervisor.jsonl"),
+                process_id="supervisor")
+        self.history: list[WorkerFailure] = []
+        self.generation = 0
+        self.restarts_used = 0
+        self._runner: mpr.MultiProcessRunner | None = None
+
+    # -- telemetry --------------------------------------------------------
+    def _event(self, name: str, **fields):
+        if self._log is not None:
+            # recovery transitions are rare and each must survive a
+            # supervisor crash: flush per event
+            self._log.event(name, **fields)
+            self._log.flush()
+        else:
+            # no supervisor file: fall back to the process-wide log (if
+            # any) so in-process callers still see the transitions
+            _events.event(name, **fields)
+
+    # -- lifecycle --------------------------------------------------------
+    def _child_env(self, generation: int) -> dict[str, str]:
+        env = dict(self._env)
+        env[elastic.ENV_GENERATION] = str(generation)
+        env[elastic.ENV_SUPERVISOR_DIR] = self._dir
+        if self._telemetry_dir:
+            env.setdefault(_events.ENV_TELEMETRY_DIR, self._telemetry_dir)
+        return env
+
+    def _clear_heartbeats(self):
+        for i in range(self._num_workers):
+            try:
+                os.unlink(elastic.heartbeat_path(self._dir, i))
+            except OSError:
+                pass
+
+    def _heartbeat(self, worker: int) -> tuple[float, int | None] | None:
+        """(mtime, step) of a worker's heartbeat file, None if absent."""
+        path = elastic.heartbeat_path(self._dir, worker)
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path) as f:
+                text = f.read().strip()
+            return mtime, int(text) if text else None
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _classify(exitcode: int | None) -> str:
+        if exitcode is None:
+            return "stall"
+        if exitcode < 0:
+            import signal as _signal
+            return ("killed" if -exitcode == _signal.SIGKILL
+                    else "preempted" if -exitcode == _signal.SIGTERM
+                    else "crash")
+        if exitcode == EXIT_PREEMPTED:
+            return "preempted"
+        return "crash"
+
+    # -- the loop ---------------------------------------------------------
+    def run(self) -> mpr.MultiProcessRunnerResult:
+        """Run the job to completion, recovering from failures within
+        the restart budget. Returns the final generation's result;
+        raises :class:`RecoveryFailedError` on budget exhaustion."""
+        spec = mpr.create_cluster_spec(num_workers=self._num_workers)
+        self._runner = mpr.MultiProcessRunner(
+            self._fn, spec, args=self._args, kwargs=self._kwargs,
+            env=self._child_env(0), devices_per_process=self._devices,
+            timeout=self._generation_timeout_s)
+        self._event("recovery.run_start", num_workers=self._num_workers,
+                    max_restarts=self.max_restarts,
+                    chaos_kills=len(self._pending_kills))
+        self._clear_heartbeats()
+        self._runner.start()
+        self._event("recovery.generation_start", generation=0)
+        backoff = Backoff(self._policy)
+        try:
+            while True:
+                failures = self._watch()
+                if failures is None:
+                    result = self._runner.join(timeout=60,
+                                               raise_on_error=False)
+                    failures = self._result_failures(result)
+                    if not failures:
+                        for i in range(self._num_workers):
+                            self.health.record_success(i)
+                        self._event("recovery.run_complete",
+                                    generation=self.generation,
+                                    restarts=self.restarts_used)
+                        return result
+                self._recover(failures, backoff)
+        finally:
+            self._runner.terminate_all()
+
+    def _result_failures(self, result) -> list[WorkerFailure]:
+        return [WorkerFailure(generation=self.generation, task=k,
+                              kind=self._classify(t.exitcode),
+                              exitcode=t.exitcode, wall=time.time(),
+                              detail=(t.error or "")[-300:])
+                for k, t in sorted(result.tasks.items())
+                if t.exitcode != 0 or t.error is not None]
+
+    def _watch(self) -> list[WorkerFailure] | None:
+        """Watch the current generation. Returns failures needing
+        recovery, or None when every task exited cleanly."""
+        runner = self._runner
+        t0 = time.monotonic()
+        while True:
+            exits = runner.poll()
+            bad = {k: c for k, c in exits.items() if c != 0}
+            if bad:
+                return [WorkerFailure(
+                    generation=self.generation, task=k,
+                    kind=self._classify(c), exitcode=c, wall=time.time())
+                    for k, c in sorted(bad.items())]
+            if len(exits) == runner.num_tasks:
+                return None
+            self._fire_due_kills(exits)
+            stalled = self._check_stall(exits, t0)
+            if stalled is not None:
+                return [stalled]
+            if time.monotonic() - t0 > self._generation_timeout_s:
+                return [WorkerFailure(
+                    generation=self.generation, task=("worker", -1),
+                    kind="stall", wall=time.time(),
+                    detail=f"generation exceeded "
+                           f"{self._generation_timeout_s}s")]
+            time.sleep(self._poll_s)
+
+    def _fire_due_kills(self, exits):
+        for spec in list(self._pending_kills):
+            if ("worker", spec.worker) in exits:
+                continue                    # already down — keep waiting
+            hb = self._heartbeat(spec.worker)
+            if hb is None or hb[1] is None or hb[1] < spec.after_step:
+                continue
+            self._event("recovery.chaos_kill", generation=self.generation,
+                        worker=spec.worker, after_step=spec.after_step,
+                        at_step=hb[1])
+            self._runner.terminate("worker", spec.worker)
+            self._pending_kills.remove(spec)
+
+    def _check_stall(self, exits, t0: float) -> WorkerFailure | None:
+        if self._stall_timeout_s is None:
+            return None
+        now = time.time()
+        worst: tuple[float, int] | None = None    # (age, worker)
+        for i in range(self._num_workers):
+            if ("worker", i) in exits:
+                continue                          # finished: not stalled
+            hb = self._heartbeat(i)
+            # before the first heartbeat, age from generation start
+            # (covers spawn + jax import + compile)
+            age = (now - hb[0]) if hb is not None \
+                else (time.monotonic() - t0)
+            if worst is None or age > worst[0]:
+                worst = (age, i)
+        if worst is not None and worst[0] > self._stall_timeout_s:
+            return WorkerFailure(
+                generation=self.generation, task=("worker", worst[1]),
+                kind="stall", wall=now,
+                detail=f"no heartbeat for {worst[0]:.1f}s "
+                       f"(budget {self._stall_timeout_s}s)")
+        return None
+
+    def _recover(self, failures: list[WorkerFailure],
+                 backoff: Backoff):
+        """Bounded recovery: record → kill stragglers → (budget
+        permitting) back off, bump the generation, reform, un-quarantine
+        the restarted lanes."""
+        for f in failures:
+            self.history.append(f)
+            self.health.record_failure(f.task[1])
+            self._event("recovery.worker_death", generation=f.generation,
+                        task_type=f.task[0], task_id=f.task[1],
+                        kind=f.kind, exitcode=f.exitcode, detail=f.detail)
+        # a stalled task is still alive; every straggler of the dead
+        # generation gets killed before the namespace moves on
+        for key in self._runner.alive_tasks():
+            self._event("recovery.kill_straggler",
+                        generation=self.generation,
+                        task_type=key[0], task_id=key[1])
+        self._runner.terminate_all()
+        if self.restarts_used >= self.max_restarts:
+            self._event("recovery.failed", generation=self.generation,
+                        restarts=self.restarts_used,
+                        failures=len(self.history))
+            raise RecoveryFailedError(
+                f"restart budget exhausted ({self.restarts_used}/"
+                f"{self.max_restarts} restarts used) after "
+                f"{len(self.history)} failure(s): "
+                + "; ".join(f.describe() for f in self.history[-5:]),
+                self.history)
+        self.restarts_used += 1
+        delay = backoff.next_s()
+        self.generation += 1
+        span_cm = (self._log.span if self._log is not None
+                   else _events.span)
+        with span_cm("recovery.recover", generation=self.generation,
+                     restart=self.restarts_used, backoff_s=round(delay, 3)):
+            if delay > 0:
+                time.sleep(delay)
+            self._clear_heartbeats()
+            self._event("recovery.restart", generation=self.generation,
+                        restart=self.restarts_used,
+                        budget_left=self.max_restarts - self.restarts_used,
+                        backoff_s=round(delay, 3))
+            self._runner.reform(
+                mpr.create_cluster_spec(num_workers=self._num_workers),
+                env=self._child_env(self.generation))
+            for f in failures:
+                self.health.worker_restarted(f.task[1])
+        self._event("recovery.generation_start",
+                    generation=self.generation)   # also flushes the span

@@ -59,13 +59,17 @@ class EventLog:
     record except the final line of a crashed writer).
     """
 
-    def __init__(self, path: str, process_id: int | None = None,
+    def __init__(self, path: str, process_id: "int | str | None" = None,
                  run_id: str | None = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.process_id = process_id if process_id is not None else 0
         self._lock = threading.Lock()
-        self._f: io.TextIOBase | None = open(path, "a",
+        # line-buffered: every complete event line reaches the OS as it
+        # is written, so a process that dies hard (SIGKILL, os._exit —
+        # exactly the processes whose last events matter most) loses at
+        # most the line being written, never a buffer of whole events
+        self._f: io.TextIOBase | None = open(path, "a", buffering=1,
                                              encoding="utf-8")
         self._t0 = time.monotonic()
         self._last_t = 0.0
@@ -153,10 +157,16 @@ def _default_process_id() -> int:
             return jax.process_index()
     except Exception:
         pass
-    try:
-        return int(os.environ.get("DTX_TASK_ID", "0"))
-    except ValueError:
-        return 0
+    # multi_process_runner children: the task index is injected before
+    # jax.distributed comes up, so env-activated logs in a freshly
+    # spawned cluster task land in per-task files instead of all
+    # colliding on events-0.jsonl
+    for var in ("DTX_TASK_ID", "DTX_MPR_TASK_INDEX"):
+        try:
+            return int(os.environ[var])
+        except (KeyError, ValueError):
+            continue
+    return 0
 
 
 def event_log_path(logdir: str, process_id: int) -> str:
@@ -258,12 +268,15 @@ def read_events(path: str, *, tolerate_torn_tail: bool = True) -> list[dict]:
 
 def read_run(logdir: str, *, tolerate_torn_tail: bool = True) -> dict:
     """All per-process event files under ``logdir``:
-    ``{process_id: [events...]}`` keyed by the id in the file name."""
+    ``{process_id: [events...]}`` keyed by the id in the file name
+    (numeric ids as ints; a recovery supervisor's file keys as the
+    string ``"supervisor"``)."""
     import glob
     import re
-    out: dict[int, list[dict]] = {}
+    out: dict = {}
     for path in sorted(glob.glob(os.path.join(logdir, "events-*.jsonl"))):
-        m = re.search(r"events-(\d+)\.jsonl$", path)
-        pid = int(m.group(1)) if m else len(out)
+        m = re.search(r"events-([A-Za-z0-9_]+)\.jsonl$", path)
+        suffix = m.group(1) if m else str(len(out))
+        pid = int(suffix) if suffix.isdigit() else suffix
         out[pid] = read_events(path, tolerate_torn_tail=tolerate_torn_tail)
     return out

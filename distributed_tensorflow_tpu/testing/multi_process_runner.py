@@ -163,6 +163,17 @@ def _child_main(env: dict, payload: bytes, task_type: str, task_id: int,
         except Exception:
             conn.send(("ok", repr(value)))   # unpicklable return value
         exitcode = 0
+    except SystemExit as e:
+        # Preserve platform exit codes: a task exiting SystemExit(42)
+        # (the preemption-restart convention, failure_handling.py) must
+        # surface 42 to a supervising parent, not a generic 1 — the
+        # recovery supervisor classifies failures by exit code.
+        exitcode = e.code if isinstance(e.code, int) else \
+            (0 if e.code is None else 1)
+        if exitcode == 0:
+            conn.send(("ok", None))
+        else:
+            conn.send(("error", f"SystemExit({e.code})"))
     except BaseException:
         conn.send(("error", traceback.format_exc()))
         exitcode = 1
@@ -203,43 +214,120 @@ class MultiProcessRunner:
         self._conns: dict[tuple[str, int], Any] = {}
         self._stdout: dict[tuple[str, int], str] = {}
         self._results: dict[tuple[str, int], TaskResult] = {}
+        self._task_env: dict[tuple[str, int], dict] = {}
+        self._incarnation: dict[tuple[str, int], int] = {}
+        #: TaskResults of dead incarnations replaced by :meth:`restart`
+        #: (a supervisor's failure-history raw material).
+        self.history: list[TaskResult] = []
+        self._payload: bytes | None = None
         self._tmpdir = None
 
     # -- lifecycle --------------------------------------------------------
+    def _task_keys(self) -> list[tuple[str, int]]:
+        return [(t, i) for t in sorted(self._spec)
+                for i in range(len(self._spec[t]))]
+
+    @property
+    def num_tasks(self) -> int:
+        return sum(len(v) for v in self._spec.values())
+
+    def _base_env(self, task_type: str, task_id: int,
+                  task_index: int) -> dict:
+        env = _child_env(self._devices)
+        env.update({
+            "TF_CONFIG": json.dumps({
+                "cluster": self._spec,
+                "task": {"type": task_type, "index": task_id},
+            }),
+            "DTX_MPR_NUM_TASKS": str(self.num_tasks),
+            "DTX_MPR_TASK_INDEX": str(task_index),
+        })
+        env.update(self._extra_env)
+        return env
+
+    def _spawn(self, key: tuple[str, int], env: dict):
+        """(Re)spawn one task process with ``env``; replaces any previous
+        pipe/stdout bookkeeping for ``key``."""
+        task_type, task_id = key
+        inc = self._incarnation.get(key, 0)
+        parent_conn, child_conn = _MP.Pipe()
+        stdout_path = os.path.join(
+            self._tmpdir,
+            f"{task_type}_{task_id}.out" if inc == 0
+            else f"{task_type}_{task_id}.r{inc}.out")
+        p = _MP.Process(
+            target=_child_main,
+            args=(env, self._payload, task_type, task_id, child_conn,
+                  stdout_path),
+            daemon=True)
+        p.start()
+        child_conn.close()
+        self._procs[key] = p
+        self._conns[key] = parent_conn
+        self._stdout[key] = stdout_path
+        self._task_env[key] = env
+        self._incarnation[key] = inc + 1
+
     def start(self):
         import tempfile
         self._tmpdir = tempfile.mkdtemp(prefix="mpr_")
-        payload = pickle.dumps((self._fn, self._args, self._kwargs))
-        ntasks = sum(len(v) for v in self._spec.values())
-        task_index = 0
-        for task_type in sorted(self._spec):
-            for task_id, _ in enumerate(self._spec[task_type]):
-                env = _child_env(self._devices)
-                env.update({
-                    "TF_CONFIG": json.dumps({
-                        "cluster": self._spec,
-                        "task": {"type": task_type, "index": task_id},
-                    }),
-                    "DTX_MPR_NUM_TASKS": str(ntasks),
-                    "DTX_MPR_TASK_INDEX": str(task_index),
-                })
-                env.update(self._extra_env)
-                parent_conn, child_conn = _MP.Pipe()
-                stdout_path = os.path.join(
-                    self._tmpdir, f"{task_type}_{task_id}.out")
-                p = _MP.Process(
-                    target=_child_main,
-                    args=(env, payload, task_type, task_id, child_conn,
-                          stdout_path),
-                    daemon=True)
-                p.start()
-                child_conn.close()
-                key = (task_type, task_id)
-                self._procs[key] = p
-                self._conns[key] = parent_conn
-                self._stdout[key] = stdout_path
-                task_index += 1
+        self._payload = pickle.dumps((self._fn, self._args, self._kwargs))
+        for task_index, key in enumerate(self._task_keys()):
+            self._spawn(key, self._base_env(key[0], key[1], task_index))
         return self
+
+    def restart(self, task_type: str, task_id: int, *,
+                env: Mapping[str, str] | None = None):
+        """Per-worker restart: SIGKILL the task if still alive, archive
+        its result into :attr:`history`, and respawn it with its prior
+        environment plus ``env`` overrides (e.g. a fresh ``TF_CONFIG``
+        or a bumped ``DTX_CLUSTER_GENERATION``). ``join`` then waits on
+        the NEW incarnation."""
+        key = (task_type, task_id)
+        p = self._procs[key]
+        if p.exitcode is None:
+            p.kill()
+            p.join(10)
+        self._collect(key)
+        self.history.append(self._results.pop(key))
+        new_env = dict(self._task_env[key])
+        new_env.update(env or {})
+        self._spawn(key, new_env)
+
+    def reform(self, cluster_spec: Mapping[str, Sequence[str]] | None = None,
+               *, env: Mapping[str, str] | None = None):
+        """Full-cluster restart: kill every task, swap in a fresh cluster
+        spec (fresh coordination-service ports — required: the dead
+        incarnation's service socket may linger in TIME_WAIT), and
+        respawn all tasks via :meth:`restart` with the new ``TF_CONFIG``
+        plus ``env`` overrides. The recovery supervisor's reform
+        primitive."""
+        self.terminate_all()
+        if cluster_spec is not None:
+            new = {k: list(v) for k, v in cluster_spec.items()}
+            if sorted((t, len(v)) for t, v in new.items()) != \
+                    sorted((t, len(v)) for t, v in self._spec.items()):
+                raise ValueError(
+                    f"reform must keep the cluster shape: "
+                    f"{self._spec.keys()} -> {new.keys()}")
+            self._spec = new
+        for task_index, key in enumerate(self._task_keys()):
+            updates = {"TF_CONFIG": json.dumps({
+                "cluster": self._spec,
+                "task": {"type": key[0], "index": key[1]},
+            })}
+            updates.update(env or {})
+            self.restart(key[0], key[1], env=updates)
+
+    def poll(self) -> dict[tuple[str, int], int]:
+        """Exit codes of tasks whose current incarnation has exited
+        (non-blocking; a restarted-and-running task is absent)."""
+        return {k: p.exitcode for k, p in self._procs.items()
+                if p.exitcode is not None}
+
+    def alive_tasks(self) -> list[tuple[str, int]]:
+        return sorted(k for k, p in self._procs.items()
+                      if p.exitcode is None)
 
     def terminate(self, task_type: str, task_id: int):
         """SIGKILL one task (≙ multi_process_runner.terminate :646)."""

@@ -8,18 +8,139 @@ SPMD step via `strategy.compile_step`. For the TF-parity
 scope()/run()/merge_call surface, see tests/test_strategy.py and the
 conformance suite (testing/strategy_conformance.py); for the Keras-style
 `Model.fit` layer, see distributed_tensorflow_tpu/training.
+
+``--elastic`` instead runs the job as an N-worker cluster under the
+recovery supervisor (resilience/supervisor.py): worker processes train
+data-parallel with periodic checkpoints; if one dies (try
+``--kill-seed``) the supervisor kills the stragglers, reforms the
+cluster under a fresh generation, and the job resumes from the last
+intact checkpoint. Render the run with ``tools/obs_report.py
+<telemetry-dir>`` to see the recovery timeline.
 """
 
 import argparse
+import os
+import sys
 
-import jax
-import jax.numpy as jnp
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-from distributed_tensorflow_tpu import telemetry
-from distributed_tensorflow_tpu.input.dataset import Dataset
-from distributed_tensorflow_tpu.models.mnist_cnn import (
-    create_train_state, make_train_step, synthetic_data)
-from distributed_tensorflow_tpu.parallel.mirrored import MirroredStrategy
+#: deterministic synthetic sample pool shared by every worker/generation
+_POOL = 512
+
+
+def elastic_worker(ckpt_dir, total_steps, save_every, per_batch, lr):
+    """One generation of one elastic worker: bootstrap from TF_CONFIG,
+    restore from the latest intact checkpoint, train data-parallel
+    (grads allgather-averaged across processes), checkpoint every
+    ``save_every`` steps, heartbeat every step. Module-level so the
+    supervisor's spawn machinery can pickle it by reference."""
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+    runtime = bootstrap.initialize()
+    import jax
+    import numpy as np
+    import optax
+    from jax.experimental import multihost_utils
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, CheckpointManager)
+    from distributed_tensorflow_tpu.models.mnist_cnn import (
+        create_train_state, synthetic_data)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tdir:
+        tv_events.configure(tdir, process_id=runtime.process_id)
+
+    state, model, tx = create_train_state(jax.random.PRNGKey(0),
+                                          learning_rate=lr)
+    params, opt_state = state["params"], state["opt_state"]
+    data = synthetic_data(_POOL)
+
+    def loss_fn(p, images, labels):
+        logits = model.apply({"params": p}, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def apply_fn(p, o, grads):
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o
+
+    leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
+    ckpt = Checkpoint(leaves=list(leaves))
+    mgr = CheckpointManager(ckpt, ckpt_dir, checkpoint_name="elastic")
+    start_step = 0
+    latest = mgr.latest_checkpoint
+    if latest is not None:
+        restored = Checkpoint(leaves=list(leaves)).restore(latest)
+        params, opt_state = jax.tree_util.tree_unflatten(
+            treedef, [restored[f"leaves/{i}"] for i in range(len(leaves))])
+        start_step = int(latest.rsplit("-", 1)[1])
+        print(f"[gen {runtime.generation} p{runtime.process_id}] resumed "
+              f"from {os.path.basename(latest)} at step {start_step}")
+
+    nproc, pid = runtime.num_processes, runtime.process_id
+    gb = per_batch * nproc
+    loss = float("nan")
+    import time as _time
+    for step in range(start_step, total_steps):
+        elastic.heartbeat(step)
+        t0 = _time.perf_counter()
+        start = (step * gb + pid * per_batch) % _POOL
+        idx = (np.arange(per_batch) + start) % _POOL
+        loss, grads = grad_fn(params, data["image"][idx],
+                              data["label"][idx])
+        if nproc > 1:
+            grads = jax.tree_util.tree_map(
+                lambda g: np.asarray(
+                    multihost_utils.process_allgather(g)).mean(0), grads)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        tv_events.event("train.step", step=step, loss=float(loss),
+                        dur_s=round(_time.perf_counter() - t0, 6))
+        if (step + 1) % save_every == 0:
+            ckpt._objects["leaves"] = list(
+                jax.tree_util.tree_flatten((params, opt_state))[0])
+            mgr.save(checkpoint_number=step + 1)
+        if step % 10 == 0 and pid == 0:
+            print(f"[gen {runtime.generation}] step {step}: "
+                  f"loss={float(loss):.4f}")
+    bootstrap.shutdown()
+    return runtime.process_id, start_step, float(loss)
+
+
+def run_elastic(args):
+    import tempfile
+
+    from distributed_tensorflow_tpu.resilience import (
+        RecoverySupervisor, seeded_kill_plan)
+
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="mnist_elastic_")
+    kill_plan = ()
+    if args.kill_seed is not None:
+        kill_plan = seeded_kill_plan(args.kill_seed, args.workers,
+                                     kills=args.kills,
+                                     step_range=(2, max(3, args.steps - 4)))
+        print(f"chaos kill plan (seed {args.kill_seed}): {kill_plan}")
+    sup = RecoverySupervisor(
+        elastic_worker, num_workers=args.workers,
+        args=(ckpt_dir, args.steps, args.save_every, args.global_batch //
+              args.workers, args.lr),
+        max_restarts=args.restart_budget, kill_plan=kill_plan,
+        generation_timeout_s=args.generation_timeout,
+        telemetry_dir=args.telemetry_dir)
+    result = sup.run()
+    for pid, start_step, loss in sorted(result.return_values):
+        print(f"worker {pid}: resumed@{start_step} final loss={loss:.4f}")
+    print(f"done: {sup.restarts_used} restart(s), "
+          f"{len(sup.history)} recorded failure(s), "
+          f"final generation {sup.generation}")
+    if args.telemetry_dir:
+        print(f"recovery timeline: python tools/obs_report.py "
+              f"{args.telemetry_dir}")
 
 
 def main():
@@ -31,7 +152,39 @@ def main():
                     help="enable telemetry: per-step train.step events "
                          "(JSONL) land here; render with "
                          "tools/obs_report.py")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run as a multi-worker job under the recovery "
+                         "supervisor (worker death -> reform -> resume)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="elastic: number of worker processes")
+    ap.add_argument("--save-every", type=int, default=10,
+                    help="elastic: checkpoint every N steps")
+    ap.add_argument("--restart-budget", type=int, default=3,
+                    help="elastic: max cluster reforms before "
+                         "RecoveryFailedError")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="elastic: checkpoint directory (default: tmp)")
+    ap.add_argument("--kill-seed", type=int, default=None,
+                    help="elastic chaos: SIGKILL workers on a schedule "
+                         "derived from this seed")
+    ap.add_argument("--kills", type=int, default=1,
+                    help="elastic chaos: number of scheduled kills")
+    ap.add_argument("--generation-timeout", type=float, default=600.0,
+                    help="elastic: per-generation wall budget (s)")
     args = ap.parse_args()
+
+    if args.elastic:
+        run_elastic(args)
+        return
+
+    import jax
+
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.input.dataset import Dataset
+    from distributed_tensorflow_tpu.models.mnist_cnn import (
+        create_train_state, make_train_step, synthetic_data)
+    from distributed_tensorflow_tpu.parallel.mirrored import MirroredStrategy
+
     if args.telemetry_dir:
         telemetry.configure(args.telemetry_dir)
 

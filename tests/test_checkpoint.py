@@ -201,6 +201,119 @@ def test_preemption_grace_period_keeps_training(tmp_path):
     assert exited                  # window closed -> exit at boundary
     assert steps_after_save > 5    # genuinely kept training
 
+# -- crash-mid-commit window (ISSUE 5 satellite) ----------------------------
+
+def test_crash_mid_commit_skips_torn_restores_previous(tmp_path):
+    """A writer killed inside the commit window must leave the previous
+    checkpoint as the restorable latest. Two points in the window:
+
+    1. death IN the commit (the ``checkpoint.commit`` fault site): the
+       second save raises, its index never lands;
+    2. death BETWEEN shard write and index commit: shards renamed into
+       place, index missing — the exact window the index-commits-last
+       protocol exists for.
+
+    Both torn attempts must be invisible to ``latest_checkpoint`` and
+    restore from the surviving checkpoint must succeed."""
+    from distributed_tensorflow_tpu.resilience import (
+        FaultRule, FaultSchedule, faults)
+
+    state = {"w": np.arange(4.0)}
+    mgr = CheckpointManager(Checkpoint(state=state), str(tmp_path))
+    mgr.save(checkpoint_number=1)
+
+    # window point 1: the commit itself dies (fault site raises)
+    sched = FaultSchedule(rules=[FaultRule(site="checkpoint.commit")])
+    with faults.inject(sched):
+        with pytest.raises(OSError):
+            mgr.save(checkpoint_number=2)
+    assert mgr.latest_checkpoint.endswith("ckpt-1")
+    assert not os.path.exists(tmp_path / "ckpt-2" /
+                              "checkpoint.index.json")
+
+    # window point 2: shards committed, index not — simulate the kill
+    # by hiding the index the commit just wrote
+    state["w"] = np.arange(4.0) * 3.0
+    mgr.save(checkpoint_number=3)
+    assert (tmp_path / "ckpt-3" / "shard_0.npz").exists()
+    (tmp_path / "ckpt-3" / "checkpoint.index.json").rename(
+        tmp_path / "hidden.index")
+
+    # the torn checkpoints are skipped everywhere...
+    assert mgr.latest_checkpoint.endswith("ckpt-1")
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt-1")
+    assert [os.path.basename(p) for p in mgr.checkpoints] == ["ckpt-1"]
+    # ...and restore from the previous intact checkpoint succeeds
+    restored = Checkpoint(state={"w": np.zeros(4)}).restore(
+        mgr.latest_checkpoint)
+    np.testing.assert_array_equal(restored["state/w"], np.arange(4.0))
+
+
+# -- preemption restart-instead-of-exit mode (ISSUE 5) ----------------------
+
+def test_preemption_restart_mode_raises_training_preempted(tmp_path):
+    """exit_mode='restart': after the preemption checkpoint commits the
+    handler raises TrainingPreempted (library code never exits the
+    process); the checkpoint is on disk and the SIGTERM handler is
+    restored."""
+    import signal
+
+    from distributed_tensorflow_tpu.checkpoint import TrainingPreempted
+
+    before = signal.getsignal(signal.SIGTERM)
+    s = dtx.MirroredStrategy()
+    with s.scope():
+        v = s.create_variable(np.zeros(()), name="r")
+    mgr = CheckpointManager(Checkpoint(v=v), str(tmp_path))
+    handler = PreemptionCheckpointHandler(
+        mgr, TerminationConfig(exit_mode="restart"))
+    assert signal.getsignal(signal.SIGTERM) is not before
+
+    def step():
+        v.assign_add(1.0)
+
+    handler.run(step)
+    handler.watch_preemption()
+    with pytest.raises(TrainingPreempted, match="restart to resume"):
+        handler.run(step)
+    assert mgr.latest_checkpoint is not None
+    # _exit restored the pre-handler SIGTERM handler
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_finalize_restores_sigterm_handler(tmp_path):
+    """finalize() must restore the prior SIGTERM handler the way
+    PreemptionWatcher.stop() does — with or without a pending signal."""
+    import signal
+
+    s = dtx.MirroredStrategy()
+    with s.scope():
+        v = s.create_variable(np.zeros(()), name="f")
+    mgr = CheckpointManager(Checkpoint(v=v), str(tmp_path))
+    before = signal.getsignal(signal.SIGTERM)
+
+    # no signal: finalize is a no-op except the handler unwind
+    handler = PreemptionCheckpointHandler(
+        mgr, TerminationConfig(exit_fn=lambda: None))
+    assert signal.getsignal(signal.SIGTERM) is not before
+    handler.finalize()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+    # with a pending signal: finalize checkpoints AND unwinds
+    handler2 = PreemptionCheckpointHandler(
+        mgr, TerminationConfig(exit_fn=lambda: None))
+    handler2.run(lambda: v.assign_add(1.0))
+    handler2.watch_preemption()
+    handler2.finalize()
+    assert signal.getsignal(signal.SIGTERM) is before
+    assert mgr.latest_checkpoint is not None
+
+
+def test_termination_config_rejects_unknown_exit_mode():
+    with pytest.raises(ValueError, match="exit_mode"):
+        TerminationConfig(exit_mode="explode")
+
+
 # -- SidecarEvaluator hardening (VERDICT r4 item 6) -------------------------
 
 def _make_ckpt_dir(tmp_path, steps, value_fn=lambda s: s):

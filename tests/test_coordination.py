@@ -125,3 +125,51 @@ def test_barrier_retry_under_policy(agent):
                              agent.barrier("flaky", timeout_s=5)))
         assert len(attempts) == 2
         assert [e[3] for e in reg.events()] == ["raise"]
+
+
+# -- elastic generation namespacing (ISSUE 5 tentpole) -----------------------
+
+def test_generation_namespaces_kv_and_barriers(agent):
+    """Every KV key/barrier is namespaced by the elastic cluster
+    generation: a reformed cluster (gen N) cannot see a dead
+    incarnation's keys, and generation 0 is byte-identical to the
+    historical unprefixed layout."""
+    from distributed_tensorflow_tpu.cluster import elastic
+
+    try:
+        assert elastic.namespace("job/x") == "job/x"      # gen 0: raw
+        agent.key_value_set("job/x", "old-gen")
+        elastic.set_generation(3)
+        assert elastic.namespace("job/x") == "gen3/job/x"
+        # the old generation's value is invisible from gen 3...
+        assert agent.key_value_try_get("job/x") is None
+        agent.key_value_set("job/x", "new-gen")
+        assert agent.key_value_get("job/x", timeout_s=5) == b"new-gen"
+        assert agent.key_value_increment("job/ctr") == 1
+        agent.barrier("meet", timeout_s=5)
+        # ...and the raw store really holds both namespaces side by side
+        assert agent._local.try_get("job/x") == b"old-gen"
+        assert agent._local.try_get("gen3/job/x") == b"new-gen"
+        # deletes stay inside the generation
+        agent.key_value_delete("job/x")
+        assert agent._local.try_get("job/x") == b"old-gen"
+    finally:
+        elastic.set_generation(None)
+    assert agent.key_value_try_get("job/x") == b"old-gen"
+
+
+def test_generation_from_environment(monkeypatch):
+    from distributed_tensorflow_tpu.cluster import elastic
+
+    monkeypatch.delenv(elastic.ENV_GENERATION, raising=False)
+    assert elastic.generation() == 0
+    monkeypatch.setenv(elastic.ENV_GENERATION, "7")
+    assert elastic.generation() == 7
+    assert elastic.namespace("a/b") == "gen7/a/b"
+    monkeypatch.setenv(elastic.ENV_GENERATION, "bogus")
+    assert elastic.generation() == 0                      # defensive
+    elastic.set_generation(2)                             # explicit wins
+    try:
+        assert elastic.generation() == 2
+    finally:
+        elastic.set_generation(None)

@@ -33,6 +33,21 @@ def test_schedule_and_fetch(coord):
     assert coord.fetch(rv) == 42
 
 
+def test_worker_restarted_unbenches_quarantined_lane(coord):
+    """Supervisor-confirmed process restart returns a quarantined lane
+    to rotation immediately (the elastic un-quarantine path)."""
+    health = coord.cluster.health
+    for _ in range(health.failure_threshold):
+        health.record_failure(0)
+    assert health.is_quarantined(0)
+    coord.worker_restarted(0)
+    assert not health.is_quarantined(0)
+    assert 0 in health.healthy_workers()
+    # the lane actually takes work again
+    rv = coord.schedule(lambda: 7)
+    assert coord.fetch(rv) == 7
+
+
 def test_schedule_many_join(coord):
     results = [coord.schedule(lambda i=i: i * i) for i in range(32)]
     coord.join()

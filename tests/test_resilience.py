@@ -296,3 +296,34 @@ def test_never_quarantines_last_healthy_worker():
     for _ in range(10):
         assert not tr.record_failure(1)    # refused: 1 is the last lane
     assert tr.healthy_workers() == [1]
+
+
+def test_worker_restarted_clears_quarantine_and_streak():
+    """Supervisor-confirmed restart (new cluster generation): the lane's
+    quarantine AND consecutive-failure streak reset — the fresh process
+    must earn its way back to quarantine from zero — while lifetime
+    totals survive as history."""
+    tr, _ = _tracker(failure_threshold=2, quarantine_s=1000.0)
+    tr.register(0)
+    tr.register(1)
+    tr.record_failure(0)
+    tr.record_failure(0)                   # benched
+    assert tr.is_quarantined(0)
+    tr.record_failure(0)                   # one failure into a new streak
+
+    tr.worker_restarted(0)                 # supervisor restarted lane 0
+    assert not tr.is_quarantined(0)
+    assert tr.healthy_workers() == [0, 1]
+    snap = tr.snapshot()[0]
+    assert snap["consecutive_failures"] == 0
+    assert snap["total_failures"] == 3     # history kept
+    assert snap["quarantine_count"] == 1
+    # needs the full threshold of FRESH failures to re-quarantine
+    assert not tr.record_failure(0)
+    assert tr.record_failure(0)
+
+
+def test_worker_restarted_unknown_worker_is_safe():
+    tr, _ = _tracker()
+    tr.worker_restarted(99)                # never seen: registers clean
+    assert tr.is_healthy(99)

@@ -7,14 +7,24 @@ from it, and probabilistic rules draw from per-site streams seeded by
 it — see resilience/faults.py). A seed "survives" when the whole suite
 passes; the survival rate is the headline robustness number.
 
+``--kill`` sweeps the OTHER failure axis — whole-process death: each
+seed runs an elastic 2-worker MNIST job under the recovery supervisor
+(examples/train_mnist.py --elastic) with a seed-derived worker SIGKILL
+schedule (resilience/supervisor.seeded_kill_plan). A seed survives only
+when the job completes AND ``obs_report.py --check --require
+recovery.restart --require recovery.run_complete`` confirms the
+telemetry recorded an actual recovery — a swept run that "passes"
+without ever recovering is a failure of the harness, not a success.
+
 Usage::
 
     python tools/chaos_sweep.py --seeds 10            # seeds 0..9
     python tools/chaos_sweep.py --seeds 5 --base-seed 100 --slow
     python tools/chaos_sweep.py --seeds 3 -- -k preemption
+    python tools/chaos_sweep.py --kill --seeds 3      # SIGKILL sweep
 
-Everything after ``--`` is forwarded to pytest. Exit code is non-zero
-if any seed fails (CI-friendly).
+Everything after ``--`` is forwarded to pytest (fault-schedule mode
+only). Exit code is non-zero if any seed fails (CI-friendly).
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -47,6 +58,50 @@ def run_seed(seed: int, include_slow: bool, extra: list[str]) -> tuple[bool, flo
     return ok, dt
 
 
+def run_kill_seed(seed: int, *, workers: int, steps: int,
+                  save_every: int, budget: int,
+                  keep_dirs: bool) -> tuple[bool, float]:
+    """One supervised elastic run with a seed-derived SIGKILL schedule;
+    survival requires BOTH a clean exit and telemetry proof (via
+    ``obs_report --check --require``) that a recovery actually ran."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_kill_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, os.path.join(REPO, "examples", "train_mnist.py"),
+           "--elastic", "--workers", str(workers), "--steps", str(steps),
+           "--save-every", str(save_every), "--kill-seed", str(seed),
+           "--restart-budget", str(budget),
+           "--ckpt-dir", os.path.join(run_dir, "ckpt"),
+           "--telemetry-dir", run_dir]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if ok:
+        gate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
+             run_dir, "--check", "--require", "recovery.restart",
+             "--require", "recovery.run_complete"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: run finished but telemetry gate "
+                  f"FAILED (rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
+    else:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -55,13 +110,33 @@ def main(argv=None) -> int:
                     help="first seed (default 0)")
     ap.add_argument("--slow", action="store_true",
                     help="include slow (multi-process) chaos tests")
+    ap.add_argument("--kill", action="store_true",
+                    help="sweep seed-driven worker SIGKILLs through the "
+                         "recovery supervisor instead of fault schedules")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="--kill: workers per supervised run")
+    ap.add_argument("--steps", type=int, default=20,
+                    help="--kill: training steps per run")
+    ap.add_argument("--save-every", type=int, default=5,
+                    help="--kill: checkpoint interval")
+    ap.add_argument("--restart-budget", type=int, default=3,
+                    help="--kill: supervisor restart budget")
+    ap.add_argument("--keep-dirs", action="store_true",
+                    help="--kill: keep telemetry dirs of passing seeds")
     ap.add_argument("pytest_args", nargs="*",
                     help="extra args forwarded to pytest (after --)")
     args = ap.parse_args(argv)
 
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        ok, dt = run_seed(s, args.slow, args.pytest_args)
+        if args.kill:
+            ok, dt = run_kill_seed(s, workers=args.workers,
+                                   steps=args.steps,
+                                   save_every=args.save_every,
+                                   budget=args.restart_budget,
+                                   keep_dirs=args.keep_dirs)
+        else:
+            ok, dt = run_seed(s, args.slow, args.pytest_args)
         results.append((s, ok, dt))
         print(f"seed {s:>4}: {'PASS' if ok else 'FAIL'}  ({dt:.1f}s)",
               flush=True)

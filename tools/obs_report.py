@@ -16,11 +16,18 @@ and, when a FleetAggregator ran, TensorBoard event files with the
 The report answers the operator questions the event schema was designed
 for: step-time p50/p95/p99, infeed-wait fraction of step time, dispatch
 retries/failures by worker, chaos fault firings by site, checkpoint
-save/restore durations, and any ``stall.suspected`` events.
+save/restore durations, any ``stall.suspected`` events, and — for
+supervised elastic runs — the ``recovery.*`` timeline (worker deaths,
+straggler kills, restarts, generation starts) written by the recovery
+supervisor into ``events-supervisor.jsonl``.
 
 ``--check`` is the CI gate: exit 0 when every event file parses (a torn
 FINAL line — a crashed writer — is tolerated and reported), non-zero on
-malformed or mid-file-corrupt JSONL.
+malformed or mid-file-corrupt JSONL. ``--require NAME`` (repeatable)
+additionally fails the check unless at least one event named ``NAME``
+(or under the ``NAME.`` namespace) appears anywhere in the run — e.g.
+``--check --require recovery.restart`` is how ``chaos_sweep --kill``
+asserts that a swept run actually recorded a recovery.
 """
 
 from __future__ import annotations
@@ -86,9 +93,12 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     faults_by_site = collections.Counter()
     ckpt = collections.defaultdict(list)
     stalls: list[dict] = []
+    recovery: list[dict] = []
     per_pid: dict[int, dict] = {}
 
-    for pid, events in sorted(events_by_pid.items()):
+    # the supervisor writes under pid "supervisor": sort keys as strings
+    for pid, events in sorted(events_by_pid.items(), key=lambda kv:
+                              str(kv[0])):
         pid_steps: list[float] = []
         pid_wait = 0.0
         for ev in events:
@@ -119,6 +129,8 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 stalls.append({k: ev.get(k) for k in
                                ("pid", "stalled_s", "median_step_s",
                                 "suspect_worker", "suspect_reason")})
+            elif isinstance(name, str) and name.startswith("recovery."):
+                recovery.append(ev)
         steps.extend(pid_steps)
         infeed_wait += pid_wait
         per_pid[pid] = {"events": len(events),
@@ -126,6 +138,7 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                         "step_time": _percentiles(pid_steps),
                         "infeed_wait_s": round(pid_wait, 6)}
 
+    recovery.sort(key=lambda ev: ev.get("wall", 0.0))
     return {
         "processes": per_pid,
         "step_time": _percentiles(steps),
@@ -137,6 +150,18 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
         "checkpoint_durations": {
             k: _percentiles(v) for k, v in sorted(ckpt.items())},
         "stalls_suspected": stalls,
+        "recovery_timeline": recovery,
+        "recovery": {
+            "restarts": sum(1 for ev in recovery
+                            if ev.get("ev") == "recovery.restart"),
+            "worker_deaths": sum(1 for ev in recovery
+                                 if ev.get("ev") ==
+                                 "recovery.worker_death"),
+            "completed": any(ev.get("ev") == "recovery.run_complete"
+                             for ev in recovery),
+            "failed": any(ev.get("ev") == "recovery.failed"
+                          for ev in recovery),
+        } if recovery else None,
     }
 
 
@@ -164,6 +189,34 @@ def _fmt_ms(seconds) -> str:
     return f"{seconds * 1e3:.2f}ms" if seconds is not None else "-"
 
 
+def _fmt_recovery_line(ev: dict) -> str:
+    name = ev.get("ev", "?")
+    t = ev.get("t")
+    head = f"  t+{t:8.3f}s " if isinstance(t, (int, float)) else "  "
+    gen = ev.get("generation")
+    tail = [name] + ([f"gen{gen}"] if gen is not None else [])
+    if name == "recovery.worker_death":
+        tail.append(f"{ev.get('task_type')}:{ev.get('task_id')} "
+                    f"{ev.get('kind')} exit={ev.get('exitcode')}")
+    elif name == "recovery.chaos_kill":
+        tail.append(f"worker {ev.get('worker')} at step "
+                    f"{ev.get('at_step')}")
+    elif name == "recovery.kill_straggler":
+        tail.append(f"{ev.get('task_type')}:{ev.get('task_id')}")
+    elif name == "recovery.restart":
+        tail.append(f"restart #{ev.get('restart')} "
+                    f"(budget left {ev.get('budget_left')}, "
+                    f"backoff {ev.get('backoff_s')}s)")
+    elif name == "recovery.recover":
+        tail.append(f"recovered in {_fmt_ms(ev.get('dur_s'))}")
+    elif name == "recovery.run_complete":
+        tail.append(f"restarts={ev.get('restarts')}")
+    elif name == "recovery.failed":
+        tail.append(f"restarts={ev.get('restarts')} "
+                    f"failures={ev.get('failures')}")
+    return head + " ".join(str(p) for p in tail)
+
+
 def render_text(report: dict, rollup: dict) -> str:
     out = []
     st = report["step_time"]
@@ -177,7 +230,8 @@ def render_text(report: dict, rollup: dict) -> str:
     if report["infeed_wait_fraction"] is not None:
         out.append(f"infeed wait {report['infeed_wait_fraction']:.1%} "
                    f"of step time")
-    for pid, info in sorted(report["processes"].items()):
+    for pid, info in sorted(report["processes"].items(),
+                            key=lambda kv: str(kv[0])):
         p = info["step_time"]
         out.append(f"  [p{pid}] {info['events']} events, "
                    f"{info['steps']} steps"
@@ -203,6 +257,16 @@ def render_text(report: dict, rollup: dict) -> str:
                    f"(median {s.get('median_step_s')}s) — suspect "
                    f"worker {s.get('suspect_worker')}: "
                    f"{s.get('suspect_reason')}")
+    if report.get("recovery_timeline"):
+        rec = report["recovery"]
+        status = ("job completed" if rec["completed"]
+                  else "RECOVERY FAILED (budget exhausted)"
+                  if rec["failed"] else "in progress")
+        out.append(f"recovery: {rec['worker_deaths']} worker death(s), "
+                   f"{rec['restarts']} restart(s) — {status}")
+        out.append("recovery timeline:")
+        for ev in report["recovery_timeline"]:
+            out.append(_fmt_recovery_line(ev))
     if rollup:
         out.append("fleet rollup (latest TensorBoard scalars):")
         for tag, v in rollup.items():
@@ -210,15 +274,17 @@ def render_text(report: dict, rollup: dict) -> str:
     return "\n".join(out)
 
 
-def check(target: str) -> int:
+def check(target: str, require: "list[str] | None" = None) -> int:
     """Validate every event file; 0 = ok (torn tails reported but
-    tolerated), 1 = corrupt/malformed, 2 = nothing to check."""
+    tolerated), 1 = corrupt/malformed or a ``require``d event is absent
+    from the whole run, 2 = nothing to check."""
     files = _event_files(target)
     if not files:
         print(f"obs_report --check: no events-*.jsonl under {target}",
               file=sys.stderr)
         return 2
     rc = 0
+    seen_names: set = set()
     for path in files:
         try:
             events = read_events(path, tolerate_torn_tail=True)
@@ -226,9 +292,17 @@ def check(target: str) -> int:
             print(f"CORRUPT  {path}: {e}", file=sys.stderr)
             rc = 1
             continue
+        seen_names.update(ev.get("ev") for ev in events
+                          if isinstance(ev.get("ev"), str))
         torn = _torn_tail(path)
         note = "  (torn tail line tolerated)" if torn else ""
         print(f"ok       {path}: {len(events)} events{note}")
+    for req in require or []:
+        if not any(n == req or n.startswith(req + ".")
+                   for n in seen_names):
+            print(f"MISSING  required event {req!r} never recorded "
+                  f"in {target}", file=sys.stderr)
+            rc = 1
     return rc
 
 
@@ -241,10 +315,16 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="validate event logs; non-zero exit on "
                          "malformed/torn-mid-file JSONL")
+    ap.add_argument("--require", action="append", metavar="EVENT",
+                    help="with --check: fail unless an event with this "
+                         "name (or namespace prefix) was recorded, e.g. "
+                         "--require recovery.restart")
     args = ap.parse_args(argv)
 
     if args.check:
-        return check(args.target)
+        return check(args.target, require=args.require)
+    if args.require:
+        ap.error("--require only applies with --check")
 
     files = _event_files(args.target)
     if not files:
@@ -254,8 +334,11 @@ def main(argv=None) -> int:
     events_by_pid = {}
     import re
     for path in files:
-        m = re.search(r"events-(\d+)\.jsonl$", path)
-        pid = int(m.group(1)) if m else len(events_by_pid)
+        # numeric suffixes are cluster process ids; the recovery
+        # supervisor writes under "supervisor"
+        m = re.search(r"events-([A-Za-z0-9_]+)\.jsonl$", path)
+        suffix = m.group(1) if m else str(len(events_by_pid))
+        pid = int(suffix) if suffix.isdigit() else suffix
         try:
             events_by_pid[pid] = read_events(path)
         except EventLogCorruptError as e:
