@@ -403,6 +403,177 @@ def run_input_pipeline():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _time_steps(step, state, batch, *, iters: int, reps: int):
+    """Steady-state per-step seconds for a wrapped (state, batch) step:
+    warm the compile, then min-of-reps over ``iters``-step host loops
+    (block_until_ready bounds each rep). The scaling rows compare
+    RATIOS across device counts measured the same way, so constant
+    dispatch overhead cancels."""
+    state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+        jax.block_until_ready(m["loss"])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def run_scaling(out_path: str | None = None, max_devices: int | None = None):
+    """Scaling-curve bench (ISSUE 6): tokens/s and images/s vs device
+    count {1,2,4,8} with an efficiency column, persisted as
+    SCALING_r06.json. Weak scaling: per-device batch fixed, global batch
+    grows with the device count — the 8->256-chip measurement shape of
+    BASELINE.json.
+
+    Efficiency basis: on real accelerators (one chip per device) the
+    ideal is linear — efficiency = T(n) / (n * T(1)). Under
+    ``--xla_force_host_platform_device_count`` every "device" time-shares
+    the SAME host cores, so linear wall-clock scaling is physically
+    impossible and the hardware-adjusted ideal is constant aggregate
+    throughput — efficiency = T(n) / T(1). That quotient isolates
+    exactly what this bench exists to measure on this container: the
+    overhead the scaling stack adds (collectives, SPMD partitioning,
+    infeed splitting) as the device count grows. The 256-chip
+    extrapolation caveats are in README "Scaling".
+
+    Each row is also emitted as a ``scaling.row`` telemetry event when
+    telemetry is configured (DTX_TELEMETRY_DIR) — tools/scaling_sweep.py
+    gates on them.
+    """
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.cluster.topology import make_mesh
+    from distributed_tensorflow_tpu.models import resnet
+    from distributed_tensorflow_tpu.models.transformer import (
+        make_sharded_train_step)
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    devices = jax.devices()
+    limit = min(len(devices), max_devices or len(devices))
+    counts = [c for c in (1, 2, 4, 8) if c <= limit]
+    shared_host = not on_tpu
+
+    if on_tpu:
+        t_cfg = TransformerConfig.transformer_big(max_seq_len=1024,
+                                                  scan_layers=False)
+        t_batch_per_dev, iters, reps = 8, 8, 3
+        r_cfg = resnet.ResNetConfig.resnet50()
+        r_batch_per_dev, image_size = 128, 224
+    else:
+        # Sized so per-device compute dominates collective overhead on
+        # the shared-host CPU mesh (a too-tiny model benches psum
+        # latency, not the scaling stack).
+        t_cfg = TransformerConfig.tiny(d_model=128, n_layers=2, d_ff=256,
+                                       vocab_size=1024, max_seq_len=128)
+        t_batch_per_dev, iters, reps = 4, 3, 2
+        r_cfg = resnet.ResNetConfig.tiny()
+        r_batch_per_dev, image_size = 8, 32
+
+    rows = []
+
+    def finish(workload_rows):
+        base = workload_rows[0]["throughput"]
+        for r in workload_rows:
+            ideal = base if shared_host else base * r["devices"]
+            r["efficiency_pct"] = round(100.0 * r["throughput"] / ideal, 1)
+            telemetry.event("scaling.row", **{
+                k: v for k, v in r.items() if not isinstance(v, dict)})
+            print(json.dumps(r))
+        rows.extend(workload_rows)
+
+    # -- transformer: tokens/s, bucketed-overlap path (the >1-device
+    # default of make_sharded_train_step) --------------------------------
+    t_rows = []
+    for n in counts:
+        mesh = make_mesh({"dp": n}, devices=devices[:n])
+        gb = t_batch_per_dev * n
+        state, step = make_sharded_train_step(t_cfg, mesh, global_batch=gb)
+        batch = {"tokens": synthetic_tokens(gb, t_cfg.max_seq_len,
+                                            t_cfg.vocab_size)}
+        dt = _time_steps(step, state, batch, iters=iters, reps=reps)
+        t_rows.append({
+            "workload": "transformer", "metric": "tokens_per_sec",
+            "devices": n, "global_batch": gb,
+            "throughput": round(gb * t_cfg.max_seq_len / dt, 1),
+            "step_time_ms": round(dt * 1e3, 2),
+            "grad_sync": "bucketed" if n > 1 else "single-device"})
+    finish(t_rows)
+
+    # -- resnet: images/s (GSPMD data-parallel, BASELINE.json workload) --
+    r_rows = []
+    for n in counts:
+        mesh = make_mesh({"dp": n}, devices=devices[:n])
+        gb = r_batch_per_dev * n
+        state, step = resnet.make_sharded_train_step(
+            r_cfg, mesh, global_batch=gb, image_size=image_size)
+        data = resnet.synthetic_images(gb, image_size,
+                                       r_cfg.num_classes)
+        batch = {"image": jnp.asarray(data["image"]),
+                 "label": jnp.asarray(data["label"])}
+        dt = _time_steps(step, state, batch, iters=iters, reps=reps)
+        r_rows.append({
+            "workload": "resnet50" if on_tpu else "resnet-tiny",
+            "metric": "images_per_sec",
+            "devices": n, "global_batch": gb,
+            "throughput": round(gb / dt, 1),
+            "step_time_ms": round(dt * 1e3, 2),
+            "grad_sync": "gspmd"})
+    finish(r_rows)
+
+    # -- pipeline schedules: GPipe vs 1F1B at pp=4 (bubble fractions) ----
+    if limit >= 4:
+        from distributed_tensorflow_tpu.models.transformer import (
+            make_pipelined_train_step)
+        from distributed_tensorflow_tpu.parallel.pipeline import (
+            bubble_fraction)
+        n_micro, gb = 8, 8
+        p_cfg = (t_cfg if on_tpu                 # 12 layers / pp=4
+                 else TransformerConfig.tiny(n_layers=4))
+        p_rows = []
+        for sched in ("gpipe", "1f1b"):
+            mesh = make_mesh({"pp": 4}, devices=devices[:4])
+            state, step = make_pipelined_train_step(
+                p_cfg, mesh, gb, num_microbatches=n_micro, schedule=sched)
+            batch = {"tokens": synthetic_tokens(gb, p_cfg.max_seq_len,
+                                                p_cfg.vocab_size)}
+            dt = _time_steps(step, state, batch, iters=max(2, iters - 1),
+                             reps=reps)
+            p_rows.append({
+                "workload": "transformer-pp", "metric": "tokens_per_sec",
+                "devices": 4, "global_batch": gb, "schedule": sched,
+                "bubble_fraction": round(bubble_fraction(4, n_micro,
+                                                         sched), 4),
+                "throughput": round(gb * p_cfg.max_seq_len / dt, 1),
+                "step_time_ms": round(dt * 1e3, 2)})
+        base = p_rows[0]["throughput"]
+        for r in p_rows:
+            r["vs_gpipe"] = round(r["throughput"] / base, 3)
+            telemetry.event("scaling.row", **r)
+            print(json.dumps(r))
+        rows.extend(p_rows)
+
+    result = {
+        "bench": "scaling",
+        "backend": backend,
+        "host_cpus": os.cpu_count(),
+        "device_counts": counts,
+        "efficiency_basis": (
+            "shared-host-compute: virtual devices time-share the host "
+            "cores, ideal = constant aggregate throughput (T_n/T_1)"
+            if shared_host else
+            "per-chip-linear: ideal = n * single-chip throughput"),
+        "rows": rows,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+    return result
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -514,13 +685,24 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
-                                 "input_pipeline"],
+                                 "input_pipeline", "scaling"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
                              "run one row")
+    parser.add_argument("--scaling", action="store_true",
+                        help="run the device-count scaling curve "
+                             "(tokens/s and images/s vs {1,2,4,8} "
+                             "devices + pipeline-schedule rows)")
+    parser.add_argument("--out", default=None,
+                        help="with --scaling: also write the full JSON "
+                             "curve (e.g. SCALING_r06.json)")
+    parser.add_argument("--max-devices", type=int, default=None,
+                        help="with --scaling: cap the device sweep")
     args = parser.parse_args()
-    if args.workload == "resnet50":
+    if args.scaling or args.workload == "scaling":
+        run_scaling(out_path=args.out, max_devices=args.max_devices)
+    elif args.workload == "resnet50":
         run_resnet50()
     elif args.workload == "bert":
         run_bert()

@@ -555,14 +555,12 @@ def _find_adam_state(opt_state):
         f"(ScaleByAdamState not found in {type(opt_state)})")
 
 
-def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
-                    param_specs=None):
-    """Functional (state, batch) -> (state, metrics) SPMD step. With MoE
-    the per-layer load-balancing aux losses (flax "losses" collection)
-    are summed into the objective (≙ Switch Transformer training).
-
-    ``param_specs`` (a pytree of PartitionSpecs matching params) lets
-    the fused optimizer run per-shard on sharded meshes."""
+def make_loss_fn(cfg: TransformerConfig, model: TransformerLM):
+    """loss_fn(params, tokens) -> scalar for ``cfg``/``model`` — the
+    objective shared by the GSPMD step, the bucketed data-parallel step,
+    and the pipeline schedules. With MoE the per-layer load-balancing aux
+    losses (flax "losses" collection) are summed in (≙ Switch
+    Transformer training)."""
 
     if cfg.loss_impl not in ("scan", "kernel"):
         raise ValueError(f"loss_impl={cfg.loss_impl!r}; expected "
@@ -624,6 +622,17 @@ def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
             return objective(out, params, tokens) + aux
         out = model.apply({"params": params}, tokens, fused)
         return objective(out, params, tokens)
+
+    return loss_fn
+
+
+def make_train_step(cfg: TransformerConfig, model: TransformerLM, tx,
+                    param_specs=None):
+    """Functional (state, batch) -> (state, metrics) SPMD step built on
+    :func:`make_loss_fn`. ``param_specs`` (a pytree of PartitionSpecs
+    matching params) lets the fused optimizer run per-shard on sharded
+    meshes."""
+    loss_fn = make_loss_fn(cfg, model)
 
     # The fused update needs per-shard execution on sharded meshes; with
     # no param_specs on a >1 mesh the pallas call would run replicated
@@ -735,7 +744,7 @@ def state_shardings_for(model, tx, mesh: Mesh, example_tokens,
 
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
                             global_batch: int, seed: int = 0,
-                            step_factory=None):
+                            step_factory=None, grad_sync: str = "auto"):
     """Initialize sharded state and return (state, jitted step_fn).
 
     The returned step consumes batches of shape (global_batch, seq);
@@ -744,11 +753,36 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     over the mesh — the TPU-native replacement for the reference's
     CrossDeviceOps.batch_reduce (cross_device_ops.py:871).
 
+    ``grad_sync`` selects the gradient-reduction schedule:
+
+    - ``"bucketed"`` — explicit shard_map step with reverse-layer-order
+      bucketed gradient allreduce (collectives.GradientBucketer): each
+      bucket's psum launches as soon as backprop has produced its
+      gradients, overlapping ICI/DCN reduction with the remaining
+      backward pass. Pure data-parallel meshes only (axes ⊆ {dcn, dp}).
+      On a hybrid dcn×dp mesh each bucket takes the hierarchical path,
+      the DCN hop overlapping the next bucket's ICI phases.
+    - ``"gspmd"`` — one compiler-scheduled sync (the pre-ISSUE-6 path).
+    - ``"auto"`` (default) — "bucketed" on >1-device pure-dp meshes
+      (no MoE, default step), "gspmd" otherwise.
+
     ``step_factory(cfg, model, tx)`` lets variants (BERT MLM) swap the
     per-step loss while reusing all sharding/jit wiring.
     """
     from distributed_tensorflow_tpu.cluster.topology import \
         data_axes as mesh_data_axes
+    pure_dp = (set(mesh.shape) <= {"dcn", "dp"} and mesh.size > 1
+               and cfg.moe_experts == 0 and step_factory is None)
+    if grad_sync not in ("auto", "bucketed", "gspmd"):
+        raise ValueError(f"grad_sync={grad_sync!r}; expected auto/"
+                         f"bucketed/gspmd")
+    if grad_sync == "bucketed" and not pure_dp:
+        raise ValueError(
+            "grad_sync='bucketed' needs a pure data-parallel mesh "
+            f"(axes ⊆ {{dcn, dp}}, >1 device, no MoE); got "
+            f"{dict(mesh.shape)}")
+    if pure_dp and grad_sync in ("auto", "bucketed"):
+        return _make_bucketed_dp_train_step(cfg, mesh, global_batch, seed)
     if cfg.mesh is None:
         cfg = dataclasses.replace(cfg, mesh=mesh)
     model = TransformerLM(cfg)
@@ -792,12 +826,102 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     return state, wrapped_step
 
 
+def _make_bucketed_dp_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                 global_batch: int, seed: int = 0):
+    """Pure data-parallel train step with explicit comm/compute overlap:
+    the whole step runs under shard_map, per-device grads are reduced by
+    collectives.GradientBucketer in reverse layer order (last-layer
+    buckets launch while earlier layers still differentiate), and the
+    replicated optimizer applies locally. Parameters are replicated on a
+    pure-dp mesh, so state/step signatures match the GSPMD path
+    (state replicated, batch sharded over dcn×dp)."""
+    from distributed_tensorflow_tpu.cluster.topology import \
+        data_axes as mesh_data_axes
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        GradientBucketer, ReduceOp)
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        all_reduce as collectives_all_reduce)
+
+    data_axes = mesh_data_axes(mesh)
+    n_shards = 1
+    for a in data_axes:
+        n_shards *= mesh.shape[a]
+    if global_batch % n_shards:
+        raise ValueError(f"global_batch={global_batch} not divisible by "
+                         f"{n_shards} data shards of {dict(mesh.shape)}")
+    # inside shard_map everything is per-shard: plain local kernels, no
+    # nested sharding machinery (same convention as the pipeline path)
+    cfg_local = dataclasses.replace(cfg, mesh=None)
+    model = TransformerLM(cfg_local)
+    tx = make_optimizer(cfg)
+    loss_fn = make_loss_fn(cfg_local, model)
+
+    outer = inner = None
+    if len(data_axes) == 2 and all(mesh.shape[a] > 1 for a in data_axes):
+        outer, inner = data_axes           # ("dcn", "dp") hybrid
+    bucketer = GradientBucketer(data_axes, outer_axis=outer,
+                                inner_axis=inner)
+
+    rng = jax.random.PRNGKey(seed)
+    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
+    replicated = NamedSharding(mesh, P())
+
+    def init_fn(rng):
+        params = model.init(rng, tokens_shape)["params"]
+        return {"params": params, "opt_state": tx.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    state_shardings = jax.tree_util.tree_map(
+        lambda _: replicated, jax.eval_shape(init_fn, rng))
+    state = jax.jit(init_fn, out_shardings=state_shardings)(rng)
+
+    def spmd_step(state, batch):
+        # local mean loss; the global objective is the mean over shards,
+        # so grads sync as a bucketed MEAN allreduce
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"],
+                                                  batch["tokens"])
+        grads = bucketer.all_reduce(grads, op=ReduceOp.MEAN)
+        loss = collectives_all_reduce(loss, data_axes, ReduceOp.MEAN)
+        updates, opt_state = tx.update(grads, state["opt_state"],
+                                       state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    batch_spec = {"tokens": P(data_axes)}
+    state_spec = jax.tree_util.tree_map(lambda _: P(), state)
+    shard_step = jax.shard_map(
+        spmd_step, mesh=mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False)
+    batch_shardings = {"tokens": NamedSharding(mesh, P(data_axes))}
+    step_jit = jax.jit(
+        shard_step,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=safe_donate_argnums((0,)))
+
+    def wrapped_step(state, batch):
+        with mesh:
+            return step_jit(state, batch)
+
+    return state, wrapped_step
+
+
 def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
                               global_batch: int, num_microbatches: int,
-                              seed: int = 0):
-    """GPipe pipeline parallelism for the flagship transformer over a
-    dp×pp mesh (parallel/pipeline.py; the reference has NO pipeline
-    parallelism — SURVEY.md §2.8 row PP).
+                              seed: int = 0, schedule: str = "gpipe"):
+    """Pipeline parallelism for the flagship transformer over a dp×pp
+    mesh (parallel/pipeline.py; the reference has NO pipeline
+    parallelism — SURVEY.md §2.8 row PP). ``schedule`` picks "gpipe"
+    (forward pipeline + autodiff reverse; bubble (S-1)/(M+S-1),
+    activation memory O(M)) or "1f1b" (interleaved
+    one-forward-one-backward with per-stage rematerialization; bubble
+    2(S-1)/(M+2(S-1)) in the lockstep realization, activation memory
+    O(S) — see parallel/pipeline.py). Both schedules compute the same
+    objective; 1F1B is loss-parity-tested against GPipe.
 
     - The scan-over-layers parameter stack (L, ...) regroups to
       (pp, L/pp, ...) with the stage axis sharded over "pp": each device
@@ -812,8 +936,11 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
     Returns (state, step_fn) like make_sharded_train_step.
     """
     from distributed_tensorflow_tpu.parallel.pipeline import (
-        make_pipelined_fn)
+        make_1f1b_fn, make_pipelined_fn)
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule={schedule!r}; expected 'gpipe' or "
+                         f"'1f1b'")
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     if cfg.moe_experts > 0:
@@ -829,6 +956,14 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
         raise ValueError(f"global_batch={global_batch} not divisible by "
                          f"num_microbatches={num_microbatches}")
     mb = global_batch // num_microbatches
+    n_dp = mesh.shape.get("dp", 1)
+    if schedule == "1f1b" and mb % n_dp:
+        # the 1F1B schedule runs the microbatch dim through shard_map,
+        # which needs exact divisibility (GPipe's GSPMD constraint pads)
+        raise ValueError(
+            f"schedule='1f1b' needs the microbatch size "
+            f"(global_batch/num_microbatches = {mb}) divisible by "
+            f"dp={n_dp}; raise global_batch or lower num_microbatches")
     per_stage = cfg.n_layers // n_stages
     # inside the shard_map region blocks run per-shard: no nested
     # sharding machinery, direct attention kernel
@@ -877,28 +1012,65 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
         out, _ = jax.lax.scan(body, x, stage_params)
         return out
 
-    pipelined = make_pipelined_fn(
-        mesh, stage_fn, param_spec=P("pp"),
-        data_spec=P(None, "dp") if "dp" in mesh.shape else P())
-
+    mb_spec = P(None, "dp" if "dp" in mesh.shape else None)
     norm = RMSNorm(cfg.dtype)
 
-    def loss_fn(params, tokens):
-        embed = params["embed"].astype(cfg.dtype)
-        x = embed[tokens]                           # (B, S, D)
-        x = x.reshape(num_microbatches, mb, *x.shape[1:])
-        x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(None, "dp" if "dp" in mesh.shape
-                                     else None)))
-        out = pipelined(params["layers"], x)
-        x = out.reshape(global_batch, *out.shape[2:])
-        x = norm.apply({"params": params["final_norm"]}, x)
-        logits = jnp.einsum("bsd,vd->bsv", x, embed).astype(jnp.float32)
-        return next_token_loss(logits, tokens)
+    if schedule == "1f1b":
+        def head_fn(head_params, y_mb, tokens_mb):
+            """Per-microbatch loss head on the last stage's output:
+            final norm + tied-embedding logits + shifted CE."""
+            x = norm.apply({"params": head_params["final_norm"]}, y_mb)
+            embed = head_params["embed"].astype(cfg.dtype)
+            logits = jnp.einsum("bsd,vd->bsv", x,
+                                embed).astype(jnp.float32)
+            return next_token_loss(logits, tokens_mb)
+
+        pipelined_1f1b = make_1f1b_fn(mesh, stage_fn, head_fn,
+                                      param_spec=P("pp"),
+                                      data_spec=mb_spec)
+
+        def value_and_grads(params, tokens):
+            def embed_lookup(embed):
+                x = embed.astype(cfg.dtype)[tokens]     # (B, S, D)
+                x = x.reshape(num_microbatches, mb, *x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, mb_spec))
+            x_mb, embed_vjp = jax.vjp(embed_lookup, params["embed"])
+            t_mb = jax.lax.with_sharding_constraint(
+                tokens.reshape(num_microbatches, mb, tokens.shape[1]),
+                NamedSharding(mesh, mb_spec))
+            head_params = {"final_norm": params["final_norm"],
+                           "embed": params["embed"]}
+            loss, g_layers, g_head, g_x = pipelined_1f1b(
+                params["layers"], head_params, x_mb, t_mb)
+            (g_embed_in,) = embed_vjp(g_x.astype(x_mb.dtype))
+            grads = {"layers": g_layers,
+                     "final_norm": g_head["final_norm"],
+                     # embedding is tied: input-lookup + logits grads
+                     "embed": g_embed_in + g_head["embed"]}
+            return loss, grads
+    else:
+        pipelined = make_pipelined_fn(
+            mesh, stage_fn, param_spec=P("pp"), data_spec=mb_spec)
+
+        def loss_fn(params, tokens):
+            embed = params["embed"].astype(cfg.dtype)
+            x = embed[tokens]                           # (B, S, D)
+            x = x.reshape(num_microbatches, mb, *x.shape[1:])
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, mb_spec))
+            out = pipelined(params["layers"], x)
+            x = out.reshape(global_batch, *out.shape[2:])
+            x = norm.apply({"params": params["final_norm"]}, x)
+            logits = jnp.einsum("bsd,vd->bsv", x,
+                                embed).astype(jnp.float32)
+            return next_token_loss(logits, tokens)
+
+        def value_and_grads(params, tokens):
+            return jax.value_and_grad(loss_fn)(params, tokens)
 
     def train_step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"],
-                                                  batch["tokens"])
+        loss, grads = value_and_grads(state["params"], batch["tokens"])
         updates, opt_state = tx.update(grads, state["opt_state"],
                                        state["params"])
         new_params = optax.apply_updates(state["params"], updates)

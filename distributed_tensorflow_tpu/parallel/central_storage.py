@@ -41,6 +41,12 @@ class CentralStorageStrategy(Strategy):
     def parameter_device(self):
         return self._parameter_device
 
+    def gradient_bucketer(self):
+        # Variables live on the parameter device, not replicated on the
+        # mesh — gradient aggregation happens on write-back through
+        # AggregatingVariable, not as an in-program collective.
+        return None
+
     def create_variable(self, value, *, name=None, trainable=True,
                         synchronization=VariableSynchronization.AUTO,
                         aggregation=VariableAggregation.NONE, dtype=None):

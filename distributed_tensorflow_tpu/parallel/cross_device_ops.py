@@ -139,49 +139,42 @@ class IciAllReduce(CrossDeviceOps):
         dtypes = [jnp.asarray(vals[0]).dtype for vals in lists]
 
         outs: list = [None] * n
-        # Tensors keep their own dtype: pack per dtype group, then per
-        # size bucket; each bucket is one collective launch.
-        for dt in dict.fromkeys(dtypes):  # stable unique order
-            idxs = [i for i in range(n) if dtypes[i] == dt]
-            buckets = self._pack_buckets(
-                [sizes[i] for i in idxs], options.bytes_per_pack,
-                jnp.dtype(dt).itemsize)
-            for bucket in buckets:
-                members = [idxs[j] for j in bucket]
-                flat_per_replica = [
-                    jnp.concatenate([jnp.ravel(jnp.asarray(lists[i][r]))
-                                     for i in members])
-                    for r in range(self.num_replicas)]
-                stacked = jnp.stack(flat_per_replica)  # (R, bucket_total)
-                integer_mean = (op is ReduceOp.MEAN
-                                and not jnp.issubdtype(dt, jnp.inexact))
-                if integer_mean:
-                    stacked = stacked.astype(jnp.float32)
-                reduced = self._compiled_allreduce(op)(stacked)
-                if integer_mean:
-                    reduced = reduced.astype(dt)
-                off = 0
-                for i in members:
-                    outs[i] = jnp.reshape(reduced[off: off + sizes[i]],
-                                          shapes[i])
-                    off += sizes[i]
+        # Tensors keep their own dtype — _pack_buckets never mixes dtypes
+        # in a bucket (concatenating bf16+f32 would silently upcast);
+        # each bucket is one collective launch.
+        for bucket in self._pack_buckets(sizes, options.bytes_per_pack,
+                                         dtypes):
+            dt = dtypes[bucket[0]]
+            flat_per_replica = [
+                jnp.concatenate([jnp.ravel(jnp.asarray(lists[i][r]))
+                                 for i in bucket])
+                for r in range(self.num_replicas)]
+            stacked = jnp.stack(flat_per_replica)  # (R, bucket_total)
+            integer_mean = (op is ReduceOp.MEAN
+                            and not jnp.issubdtype(dt, jnp.inexact))
+            if integer_mean:
+                stacked = stacked.astype(jnp.float32)
+            reduced = self._compiled_allreduce(op)(stacked)
+            if integer_mean:
+                reduced = reduced.astype(dt)
+            off = 0
+            for i in bucket:
+                outs[i] = jnp.reshape(reduced[off: off + sizes[i]],
+                                      shapes[i])
+                off += sizes[i]
         return outs
 
     @staticmethod
-    def _pack_buckets(sizes, bytes_per_pack, itemsize):
-        """≙ cross_device_utils.group_by_size (cross_device_utils.py:679)."""
-        if not bytes_per_pack:
-            return [list(range(len(sizes)))]
-        buckets, cur, cur_bytes = [], [], 0
-        for i, s in enumerate(sizes):
-            cur.append(i)
-            cur_bytes += s * itemsize
-            if cur_bytes >= bytes_per_pack:
-                buckets.append(cur)
-                cur, cur_bytes = [], 0
-        if cur:
-            buckets.append(cur)
-        return buckets
+    def _pack_buckets(sizes, bytes_per_pack, dtypes):
+        """≙ cross_device_utils.group_by_size (cross_device_utils.py:679),
+        dtype-aware: a dtype change always closes the current bucket (no
+        silent upcast from concatenating mixed-dtype leaves), and a leaf
+        landing exactly on ``bytes_per_pack`` closes its bucket with the
+        leaf included. ``dtypes`` may be one dtype (applied to all) or a
+        per-leaf sequence."""
+        if not isinstance(dtypes, (list, tuple)):
+            dtypes = [dtypes] * len(sizes)
+        return collectives.plan_buckets(sizes, dtypes, bytes_per_pack)
 
     def _compiled_allreduce(self, op: ReduceOp):
         # cached per-instance (an lru_cache on the method would pin self,

@@ -7,6 +7,13 @@ with a merge_call rendezvous (mirrored_run.py:289). Here the strategy is a
 thin configuration over the shared SPMD core: a 1-axis mesh over the local
 devices, variables replicated (mirrored = replicated NamedSharding), and
 ``run`` compiling a single program whose gradient sync is an ICI psum.
+
+Gradient sync under ``Model.fit`` uses the strategy's
+:meth:`Strategy.gradient_bucketer` by default on >1 device:
+reverse-layer-order bucketed allreduce (≙ the reference NcclAllReduce's
+pack-by-size, cross_device_utils.py:436) so late-layer buckets reduce
+while early layers are still differentiating. Tune the bucket size via
+``communication_options.bytes_per_pack`` (0 = the 4 MiB default).
 """
 
 from __future__ import annotations

@@ -44,7 +44,13 @@ class CollectiveAllReduceStrategy(Strategy):
         if mesh is None:
             mesh = topo_lib.make_mesh(
                 {topo_lib.DATA_AXIS: len(jax.devices())})
-        super().__init__(mesh=mesh, data_axis_names=(topo_lib.DATA_AXIS,),
+        # A hybrid (multi-slice) mesh reduces over dcn×dp so the
+        # gradient bucketer takes the hierarchical path: per-bucket
+        # reduce-scatter on ICI, the small cross-slice hop on DCN
+        # overlapping the next bucket's ICI phases (≙ the reference's
+        # CollectiveAllReduce with hierarchical copy on multi-NIC hosts).
+        data_axes = topo_lib.data_axes(mesh) or (topo_lib.DATA_AXIS,)
+        super().__init__(mesh=mesh, data_axis_names=data_axes,
                          communication_options=communication_options)
 
     @property

@@ -63,6 +63,11 @@ class ParameterServerStrategy(Strategy):
     def cluster_resolver(self) -> ClusterResolver | None:
         return self._cluster_resolver
 
+    def gradient_bucketer(self):
+        # PS training is asynchronous: gradients apply to sharded
+        # variables through the coordinator, never via a sync allreduce.
+        return None
+
     def create_variable(self, value, *, name=None, trainable=True,
                         synchronization=VariableSynchronization.AUTO,
                         aggregation=VariableAggregation.NONE, dtype=None):

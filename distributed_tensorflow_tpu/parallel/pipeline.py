@@ -1,4 +1,4 @@
-"""Pipeline parallelism over the "pp" mesh axis (GPipe schedule).
+"""Pipeline parallelism over the "pp" mesh axis (GPipe and 1F1B).
 
 The reference has NO pipeline parallelism (SURVEY.md §2.8: "nothing in
 TF/python/distribute/; delegated to GPipe/Mesh-TF out-of-tree"). The
@@ -9,10 +9,25 @@ TPU-native framework provides it as a first-class schedule:
 - Microbatches flow stage-to-stage via ``jax.lax.ppermute`` over ICI,
   the canonical neighbor-exchange on a TPU torus.
 - The whole schedule is a ``lax.scan`` over ticks inside ``shard_map``,
-  so XLA sees one compiled loop body; autodiff through ppermute/scan
-  gives the backward pipeline (reverse schedule) for free.
+  so XLA sees one compiled loop body.
 
-Bubble fraction is (n_stages-1)/(n_micro+n_stages-1) — standard GPipe.
+Two schedules (pick via :func:`bubble_fraction` / the transformer's
+``make_pipelined_train_step(schedule=...)``):
+
+- **GPipe** (:func:`pipeline_apply`): forward pipeline under autodiff;
+  the reverse schedule falls out of differentiating ppermute/scan.
+  Bubble fraction (S-1)/(M+S-1); activation memory O(M) — autodiff
+  stashes every microbatch's residuals until the backward phase.
+- **1F1B** (:func:`pipeline_1f1b_value_and_grad`): PipeDream-flush
+  one-forward-one-backward — the backward of microbatch m starts the
+  cycle its forward reaches the last stage and interleaves with the
+  remaining forwards, so at most min(M, 2S-1) microbatch inputs are
+  stashed (activations rematerialized per stage on the backward).
+  In this lockstep SPMD realization the schedule spans M+2(S-1)
+  fwd+bwd cycles — bubble fraction 2(S-1)/(M+2(S-1)) — trading GPipe's
+  O(M) activation memory for O(S); on asynchronous hardware the same
+  order realizes the classic (S-1)/(M+S-1) bubble with t_f-granular
+  warmup.
 """
 
 from __future__ import annotations
@@ -75,6 +90,185 @@ def pipeline_apply(stage_fn: Callable, params_local, x_microbatches,
     # Broadcast the last stage's outputs to every device.
     outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
     return jax.lax.psum(outputs, axis_name)
+
+
+def bubble_fraction(n_stages: int, n_micro: int,
+                    schedule: str = "gpipe") -> float:
+    """Idle fraction of the pipeline schedule (docstring formulas)."""
+    s, m = int(n_stages), int(n_micro)
+    if schedule == "gpipe":
+        return (s - 1) / (m + s - 1)
+    if schedule == "1f1b":
+        return 2 * (s - 1) / (m + 2 * (s - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def pipeline_1f1b_value_and_grad(stage_fn: Callable, head_fn: Callable,
+                                 params_local, head_params,
+                                 x_microbatches, targets_microbatches,
+                                 *, axis_name: str = "pp",
+                                 batch_axes: tuple = ()):
+    """1F1B (PipeDream-flush) schedule: loss and grads in ONE interleaved
+    forward/backward pipeline sweep. Must run inside a shard_map region
+    binding ``axis_name``.
+
+    stage_fn(params, x) -> y: one stage (same shape in/out).
+    head_fn(head_params, y, target) -> scalar: per-microbatch loss on the
+        LAST stage's output (executed masked on other stages — SPMD).
+    params_local: this device's stage parameters (pp axis sliced away).
+    head_params: replicated head/loss parameters.
+    x_microbatches / targets_microbatches: (n_micro, mb, ...) replicated
+        over pp (shard other axes outside).
+    batch_axes: data-parallel axes also bound in this region; loss and
+        parameter grads are additionally pmean'd over them (global-mean
+        objective) and input grads scaled to match.
+
+    Schedule (cycle c, stage s of S, microbatch count M): forward of
+    microbatch f = c - s, then backward of b = c - (2S-2-s); the
+    backward of each microbatch starts the cycle its forward reaches the
+    last stage. Stage inputs are stashed in a min(M, 2S-1)-slot ring and
+    rematerialized via ``jax.vjp`` on the backward — O(S) activation
+    memory vs GPipe's O(M). Bubble fraction 2(S-1)/(M+2(S-1)) in this
+    lockstep realization (see module docstring).
+
+    Returns ``(loss, stage_param_grads_local, head_param_grads,
+    x_microbatch_grads)`` — loss is the mean over microbatches (and
+    ``batch_axes``), stage grads stay per-device (pp-sharded), head and
+    input grads are valid on every device.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+    S = n_stages
+    K = max(1, min(M, 2 * S - 1))
+    C = M + 2 * (S - 1)
+
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    x_dtype = x_microbatches.dtype
+    is_last = stage == S - 1
+
+    def cycle(carry, c):
+        fwd_in, bwd_in, stash, gparams, ghead, gx, loss_sum = carry
+
+        # -- forward sub-tick: microbatch f = c - stage ------------------
+        f = c - stage
+        active_f = (f >= 0) & (f < M)
+        inject = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(f, 0, M - 1), axis=0, keepdims=False)
+        fwd_in = jnp.where(stage == 0, inject, fwd_in)
+        slot_f = jnp.where(active_f, jnp.mod(f, K), 0)
+        stash = jnp.where(
+            active_f,
+            jax.lax.dynamic_update_index_in_dim(
+                stash, fwd_in.astype(stash.dtype), slot_f, axis=0),
+            stash)
+        out = stage_fn(params_local, fwd_in)
+        next_fwd_in = jax.lax.ppermute(out, axis_name, perm_fwd)
+
+        # -- backward sub-tick: microbatch b = c - (2S-2-stage) ----------
+        b = c - (2 * S - 2 - stage)
+        active_b = (b >= 0) & (b < M)
+        slot_b = jnp.where(active_b, jnp.mod(b, K), 0)
+        binp = jax.lax.dynamic_index_in_dim(stash, slot_b, axis=0,
+                                            keepdims=False).astype(x_dtype)
+        out_b, stage_vjp = jax.vjp(stage_fn, params_local, binp)
+        tgt = jax.lax.dynamic_index_in_dim(
+            targets_microbatches, jnp.clip(b, 0, M - 1), axis=0,
+            keepdims=False)
+        loss_b, head_vjp = jax.vjp(
+            lambda hp, y: head_fn(hp, y, tgt), head_params, out_b)
+        dhead, dy = head_vjp(jnp.asarray(1.0 / M, loss_b.dtype))
+        g_out = jnp.where(is_last, dy, bwd_in)
+        g_out = jnp.where(active_b, g_out, jnp.zeros_like(g_out))
+        dparams, dx = stage_vjp(g_out)
+        gparams = jax.tree_util.tree_map(jnp.add, gparams, dparams)
+        take_head = is_last & active_b
+        ghead = jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(take_head, d, 0), ghead, dhead)
+        loss_sum = loss_sum + jnp.where(
+            take_head, loss_b.astype(jnp.float32), 0.0)
+        take_x = (stage == 0) & active_b
+        gx = jnp.where(
+            take_x,
+            jax.lax.dynamic_update_index_in_dim(
+                gx, dx.astype(gx.dtype), jnp.clip(b, 0, M - 1), axis=0),
+            gx)
+        next_bwd_in = jax.lax.ppermute(dx, axis_name, perm_bwd)
+
+        return (next_fwd_in, next_bwd_in, stash, gparams, ghead, gx,
+                loss_sum), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, x_dtype),                        # fwd_in
+        jnp.zeros(mb_shape, x_dtype),                        # bwd_in
+        jnp.zeros((K,) + mb_shape, x_dtype),                 # stash
+        jax.tree_util.tree_map(jnp.zeros_like, params_local),
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)),
+            head_params),
+        jnp.zeros((M,) + mb_shape, x_dtype),                 # gx
+        jnp.zeros((), jnp.float32),                          # loss_sum
+    )
+    (_, _, _, gparams, ghead, gx, loss_sum), _ = jax.lax.scan(
+        cycle, carry0, jnp.arange(C))
+
+    # loss/head grads live on the last stage, input grads on stage 0:
+    # psum broadcasts each to every pp rank (single contributors).
+    loss = jax.lax.psum(loss_sum, axis_name) / M
+    ghead = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), ghead)
+    gx = jax.lax.psum(gx, axis_name)
+    if batch_axes:
+        loss = jax.lax.pmean(loss, batch_axes)
+        gparams = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, batch_axes), gparams)
+        ghead = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, batch_axes), ghead)
+        n_batch = 1
+        for a in batch_axes:
+            n_batch *= jax.lax.psum(1, a)
+        gx = gx / n_batch
+    return loss, gparams, ghead, gx
+
+
+def make_1f1b_fn(mesh: Mesh, stage_fn: Callable, head_fn: Callable, *,
+                 axis_name: str = "pp",
+                 param_spec: P | None = None,
+                 data_spec: P | None = None):
+    """shard_map wrapper for :func:`pipeline_1f1b_value_and_grad`:
+    ``(stacked_params, head_params, x_microbatches, targets) ->
+    (loss, stacked_param_grads, head_grads, x_grads)``. Same stacking
+    conventions as :func:`make_pipelined_fn`."""
+    if param_spec is None:
+        param_spec = P(axis_name)
+    if data_spec is None:
+        data_spec = P()
+    batch_axes = tuple(
+        a for a in jax.tree_util.tree_leaves(
+            tuple(data_spec), is_leaf=lambda x: isinstance(x, str))
+        if isinstance(a, str) and a in mesh.shape)
+
+    def run(stacked_params, head_params, x_mb, targets_mb):
+        def inner(params_local, head_params, x_local, t_local):
+            params_local = jax.tree_util.tree_map(
+                lambda p: jnp.squeeze(p, axis=0), params_local)
+            loss, gp, gh, gx = pipeline_1f1b_value_and_grad(
+                stage_fn, head_fn, params_local, head_params,
+                x_local, t_local, axis_name=axis_name,
+                batch_axes=batch_axes)
+            gp = jax.tree_util.tree_map(
+                lambda g: jnp.expand_dims(g, axis=0), gp)
+            return loss, gp, gh, gx
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(param_spec, P(), data_spec, data_spec),
+            out_specs=(P(), param_spec, P(), data_spec),
+            check_vma=False)(stacked_params, head_params, x_mb, targets_mb)
+
+    return run
 
 
 def make_pipelined_fn(mesh: Mesh, stage_fn: Callable, *,

@@ -318,6 +318,8 @@ class Strategy:
             a for a in data_axis_names if a in mesh.shape)
         if not self.data_axis_names:
             self.data_axis_names = tuple(mesh.axis_names[:1])
+        self.communication_options = (communication_options
+                                      or CommunicationOptions())
         self.cross_device_ops = cross_device_ops or select_cross_device_ops(
             mesh, self.data_axis_names, communication_options)
         self.extended = StrategyExtended(self)
@@ -657,6 +659,31 @@ class Strategy:
                 lambda spec: NamedSharding(self.mesh, spec), sharding_rules,
                 is_leaf=lambda s: isinstance(s, P))
         return jax.jit(init_fn, out_shardings=out_shardings)(*args, **kwargs)
+
+    def gradient_bucketer(self):
+        """Reverse-order bucketed gradient collectives for this strategy's
+        data axes (≙ the reference's NcclAllReduce gradient packing,
+        cross_device_utils.py:436-449) — ON by default whenever the
+        strategy spans more than one replica. Pack size comes from
+        ``CommunicationOptions.bytes_per_pack`` (0 -> the
+        ``DEFAULT_BYTES_PER_PACK`` fusion-buffer default). On a hybrid
+        dcn×dp reduction the bucketer takes the hierarchical path so the
+        cross-slice DCN hop of each bucket overlaps the ICI phases of the
+        next. Returns None when there is nothing to reduce (single
+        replica); subclasses whose variables live off-mesh (central
+        storage, parameter server) also return None.
+        """
+        if self.num_replicas_in_sync <= 1:
+            return None
+        axes = self.data_axis_names
+        bpp = (self.communication_options.bytes_per_pack
+               or collectives.DEFAULT_BYTES_PER_PACK)
+        outer = inner = None
+        if (len(axes) == 2 and axes[0] == topo_lib.DCN_AXIS
+                and all(self.mesh.shape[a] > 1 for a in axes)):
+            outer, inner = axes
+        return collectives.GradientBucketer(
+            axes, bytes_per_pack=bpp, outer_axis=outer, inner_axis=inner)
 
     def compile_step(self, step_fn: Callable, donate_state: bool = True):
         """Compile ``step_fn(state, batch) -> (state, aux)`` into the SPMD

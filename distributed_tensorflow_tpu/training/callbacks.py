@@ -129,7 +129,13 @@ class ReduceLROnPlateau(Callback):
     """≙ tf_keras ReduceLROnPlateau: multiply the (mutable) learning
     rate by ``factor`` after ``patience`` epochs without monitored
     improvement; stop at ``min_lr``; ``cooldown`` epochs pause the
-    patience counter after each reduction."""
+    patience counter after each reduction.
+
+    Requires a FLOAT learning rate: compiling with a ``schedules.*``
+    callable makes ``model.learning_rate`` schedule-driven
+    (inject_hyperparams re-evaluates it every update), so the reduction
+    would be silently clobbered — the learning_rate setter raises on
+    that combination instead (≙ tf_keras, which also fails loudly)."""
 
     def __init__(self, monitor="val_loss", factor=0.1, patience=10,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0,
@@ -221,12 +227,20 @@ class CSVLogger(Callback):
 
 
 class TerminateOnNaN(Callback):
-    """≙ tf_keras TerminateOnNaN: stop training on a NaN/inf running
-    loss. Checks every ``check_every`` batches (default 10) instead of
-    every batch: the epoch loss metric is a running mean, so one NaN
-    batch poisons it permanently and a sparse check still catches it
-    within ``check_every`` steps — without forcing the per-batch
-    host-device metric sync that defeats async dispatch."""
+    """≙ tf_keras TerminateOnNaN — WITH ONE DELIBERATE DEVIATION:
+    the loss is checked every ``check_every`` batches (default 10), not
+    every batch like tf_keras. The epoch loss metric is a running mean,
+    so one NaN batch poisons it permanently and a sparse check still
+    catches it within ``check_every`` steps — without forcing the
+    per-batch host-device metric sync that defeats async dispatch.
+
+    The cost of the sparse default: up to ``check_every - 1`` additional
+    optimizer steps run on NaN parameters before training stops, so
+    params (and any checkpoint taken in that window) may be poisoned.
+    Pass ``check_every=1`` for tf_keras-exact behavior when debugging
+    divergence or checkpointing every batch; see README "Training
+    callbacks" for the trade-off.
+    """
 
     def __init__(self, check_every: int = 10):
         super().__init__()

@@ -40,8 +40,12 @@ _ACTIVATIONS = {
     "silu": nn.silu,
     "swish": nn.silu,
     "elu": nn.elu,
-    # keras's leaky_relu activation slope is 0.2 (nn.leaky_relu
-    # defaults to 0.01)
+    # keras's leaky_relu ACTIVATION slope is 0.2 (Keras 3 activations
+    # default; flax nn.leaky_relu defaults to 0.01). NOTE the known
+    # discrepancy vs the LeakyReLU LAYER, whose tf_keras default alpha
+    # is 0.3: activation="leaky_relu" and layers.LeakyReLU() give
+    # different slopes, exactly as the two defaults differ upstream —
+    # pass the slope explicitly when switching between the forms.
     "leaky_relu": lambda x: nn.leaky_relu(x, negative_slope=0.2),
     "softplus": nn.softplus,
     "exponential": jnp.exp,

@@ -142,7 +142,14 @@ class _ConfusionMetric(Metric):
         if sample_weight is None:
             w = jnp.ones((pred.shape[0], 1), jnp.float32)
         else:
-            w = sample_weight.astype(jnp.float32).reshape(-1, 1)
+            w = jnp.asarray(sample_weight, jnp.float32)
+            if w.size == pred.size:
+                # keras also accepts ELEMENT-wise weights matching
+                # y_true's shape — broadcast against the flattened
+                # prediction shape, not a forced (-1, 1)
+                w = w.reshape(pred.shape)
+            else:
+                w = w.reshape(-1, 1)            # strictly per-sample
         tp = jnp.sum(pred * true * w)
         denom = jnp.sum(self._denom_mask(true, pred) * w)
         return {"true_pos": state["true_pos"] + tp,

@@ -139,6 +139,12 @@ class Model:
         any optimizer when ``learning_rate`` is given) are wrapped in
         ``optax.inject_hyperparams`` so LearningRateScheduler works."""
         self._ensure_strategy()
+        # Schedule-driven optimizers (callable learning_rate): optax
+        # re-evaluates the schedule every update, so host-side writes to
+        # model.learning_rate (ReduceLROnPlateau, LearningRateScheduler)
+        # would be silently clobbered — the setter raises in that
+        # combination (tf_keras fails loudly there too).
+        self._lr_schedule_driven = callable(learning_rate)
         if isinstance(optimizer, str):
             key = optimizer.lower()
             if key not in _OPTIMIZERS:
@@ -178,6 +184,13 @@ class Model:
 
     @learning_rate.setter
     def learning_rate(self, value: float):
+        if getattr(self, "_lr_schedule_driven", False):
+            raise AttributeError(
+                "learning_rate was compiled as a schedule; "
+                "inject_hyperparams re-evaluates it every update, so "
+                "writes (ReduceLROnPlateau, LearningRateScheduler) "
+                "would be silently clobbered — compile with a float "
+                "learning rate to drive it from callbacks")
         opt = self._state["opt_state"]
         hp = getattr(opt, "hyperparams", None)
         if hp is None or "learning_rate" not in hp:
@@ -202,6 +215,19 @@ class Model:
     def _make_train_function(self):
         if self._train_fn is not None:
             return self._train_fn
+        # Bucketed-overlap gradient sync (ISSUE 6): on >1 replica the
+        # strategy supplies a GradientBucketer and the step computes
+        # per-replica gradients under shard_map, reducing them as
+        # reverse-layer-order buckets so late-layer collectives overlap
+        # the remaining backward pass. Models with mutable collections
+        # (BN batch_stats) keep the GSPMD path: its global-batch
+        # statistics semantics must not change under the default.
+        if not self._state.get("model_state"):
+            get_bucketer = getattr(self.strategy, "gradient_bucketer", None)
+            bucketer = get_bucketer() if callable(get_bucketer) else None
+            if bucketer is not None:
+                self._train_fn = self._make_bucketed_train_function(bucketer)
+                return self._train_fn
         module, loss_obj = self.module, self._loss
         metrics, loss_metric = self._metrics, self._loss_metric
         tx = self._tx
@@ -254,6 +280,78 @@ class Model:
 
         self._train_fn = self.strategy.compile_step(step)
         return self._train_fn
+
+    def _make_bucketed_train_function(self, bucketer):
+        """Explicit-SPMD train step: per-replica grads + reverse-order
+        bucketed allreduce (collectives.GradientBucketer) + replicated
+        optimizer apply, all inside one shard_map region. Numerically the
+        same objective as the GSPMD path (global sample-weighted mean);
+        only the reduction schedule changes — each bucket's collective
+        launches as soon as backprop has produced its (late-layer)
+        gradients instead of one compiler-chosen sync point."""
+        module, loss_obj = self.module, self._loss
+        metrics, loss_metric = self._metrics, self._loss_metric
+        tx = self._tx
+        strategy = self.strategy
+        mesh = strategy.mesh
+        axes = strategy.data_axis_names
+        base_rng = jax.random.PRNGKey(self.seed ^ 0x5eed)
+        from jax.sharding import PartitionSpec as P
+        from distributed_tensorflow_tpu.parallel import collectives as coll
+
+        def local_apply(params, opt_state, step_idx, x, y, sw):
+            # per-(step, replica) stochastic-layer rng: replicas draw
+            # DIFFERENT dropout masks for their distinct data shards
+            rngs = {"dropout": jax.random.fold_in(
+                jax.random.fold_in(base_rng, step_idx),
+                coll.combined_axis_index(axes))}
+
+            def local_objective(p):
+                preds, mutated = module.apply(
+                    {"params": p}, x, mutable=["reg_losses"], rngs=rngs)
+                reg = sum(jax.tree_util.tree_leaves(
+                    dict(mutated).get("reg_losses", {})),
+                    jnp.zeros((), jnp.float32))
+                per = loss_obj.call(y, preds).astype(jnp.float32) + reg
+                w = sw.astype(jnp.float32)
+                return jnp.sum(per * w), (preds, per)
+
+            (num, (preds, per)), grads = jax.value_and_grad(
+                local_objective, has_aux=True)(params)
+            den = jnp.maximum(
+                coll.all_reduce(jnp.sum(sw.astype(jnp.float32)), axes),
+                1e-9)
+            # global loss = psum(local weighted sums) / psum(weights);
+            # its gradient is psum(local grads) / psum(weights) — the
+            # psum is the bucketed, reverse-scheduled reduction.
+            grads = bucketer.all_reduce(grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: (g / den).astype(g.dtype), grads)
+            loss = coll.all_reduce(num, axes) / den
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, preds, per
+
+        spmd = jax.shard_map(
+            local_apply, mesh=mesh,
+            in_specs=(P(), P(), P(), P(axes), P(axes), P(axes)),
+            out_specs=(P(), P(), P(), P(axes), P(axes)),
+            check_vma=False)
+
+        def step(state, mstate, batch, full):
+            x, y, sw = batch
+            params, opt_state, loss, preds, per = spmd(
+                state["params"], state["opt_state"], state["step"],
+                x, y, sw)
+            new_state = {"params": params, "opt_state": opt_state,
+                         "step": state["step"] + 1, "model_state": {}}
+            m2 = dict(mstate)
+            m2["loss"] = loss_metric.update_values(mstate["loss"], per, sw)
+            for m in metrics:
+                m2[m.name] = m.update(mstate[m.name], y, preds, sw)
+            return new_state, m2
+
+        return strategy.compile_step(step)
 
     def _make_eval_function(self):
         if self._eval_fn is not None:

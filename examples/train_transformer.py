@@ -55,7 +55,17 @@ def main():
     ap.add_argument("--tiny", action="store_true",
                     help="CI-sized model (default on CPU)")
     ap.add_argument("--microbatches", type=int, default=2,
-                    help="GPipe microbatches when the mesh has pp")
+                    help="pipeline microbatches when the mesh has pp")
+    ap.add_argument("--schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="pipeline schedule (1f1b = interleaved "
+                         "one-forward-one-backward, O(stages) "
+                         "activation memory)")
+    ap.add_argument("--grad-sync", default="auto",
+                    choices=["auto", "bucketed", "gspmd"],
+                    help="gradient sync on non-pp meshes (auto = "
+                         "reverse-order bucketed collectives on >1 "
+                         "device pure-dp meshes)")
     ap.add_argument("--moe-experts", type=int, default=0)
     ap.add_argument("--sp-impl", default="ring",
                     choices=["ring", "ulysses", "striped"])
@@ -81,10 +91,12 @@ def main():
     if mesh.shape.get("pp", 1) > 1:
         state, step = make_pipelined_train_step(
             cfg, mesh, args.global_batch,
-            num_microbatches=args.microbatches)
+            num_microbatches=args.microbatches,
+            schedule=args.schedule)
     else:
         state, step = make_sharded_train_step(cfg, mesh,
-                                              args.global_batch)
+                                              args.global_batch,
+                                              grad_sync=args.grad_sync)
 
     tokens = synthetic_tokens(args.global_batch, cfg.max_seq_len,
                               cfg.vocab_size)

@@ -146,3 +146,185 @@ def test_collective_keys():
     assert keys.get_instance_key(g1) == 2
     with pytest.raises(ValueError):
         keys.get_instance_key(999)
+
+
+# ---------------------------------------------------------------------------
+# Reverse-order bucketed gradient collectives (ISSUE 6 tentpole)
+# ---------------------------------------------------------------------------
+
+def _grad_tree(dtype=jnp.float32):
+    """Layer-ordered pytree with mixed shapes incl. a scalar leaf."""
+    rng = np.random.default_rng(0)
+    return {
+        "layer0": {"w": jnp.asarray(rng.normal(size=(16, 8)), dtype),
+                   "b": jnp.asarray(rng.normal(size=8), dtype)},
+        "layer1": {"w": jnp.asarray(rng.normal(size=(8, 4)), dtype)},
+        "scale": jnp.asarray(rng.normal(), dtype),
+    }
+
+
+def test_plan_buckets_boundaries_and_reverse():
+    from distributed_tensorflow_tpu.parallel.collectives import plan_buckets
+    f32 = jnp.float32
+    # bytes_per_pack=0: everything (one dtype run) in one bucket
+    assert plan_buckets([4, 4, 4], [f32] * 3, 0) == [[0, 1, 2]]
+    # boundary at EXACTLY bytes_per_pack: the leaf that lands on the
+    # boundary closes its bucket (included), the next starts fresh
+    assert plan_buckets([2, 2, 2], [f32] * 3, 16) == [[0, 1], [2]]
+    assert plan_buckets([4, 4, 4], [f32] * 3, 16) == [[0], [1], [2]]
+    # reverse layer order: last leaves first (ready-first in backprop)
+    assert plan_buckets([2, 2, 2, 2], [f32] * 4, 16,
+                        reverse=True) == [[3, 2], [1, 0]]
+
+
+def test_plan_buckets_never_mixes_dtypes():
+    """bf16+f32 grads must not share a bucket (concat would upcast)."""
+    from distributed_tensorflow_tpu.parallel.collectives import plan_buckets
+    dts = [jnp.bfloat16, jnp.bfloat16, jnp.float32, jnp.bfloat16]
+    buckets = plan_buckets([2, 2, 2, 2], dts, 0)
+    assert buckets == [[0, 1], [2], [3]]
+    for b in buckets:
+        assert len({jnp.dtype(dts[i]) for i in b}) == 1
+
+
+def test_cross_device_pack_buckets_dtype_and_boundary():
+    """Satellite: _pack_buckets respects dtype mix and exact-boundary
+    packing (≙ group_by_size, cross_device_utils.py:679)."""
+    from distributed_tensorflow_tpu.parallel.cross_device_ops import (
+        IciAllReduce)
+    f32, bf16 = jnp.float32, jnp.bfloat16
+    # exactly bytes_per_pack: 2 f32 leaves of 2 = 16 bytes
+    assert IciAllReduce._pack_buckets([2, 2, 2], 16, f32) == [[0, 1], [2]]
+    # mixed dtypes never share a bucket
+    assert IciAllReduce._pack_buckets(
+        [2, 2, 2], 0, [bf16, f32, f32]) == [[0], [1, 2]]
+
+
+def test_ici_all_reduce_mixed_dtype_no_upcast(mesh8):
+    """Batch-reducing bf16+f32 tensors returns each in its own dtype."""
+    from distributed_tensorflow_tpu.parallel.cross_device_ops import (
+        IciAllReduce)
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        CommunicationOptions)
+    from distributed_tensorflow_tpu.parallel.values import PerReplica
+    ops = IciAllReduce(mesh8, ("dp",),
+                       CommunicationOptions(bytes_per_pack=8))
+    vals = [PerReplica([jnp.ones((4,), jnp.bfloat16)] * 8),
+            PerReplica([jnp.ones((4,), jnp.float32)] * 8)]
+    out = ops.batch_reduce("sum", vals)
+    assert out[0].values[0].dtype == jnp.bfloat16
+    assert out[1].values[0].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out[1].values[0], np.float32),
+                               np.full(4, 8.0))
+
+
+@pytest.mark.parametrize("axes_spec", ["dp", "fsdp", "hybrid"])
+def test_bucketed_all_reduce_bit_identical(axes_spec, devices):
+    """Satellite: bucketed/overlapped allreduce vs the unbucketed
+    per-leaf psum on dp, fsdp, and hybrid dcn×dp meshes. On flat meshes
+    the results are BIT-identical (packing concatenates buffers but
+    never changes any element's reduction). On the hybrid mesh the
+    bucketer takes the hierarchical scatter->DCN->gather path whose
+    8-way summation ORDER differs from the flat psum's — documented
+    tolerance 1e-6 relative (fp32 reassociation only)."""
+    from distributed_tensorflow_tpu.cluster.topology import (
+        make_hybrid_mesh, make_mesh)
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        GradientBucketer)
+    if axes_spec == "hybrid":
+        mesh = make_hybrid_mesh({"dcn": 2}, {"dp": 4})
+        axes = ("dcn", "dp")
+        bucketer = GradientBucketer(axes, bytes_per_pack=64,
+                                    outer_axis="dcn", inner_axis="dp")
+    else:
+        mesh = make_mesh({axes_spec: 8})
+        axes = (axes_spec,)
+        bucketer = GradientBucketer(axes, bytes_per_pack=64)
+    tree = _grad_tree()
+
+    def f(t):
+        # distinct per-device contributions
+        t2 = jax.tree_util.tree_map(
+            lambda x: x + coll.combined_axis_index(axes), t)
+        return (bucketer.all_reduce(t2),
+                jax.tree_util.tree_map(
+                    lambda x: coll.all_reduce(x, axes), t2))
+
+    got, ref = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))(tree)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        if axes_spec == "hybrid":
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+        else:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bucketed_all_reduce_mean_and_reverse_plan(mesh8, devices):
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        GradientBucketer, ReduceOp)
+    bucketer = GradientBucketer(("dp",), bytes_per_pack=64)
+    tree = _grad_tree()
+    leaves = jax.tree_util.tree_flatten(tree)[0]
+    plan = bucketer.plan(leaves)
+    # reverse layer order: the FIRST bucket holds the LAST leaves
+    assert plan[0][0] == len(leaves) - 1
+    assert sorted(i for b in plan for i in b) == list(range(len(leaves)))
+
+    def f(t):
+        t2 = jax.tree_util.tree_map(
+            lambda x: x + coll.axis_index("dp"), t)
+        return (bucketer.all_reduce(t2, op=ReduceOp.MEAN),
+                jax.tree_util.tree_map(
+                    lambda x: coll.all_reduce(x, "dp", ReduceOp.MEAN),
+                    t2))
+
+    got, ref = jax.jit(jax.shard_map(
+        f, mesh=mesh8, in_specs=P(), out_specs=P(), check_vma=False))(tree)
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_hierarchical_all_reduce_chunks_bit_identical(mesh2d):
+    """Async-dispatch chunking partitions the vector but must not change
+    any element's arithmetic: chunks=3 == chunks=1 bit-for-bit."""
+    x = jnp.arange(37.0) * 1.7
+
+    def run(chunks):
+        return jax.jit(jax.shard_map(
+            lambda v: coll.hierarchical_all_reduce(
+                v, inner_axis="tp", outer_axis="dp", chunks=chunks),
+            mesh=mesh2d, in_specs=P(), out_specs=P(),
+            check_vma=False))(x)
+
+    assert np.array_equal(np.asarray(run(1)), np.asarray(run(3)))
+
+
+def test_strategy_gradient_bucketer_defaults(devices):
+    """Bucketed grad sync is ON by default for >1 replica, OFF for one,
+    hierarchical on hybrid dcn×dp, and disabled for off-mesh-variable
+    strategies (central storage / PS)."""
+    from distributed_tensorflow_tpu.cluster.topology import (
+        make_hybrid_mesh)
+    from distributed_tensorflow_tpu.parallel.central_storage import (
+        CentralStorageStrategy)
+    from distributed_tensorflow_tpu.parallel.mirrored import (
+        MirroredStrategy)
+    from distributed_tensorflow_tpu.parallel.one_device import (
+        OneDeviceStrategy)
+    from distributed_tensorflow_tpu.parallel.strategy import Strategy
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        DEFAULT_BYTES_PER_PACK)
+
+    b = MirroredStrategy().gradient_bucketer()
+    assert b is not None and b.reverse
+    assert b.bytes_per_pack == DEFAULT_BYTES_PER_PACK
+    assert OneDeviceStrategy().gradient_bucketer() is None
+    assert CentralStorageStrategy().gradient_bucketer() is None
+    hybrid = Strategy(mesh=make_hybrid_mesh({"dcn": 2}, {"dp": 4}),
+                      data_axis_names=("dcn", "dp"))
+    hb = hybrid.gradient_bucketer()
+    assert hb.outer_axis == "dcn" and hb.inner_axis == "dp"

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Device-count scaling sweep + CI gate (ISSUE 6).
+
+Runs ``bench.py --scaling`` in a subprocess pinned to a virtual-device
+CPU mesh (``JAX_PLATFORMS=cpu`` +
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``), then gates:
+
+1. every row carries an ``efficiency_pct`` (or pipeline ``vs_gpipe``)
+   column and the dp transformer curve exists at {1,2,4,8} devices;
+2. efficiency-curve monotonicity sanity vs the PREVIOUS round's
+   ``SCALING_r*.json`` when one exists — no (workload, devices[,
+   schedule]) row may regress more than ``--regression-frac`` (10%
+   default) in throughput;
+3. telemetry wiring: one ``scaling.row`` event per row must land in the
+   run's event log (``DTX_TELEMETRY_DIR`` is set for the child;
+   bench.py emits through ``telemetry.event``).
+
+    python tools/scaling_sweep.py --out SCALING_r07.json
+
+Exit code 0 = all gates green. Writes the curve JSON to ``--out``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def previous_round_file(out_path: str) -> str | None:
+    rounds = sorted(glob.glob(os.path.join(REPO, "SCALING_r*.json")))
+    rounds = [p for p in rounds
+              if os.path.abspath(p) != os.path.abspath(out_path)]
+    return rounds[-1] if rounds else None
+
+
+def row_key(row: dict) -> tuple:
+    return (row.get("workload"), row.get("metric"), row.get("devices"),
+            row.get("schedule"))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "SCALING_run.json"),
+                    help="where to write the curve JSON "
+                         "(check in as SCALING_r<NN>.json)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU device count for the sweep")
+    ap.add_argument("--regression-frac", type=float, default=0.10,
+                    help="max allowed per-row throughput regression vs "
+                         "the previous round's file")
+    ap.add_argument("--keep-telemetry", action="store_true",
+                    help="print the telemetry dir instead of using a "
+                         "temp dir")
+    args = ap.parse_args()
+
+    tdir = (os.path.join(REPO, ".cache", "scaling_telemetry")
+            if args.keep_telemetry else
+            tempfile.mkdtemp(prefix="dtx_scaling_telemetry_"))
+    os.makedirs(tdir, exist_ok=True)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + f" --xla_force_host_platform_device_count="
+                     f"{args.devices}"),
+        DTX_TELEMETRY_DIR=tdir,
+    )
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--scaling",
+           "--out", args.out, "--max-devices", str(args.devices)]
+    print("scaling_sweep:", " ".join(cmd), flush=True)
+    rc = subprocess.run(cmd, env=env, check=False).returncode
+    if rc != 0:
+        print(f"scaling_sweep: FAIL — bench exited {rc}")
+        return 1
+    with open(args.out) as f:
+        result = json.load(f)
+    rows = result["rows"]
+
+    failures = []
+
+    # gate 1: curve shape
+    dp_rows = [r for r in rows if r["workload"] in ("transformer",)
+               and r.get("metric") == "tokens_per_sec"]
+    dp_counts = sorted(r["devices"] for r in dp_rows)
+    want = [c for c in (1, 2, 4, 8) if c <= args.devices]
+    if dp_counts != want:
+        failures.append(f"transformer dp curve has device counts "
+                        f"{dp_counts}, expected {want}")
+    for r in rows:
+        if "efficiency_pct" not in r and "vs_gpipe" not in r:
+            failures.append(f"row missing efficiency column: {row_key(r)}")
+
+    # gate 2: monotonicity sanity vs the previous round
+    prev_path = previous_round_file(args.out)
+    if prev_path:
+        with open(prev_path) as f:
+            prev = {row_key(r): r for r in json.load(f)["rows"]}
+        for r in rows:
+            p = prev.get(row_key(r))
+            if p is None:
+                continue
+            floor = p["throughput"] * (1.0 - args.regression_frac)
+            if r["throughput"] < floor:
+                failures.append(
+                    f"{row_key(r)}: throughput {r['throughput']} "
+                    f"regressed >{args.regression_frac:.0%} vs "
+                    f"{p['throughput']} in {os.path.basename(prev_path)}")
+        print(f"scaling_sweep: compared {len(rows)} rows against "
+              f"{os.path.basename(prev_path)}")
+    else:
+        print("scaling_sweep: no previous SCALING_r*.json — "
+              "regression gate skipped")
+
+    # gate 3: scaling.* telemetry wiring
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")   # import-safe off-TPU
+    from distributed_tensorflow_tpu import telemetry
+    ev_path = telemetry.event_log_path(tdir, 0)
+    try:
+        events = telemetry.read_events(ev_path)
+    except OSError:
+        events = []
+    scaling_events = [e for e in events if e.get("ev") == "scaling.row"]
+    if len(scaling_events) != len(rows):
+        failures.append(f"expected {len(rows)} scaling.row telemetry "
+                        f"events, found {len(scaling_events)} in "
+                        f"{ev_path}")
+
+    if failures:
+        for msg in failures:
+            print(f"scaling_sweep: FAIL — {msg}")
+        return 1
+    eff8 = next((r["efficiency_pct"] for r in dp_rows
+                 if r["devices"] == max(dp_counts)), None)
+    print(f"scaling_sweep: OK — {len(rows)} rows, "
+          f"{len(scaling_events)} telemetry events, "
+          f"{max(dp_counts)}-device transformer efficiency {eff8}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
