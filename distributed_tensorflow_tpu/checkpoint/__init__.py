@@ -9,6 +9,10 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     CheckpointCorruptError,
     CheckpointManager,
 )
+from distributed_tensorflow_tpu.checkpoint.peer_snapshot import (
+    HostSnapshot,
+    SnapshotStore,
+)
 from distributed_tensorflow_tpu.checkpoint.failure_handling import (
     EXIT_PREEMPTED,
     PreemptionCheckpointHandler,

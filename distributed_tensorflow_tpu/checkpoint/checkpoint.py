@@ -17,6 +17,16 @@ value, ShardedVariables save as slices of one logical tensor.
 
 Async saves (≙ async_checkpoint_helper.py): device->host transfer happens
 synchronously (cheap), file writes on a background thread.
+
+Tiered commits (the recovery ladder's disk half): with
+``CheckpointManager(local_dir=...)`` every save commits first to the
+node-local fast directory (tier ``local``) and then — pipelined behind
+training on the same async machinery — re-commits the identical shards
+to the durable directory (tier ``durable``). Each tier commit gets its
+own ``checkpoint.commit`` telemetry span carrying a ``tier`` field, and
+the index records its tier so ``latest_checkpoint`` can prefer the
+freshest *intact* tier. The in-memory tiers (``host``/``peer``) live in
+checkpoint/peer_snapshot.py and plug in via ``snapshot_store``.
 """
 
 from __future__ import annotations
@@ -45,6 +55,23 @@ _LATEST_FILE = "checkpoint"  # ≙ the reference's `checkpoint` state file
 class CheckpointCorruptError(RuntimeError):
     """A shard file fails its recorded checksum/size — the checkpoint is
     torn (truncated write, partial commit) and must not be restored."""
+
+
+def _fsync_dir(path: str):
+    """fsync a directory so renames inside it are durable — file
+    contents being fsynced does not make the *directory entry* crash
+    -safe; without this a host crash right after a tmp->final rename can
+    lose a checkpoint the index already calls committed."""
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return                    # platform without dir-open semantics
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass                      # e.g. network fs rejecting dir fsync
+    finally:
+        os.close(fd)
 
 
 def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
@@ -90,6 +117,11 @@ class Checkpoint:
         self._save_counter = 0
         self._async_thread: threading.Thread | None = None
         self._async_error: BaseException | None = None
+        # Paths an in-flight (possibly async) write still commits into —
+        # the manager's sweep must never delete these out from under the
+        # commit thread.
+        self._pending_lock = threading.Lock()
+        self._pending_paths: set[str] = set()
 
     @property
     def save_counter(self) -> int:
@@ -107,19 +139,34 @@ class Checkpoint:
         self.write(path, async_write=async_write)
         return path
 
-    def write(self, path: str, *, async_write: bool = False) -> str:
+    def write(self, path: str, *, async_write: bool = False,
+              tier: str = "durable", pipeline_to: str | None = None,
+              on_captured=None) -> str:
+        """Write a checkpoint directory at ``path``.
+
+        ``tier`` labels the index (recorded as ``index["tier"]``);
+        ``pipeline_to`` re-commits the same shards to a second directory
+        (tier ``durable``) after the first commit — with ``async_write``
+        both commits are pipelined behind training. ``on_captured``, if
+        given, is called as ``on_captured(host_arrays, index)`` right
+        after the device->host capture (before any file IO) — the hook
+        the in-memory snapshot tiers ride.
+        """
         # span covers the BLOCKING portion (device->host + commit when
         # sync; device->host + thread handoff when async) — the async
         # file IO reports separately via the checkpoint.commit event
         with telemetry.span("checkpoint.save", path=path,
                             async_write=async_write):
-            return self._write_impl(path, async_write=async_write)
+            return self._write_impl(path, async_write=async_write,
+                                    tier=tier, pipeline_to=pipeline_to,
+                                    on_captured=on_captured)
 
-    def _write_impl(self, path: str, *, async_write: bool) -> str:
+    def _capture(self) -> tuple[dict[str, np.ndarray], dict]:
+        """Device->host snapshot of the tracked pytree: the shard arrays
+        this process owns plus the checkpoint index. The cheap,
+        synchronous part of every save — and the whole of a host-tier
+        snapshot."""
         flat = _flatten(self._objects)
-        proc = jax.process_index()
-        tmp = f"{path}.tmp.{proc}"
-        os.makedirs(tmp, exist_ok=True)
 
         # Read each leaf ONCE (ON_READ variables reduce on read — a device
         # computation that must not run twice), then start every
@@ -145,18 +192,61 @@ class Checkpoint:
                 if offset is not None:
                     host_arrays[key + "::off"] = np.asarray([offset],
                                                             dtype=np.int64)
+        return host_arrays, index
+
+    def _write_impl(self, path: str, *, async_write: bool,
+                    tier: str = "durable", pipeline_to: str | None = None,
+                    on_captured=None) -> str:
+        proc = jax.process_index()
+        tmp = f"{path}.tmp.{proc}"
+        os.makedirs(tmp, exist_ok=True)
+        host_arrays, index = self._capture()
+        index["tier"] = tier
+        if on_captured is not None:
+            on_captured(host_arrays, index)
+
+        def mark_pending():
+            with self._pending_lock:
+                self._pending_paths.add(path)
+                if pipeline_to:
+                    self._pending_paths.add(pipeline_to)
 
         def finish():
-            # fsync BEFORE the rename into place: an OS crash after the
-            # rename must not leave a shard whose data pages never hit
-            # disk (rename is only atomic for the directory entry).
-            with telemetry.span("checkpoint.commit", path=path):
-                shard = os.path.join(tmp, f"shard_{proc}.npz")
-                with open(shard, "wb") as f:
-                    np.savez(f, **host_arrays)
-                    f.flush()
-                    os.fsync(f.fileno())
-                self._commit(tmp, path, index)
+            try:
+                # fsync BEFORE the rename into place: an OS crash after
+                # the rename must not leave a shard whose data pages
+                # never hit disk (rename is only atomic for the
+                # directory entry).
+                with telemetry.span("checkpoint.commit", path=path,
+                                    tier=tier):
+                    shard = os.path.join(tmp, f"shard_{proc}.npz")
+                    with open(shard, "wb") as f:
+                        np.savez(f, **host_arrays)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    self._commit(tmp, path, index)
+                if pipeline_to:
+                    # second-tier commit: re-commit the just-committed
+                    # local shard into the durable directory through the
+                    # same hardened protocol (fresh tmp, barriers,
+                    # index-last)
+                    with telemetry.span("checkpoint.commit",
+                                        path=pipeline_to, tier="durable"):
+                        tmp2 = f"{pipeline_to}.tmp.{proc}"
+                        os.makedirs(tmp2, exist_ok=True)
+                        shutil.copy2(os.path.join(path,
+                                                  f"shard_{proc}.npz"),
+                                     os.path.join(tmp2,
+                                                  f"shard_{proc}.npz"))
+                        index2 = dict(index)
+                        index2["tier"] = "durable"
+                        index2.pop("shards", None)
+                        self._commit(tmp2, pipeline_to, index2)
+            finally:
+                with self._pending_lock:
+                    self._pending_paths.discard(path)
+                    if pipeline_to:
+                        self._pending_paths.discard(pipeline_to)
 
         def finish_async():
             try:
@@ -166,13 +256,21 @@ class Checkpoint:
 
         if async_write:
             # device->host already done above (np arrays); file IO async
-            self._join_pending()
+            self._join_pending()         # may raise a PRIOR write's
+            mark_pending()               # error: mark only after it
             self._async_thread = threading.Thread(target=finish_async,
                                                   daemon=True)
             self._async_thread.start()
         else:
+            mark_pending()
             finish()                     # sync path: raise right here
         return path
+
+    def pending_write_paths(self) -> set[str]:
+        """Checkpoint directories an in-flight write still commits into
+        (rotation must skip these)."""
+        with self._pending_lock:
+            return set(self._pending_paths)
 
     def _commit(self, tmp: str, path: str, index: dict):
         """Multi-host commit protocol (≙ checkpoint_management's
@@ -208,6 +306,12 @@ class Checkpoint:
         for f in os.listdir(tmp):
             os.replace(os.path.join(tmp, f), os.path.join(path, f))
         os.rmdir(tmp)
+        # fsync the directories the renames mutated: the shard files'
+        # DATA is already on disk (fsynced pre-rename), but the new
+        # directory entries are not until their parent dirs are synced —
+        # the last torn-commit window a host crash could still open.
+        _fsync_dir(path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
         # Token = basename + abspath hash: two saves into different
         # directories that share a basename (e.g. every Model backup dir
         # is ".../backup") must NOT meet at the same barrier.
@@ -256,6 +360,7 @@ class Checkpoint:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp_index, os.path.join(path, _INDEX_FILE))
+            _fsync_dir(path)      # the index rename IS the commit point
         if agent.is_distributed:
             try:
                 agent.barrier(f"ckpt_index/{token}", timeout_s=600.0)
@@ -379,37 +484,78 @@ class Checkpoint:
                                             if shard_pat.match(n) else -1)):
             if shard_pat.match(f_name):
                 shards[f_name] = np.load(os.path.join(path, f_name))
+        return self._apply_shards(shards, index, source=path)
 
-        def lookup(name):
+    def _apply_shards(self, shards: Mapping[str, Any], index: dict,
+                      source: str) -> dict:
+        """Reassemble leaves from shard mappings (npz files or plain
+        dicts of arrays) and assign/return them.
+
+        Topology-elastic by construction (reshard-on-load): parts are
+        stitched in *slice* order using the recorded axis-0 offsets and
+        verified contiguous against the leaf's logical shape, so a
+        checkpoint written by N processes restores onto M — each leaf's
+        ``assign`` re-places the full tensor under the CURRENT sharding.
+        """
+        def lookup(name, want_shape=None):
             key = self._fname(name)
             parts = []
             for shard in shards.values():
-                if key in shard.files:
+                if key in shard:
                     off = (int(shard[key + "::off"][0])
-                           if key + "::off" in shard.files else 0)
+                           if key + "::off" in shard else 0)
                     parts.append((off, shard[key]))
             if not parts:
-                raise KeyError(f"Leaf {name!r} missing from checkpoint {path}")
+                raise KeyError(f"Leaf {name!r} missing from "
+                               f"checkpoint {source}")
             parts.sort(key=lambda t: t[0])   # slice order, not file order
+            if want_shape is not None and len(parts) > 1:
+                # contiguity check: a missing slice must surface as a
+                # corrupt checkpoint, not a silently mis-stitched tensor
+                pos = 0
+                for off, arr in parts:
+                    if off != pos:
+                        raise CheckpointCorruptError(
+                            f"leaf {name!r} in {source}: slice at axis-0 "
+                            f"offset {off} does not abut previous end "
+                            f"{pos} (missing shard part?)")
+                    pos += np.shape(arr)[0]
+                if pos != want_shape[0]:
+                    raise CheckpointCorruptError(
+                        f"leaf {name!r} in {source}: stitched rows {pos} "
+                        f"!= logical rows {want_shape[0]}")
             return [a for _, a in parts]
 
         flat = _flatten(self._objects)
         restored = {}
         for name, leaf in flat.items():
-            parts = lookup(name)
             if isinstance(leaf, DistributedVariable):
                 meta = index["leaves"].get(name, {})
                 if meta.get("kind") == "sharded_variable":
+                    parts = lookup(name, want_shape=meta.get("shape"))
                     full = np.concatenate(parts, axis=0) if len(parts) > 1 \
                         else parts[0]
                 else:
-                    full = parts[0]
+                    full = lookup(name)[0]
                 leaf.assign(full.reshape(leaf.shape) if full.shape !=
                             tuple(leaf.shape) else full)
                 restored[name] = leaf
             else:
-                restored[name] = parts[0]
+                restored[name] = lookup(name)[0]
         return restored
+
+    def restore_from_parts(self, parts, index: dict) -> dict:
+        """Restore from in-memory snapshot parts (the host/peer tiers):
+        ``parts`` is an iterable of objects with an ``arrays`` mapping
+        (e.g. :class:`~distributed_tensorflow_tpu.checkpoint.
+        peer_snapshot.HostSnapshot`) — one per original shard owner.
+        Same reassembly (and reshard-on-load) semantics as a disk
+        restore, no file IO."""
+        self._join_pending()
+        with telemetry.span("checkpoint.restore", path="<memory>"):
+            shards = {f"mem_{i}": p.arrays for i, p in enumerate(parts)}
+            return self._apply_shards(shards, index,
+                                      source="<memory snapshot>")
 
     def read(self, path: str) -> dict:
         return self.restore(path)
@@ -470,14 +616,34 @@ class CheckpointManager:
     ``max_to_keep`` oldest-first deletion, ``keep_checkpoint_every_n_hours``
     pinning, ``restore_or_initialize`` convenience, and step-interval
     gating via ``save(checkpoint_number, check_interval)``.
+
+    Fast-recovery tiers (all optional):
+
+    - ``local_dir`` — node-local fast scratch: saves commit here first
+      (tier ``local``) and the durable re-commit is pipelined behind
+      training; ``latest_checkpoint`` prefers the freshest intact tier.
+      Saves default to ``async_write=True`` when a local tier exists.
+    - ``snapshot_store`` — a :class:`~distributed_tensorflow_tpu.
+      checkpoint.peer_snapshot.SnapshotStore`: every save also captures
+      a host-RAM snapshot and ring-replicates it to a peer
+      (:meth:`snapshot` takes extra memory-only snapshots between disk
+      saves). :meth:`restore_latest` then restores down the ladder
+      host > peer > local > durable, emitting a
+      ``recovery.restore_tier`` telemetry event.
     """
 
     def __init__(self, checkpoint: Checkpoint, directory: str,
                  max_to_keep: int = 5,
                  keep_checkpoint_every_n_hours: float | None = None,
-                 checkpoint_name: str = "ckpt"):
+                 checkpoint_name: str = "ckpt",
+                 local_dir: str | None = None,
+                 snapshot_store=None,
+                 exchange_timeout_s: float = 30.0):
         self.checkpoint = checkpoint
         self.directory = directory
+        self.local_dir = local_dir
+        self.snapshot_store = snapshot_store
+        self._exchange_timeout_s = exchange_timeout_s
         self.max_to_keep = max_to_keep
         self.keep_every_s = (keep_checkpoint_every_n_hours * 3600
                              if keep_checkpoint_every_n_hours else None)
@@ -489,11 +655,18 @@ class CheckpointManager:
         # immediately, permanently pinning the first rotated checkpoint.
         self._last_pin_time = time.time()
         os.makedirs(directory, exist_ok=True)
+        if local_dir:
+            os.makedirs(local_dir, exist_ok=True)
         self._load_meta()
 
     @property
     def _prefix(self) -> str:
         return os.path.join(self.directory, self._name)
+
+    @property
+    def _local_prefix(self) -> str | None:
+        return (os.path.join(self.local_dir, self._name)
+                if self.local_dir else None)
 
     # Pin state persists across manager restarts (≙ the reference keeping
     # last_preserved_timestamp in the CheckpointState proto).
@@ -554,38 +727,117 @@ class CheckpointManager:
                 return False
         return True
 
-    def _list_checkpoints(self) -> list[tuple[int, str]]:
+    def _list_checkpoints(self, directory: str | None = None
+                          ) -> list[tuple[int, str]]:
+        directory = directory or self.directory
         pat = re.compile(re.escape(self._name) + r"-(\d+)$")
         out = []
-        for d in os.listdir(self.directory):
+        try:
+            entries = os.listdir(directory)
+        except OSError:
+            return []
+        for d in entries:
             m = pat.match(d)
-            full = os.path.join(self.directory, d)
+            full = os.path.join(directory, d)
             if m and os.path.isdir(full) and self._is_complete(full):
                 out.append((int(m.group(1)), full))
         return sorted(out)
 
+    def _disk_best(self) -> "tuple[int, str, str] | None":
+        """(step, path, tier) of the freshest intact disk checkpoint
+        across tiers; the warmer (local) tier wins step ties."""
+        cands = []
+        for tier, d in (("local", self.local_dir),
+                        ("durable", self.directory)):
+            if not d:
+                continue
+            cks = self._list_checkpoints(d)
+            if cks:
+                n, p = cks[-1]
+                cands.append((n, 1 if tier == "local" else 0, p, tier))
+        if not cands:
+            return None
+        n, _, p, tier = max(cands)
+        return n, p, tier
+
     @property
     def latest_checkpoint(self) -> str | None:
-        cks = self._list_checkpoints()
-        return cks[-1][1] if cks else None
+        best = self._disk_best()
+        return best[1] if best else None
 
     @property
     def checkpoints(self) -> list[str]:
         return [p for _, p in self._list_checkpoints()]
 
     def save(self, checkpoint_number: int | None = None, *,
-             async_write: bool = False) -> str:
+             async_write: bool | None = None) -> str:
+        """Tier-pipelined save. With a ``local_dir`` the commit lands in
+        the local tier first and the durable re-commit is pipelined
+        (``async_write`` defaults to True); with a ``snapshot_store``
+        the device->host capture is also retained as a host snapshot and
+        ring-replicated to a peer before any file IO."""
         if checkpoint_number is not None:
             self.checkpoint._save_counter = checkpoint_number - 1
-        path = self.checkpoint.save(self._prefix, async_write=async_write)
+        if async_write is None:
+            async_write = self.local_dir is not None
+        self.checkpoint._save_counter += 1
+        number = self.checkpoint._save_counter
+        on_captured = None
+        if self.snapshot_store is not None:
+            def on_captured(host_arrays, index):
+                self._commit_snapshot(host_arrays, dict(index), number)
+        if self.local_dir:
+            path = self.checkpoint.write(
+                f"{self._local_prefix}-{number}", async_write=async_write,
+                tier="local", pipeline_to=f"{self._prefix}-{number}",
+                on_captured=on_captured)
+        else:
+            path = self.checkpoint.write(
+                f"{self._prefix}-{number}", async_write=async_write,
+                on_captured=on_captured)
         self._sweep()
         return path
 
+    def snapshot(self, step: int):
+        """Memory-only host snapshot (+ ring replica exchange): the
+        cheap high-frequency tier between disk saves. Collective when
+        distributed — every process must snapshot the same steps."""
+        if self.snapshot_store is None:
+            raise ValueError("CheckpointManager has no snapshot_store")
+        host_arrays, index = self.checkpoint._capture()
+        return self._commit_snapshot(host_arrays, index, step)
+
+    def _commit_snapshot(self, host_arrays, index, step: int):
+        from distributed_tensorflow_tpu.checkpoint import (
+            peer_snapshot as _ps)
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        index = dict(index)
+        index["tier"] = "host"
+        with telemetry.span("checkpoint.commit", tier="host", step=step):
+            # copy: the capture aliases live host buffers for plain-np
+            # leaves; a retained snapshot must not track future updates
+            snap = _ps.HostSnapshot(
+                owner=agent.process_id, step=int(step),
+                world=agent.num_processes, index=index,
+                arrays={k: np.array(v, copy=True)
+                        for k, v in host_arrays.items()})
+            self.snapshot_store.put(snap)
+            _ps.exchange(self.snapshot_store, snap, agent,
+                         timeout_s=self._exchange_timeout_s)
+        return snap
+
     def _sweep(self):
+        # Never delete a directory an in-flight async write still
+        # commits into: the local->durable pipeline copies out of the
+        # local tier AFTER it becomes listable, so rotation racing the
+        # commit thread would tear the durable re-commit.
+        pending = self.checkpoint.pending_write_paths()
         # Pinned checkpoints are permanently out of rotation: they neither
         # count toward max_to_keep nor get deleted.
         cks = [(n, p) for n, p in self._list_checkpoints()
-               if p not in self._kept_pinned]
+               if p not in self._kept_pinned and p not in pending]
         now = time.time()
         changed = False
         while len(cks) > self.max_to_keep:
@@ -600,6 +852,14 @@ class CheckpointManager:
                 shutil.rmtree(path, ignore_errors=True)
         if changed:
             self._save_meta()
+        if self.local_dir:
+            locals_ = [(n, p)
+                       for n, p in self._list_checkpoints(self.local_dir)
+                       if p not in pending]
+            while len(locals_) > self.max_to_keep:
+                _, path = locals_.pop(0)
+                if jax.process_index() == 0:
+                    shutil.rmtree(path, ignore_errors=True)
 
     def restore_or_initialize(self) -> str | None:
         """≙ CheckpointManager.restore_or_initialize: restore latest if one
@@ -611,6 +871,102 @@ class CheckpointManager:
             if m:
                 self.checkpoint._save_counter = int(m.group(1))
         return latest
+
+    #: warmth rank of each restore tier (lower = warmer = faster)
+    _TIER_RANK = {"host": 0, "peer": 0, "memory": 0, "local": 1,
+                  "durable": 2, "none": 3}
+
+    def restore_latest(self, *, timeout_s: float = 60.0
+                       ) -> "tuple[str, int, dict] | None":
+        """Restore down the recovery ladder: own host snapshot > peer
+        replica (fetched over the coordination KV) > local disk >
+        durable disk. Collective when a ``snapshot_store`` is present
+        and the job is distributed: every process must call it exactly
+        ONCE per cluster generation (the negotiation keys are
+        generation-namespaced and write-once — legacy TSL clients
+        cannot safely re-read overwritten keys). Emits a
+        ``recovery.restore_tier``
+        telemetry event recording the chosen tier, the freshest step
+        each tier had, and ``best_available`` — the warmest tier that
+        held the freshest state (chaos_sweep gates chosen == best).
+
+        Returns ``(tier, step, flat_restored)`` or ``None`` when there
+        is nothing anywhere to restore.
+        """
+        from distributed_tensorflow_tpu.checkpoint import (
+            peer_snapshot as _ps)
+        from distributed_tensorflow_tpu.cluster import elastic
+        from distributed_tensorflow_tpu.cluster.coordination import (
+            coordination_service)
+        agent = coordination_service()
+        disk = self._disk_best()
+        decision = None
+        if self.snapshot_store is not None:
+            self.snapshot_store.load_surviving()
+            try:
+                decision = _ps.negotiate(self.snapshot_store, agent, disk,
+                                         timeout_s=timeout_s)
+            except Exception:
+                decision = None          # negotiation failed: disk path
+        tier, step, restored, old_world = None, None, None, None
+        mem_step = None
+        if decision is not None:
+            mem_step = (decision.get("step")
+                        if decision.get("source") == "memory"
+                        else decision.get("mem_step"))
+        if decision is not None and decision.get("source") == "memory":
+            try:
+                remote = _ps.any_fetched_remotely(self.snapshot_store,
+                                                  decision)
+                parts = _ps.fetch_parts(self.snapshot_store, agent,
+                                        decision, timeout_s=timeout_s)
+                index = parts[0].index
+                restored = self.checkpoint.restore_from_parts(parts, index)
+                tier = "peer" if remote else "host"
+                step = int(decision["step"])
+                old_world = int(decision.get("world", len(parts)))
+            except Exception:
+                restored = None          # memory tier failed: disk path
+        if restored is None:
+            if decision is not None and decision.get("source") == "disk":
+                step, path, tier = (int(decision["step"]),
+                                    decision["path"], decision["tier"])
+            elif disk is not None:
+                step, path, tier = disk
+            else:
+                path = None
+            if path is not None:
+                restored = self.checkpoint.restore(path)
+                old_world = len([f for f in os.listdir(path)
+                                 if re.match(r"shard_\d+\.npz$", f)])
+            else:
+                tier, step = None, None
+        available = {
+            "memory": mem_step,
+            "local": (self._list_checkpoints(self.local_dir)[-1][0]
+                      if self.local_dir
+                      and self._list_checkpoints(self.local_dir)
+                      else None),
+            "durable": (self._list_checkpoints()[-1][0]
+                        if self._list_checkpoints() else None),
+        }
+        best_step = max((s for s in available.values() if s is not None),
+                        default=None)
+        best_available = "none" if best_step is None else min(
+            (t for t, s in available.items() if s == best_step),
+            key=lambda t: self._TIER_RANK[t])
+        telemetry.event(
+            "recovery.restore_tier",
+            tier=tier or "none", step=step,
+            generation=elastic.generation(),
+            world=agent.num_processes, old_world=old_world,
+            resharded=(old_world is not None
+                       and old_world != agent.num_processes),
+            available=available, best_available=best_available)
+        if restored is None:
+            return None
+        self.checkpoint._save_counter = int(step)
+        return tier, int(step), restored
 
 
 def latest_checkpoint(directory: str, name: str = "ckpt") -> str | None:

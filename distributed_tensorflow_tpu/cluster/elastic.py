@@ -96,3 +96,24 @@ def heartbeat(step: int | None = None):
 def heartbeat_path(supervisor_dir: str, task_index: int) -> str:
     """Supervisor-side: the heartbeat file a task writes."""
     return os.path.join(supervisor_dir, f"heartbeat-{task_index}")
+
+
+def peer_memdir(task_index: int | str | None = None) -> str | None:
+    """This worker's *memdir* — the directory standing in for its
+    machine's RAM/ramdisk in the peer-snapshot tier
+    (checkpoint/peer_snapshot.py). Lives under the supervisor's scratch
+    dir keyed by task index: it survives a process restart (the
+    supervisor respawns onto the same "machine") but the supervisor
+    wipes it when the machine is considered dead. ``None`` outside a
+    supervised run."""
+    d = os.environ.get(ENV_SUPERVISOR_DIR)
+    if not d:
+        return None
+    if task_index is None:
+        task_index = os.environ.get("DTX_MPR_TASK_INDEX", "0")
+    return peer_memdir_path(d, task_index)
+
+
+def peer_memdir_path(supervisor_dir: str, task_index: int | str) -> str:
+    """Supervisor-side: the memdir of the machine behind a task slot."""
+    return os.path.join(supervisor_dir, "peermem", f"worker-{task_index}")

@@ -30,4 +30,5 @@ from distributed_tensorflow_tpu.resilience.supervisor import (
     RecoverySupervisor,
     WorkerFailure,
     seeded_kill_plan,
+    seeded_shrink_plan,
 )

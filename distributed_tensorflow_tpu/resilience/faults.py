@@ -36,6 +36,10 @@ Instrumented sites:
                           ``raise`` here models a decode/IO failure inside
                           the host input pipeline; it must surface on the
                           consumer, never hang the queue
+``peer.exchange``         peer_snapshot.exchange (tag=process id) — a
+                          ``raise`` models losing the ring-replica
+                          transfer at a snapshot boundary; training and
+                          the disk tiers must be unaffected
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

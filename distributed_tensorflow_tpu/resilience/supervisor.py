@@ -24,12 +24,25 @@ The recovery protocol, per failure:
    coordination-service ports) with ``DTX_CLUSTER_GENERATION`` bumped,
    so the new incarnation's KV keys and barriers live in a fresh
    namespace (cluster/elastic.py).
-4. **Resume.** Restarted workers restore from the latest *intact*
-   checkpoint (torn checkpoints are already skipped by
-   ``CheckpointManager.latest_checkpoint``) and re-enter their step
+4. **Resume.** Restarted workers restore down the recovery ladder —
+   own host snapshot > peer replica (checkpoint/peer_snapshot.py) >
+   local disk > durable disk (``CheckpointManager.restore_latest``;
+   torn checkpoints are already skipped) — and re-enter their step
    loop. Restart pacing follows a :class:`RetryPolicy` backoff; the
    restart budget is bounded, and exhaustion raises
-   :class:`RecoveryFailedError` carrying the full failure history.
+   :class:`RecoveryFailedError` carrying the (bounded) failure history.
+5. **Shrink** (optional, ``shrink_after``): when the SAME task slot has
+   failed that many consecutive restarts, the machine is treated as
+   gone for good — the cluster reforms at N-1 workers
+   (``recovery.reshard`` event) and the topology-elastic restore
+   stitches the N-worker checkpoint onto the smaller cluster instead
+   of burning the remaining budget re-spawning into the hole.
+
+The supervisor also owns each worker machine's *memdir* (the stand-in
+for node RAM holding host/peer snapshots, ``cluster.elastic.
+peer_memdir``): a slot whose failure means machine death (SIGKILL,
+preemption) gets its memdir wiped; a stall or in-process crash keeps
+it, so the respawned worker restores from its own host tier.
 
 Every transition emits ``recovery.*`` telemetry events (plus a
 ``recovery.recover`` span around each reform), written both to the
@@ -93,10 +106,14 @@ class RecoveryFailedError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class KillSpec:
     """One scheduled chaos kill: SIGKILL ``worker`` once its heartbeat
-    reports a step >= ``after_step``."""
+    reports a step >= ``after_step``. A ``permanent`` spec models a
+    machine that is gone for good: it re-fires in EVERY generation
+    (once per generation) until the supervisor's shrink policy removes
+    the slot."""
 
     worker: int
     after_step: int
+    permanent: bool = False
 
 
 def seeded_kill_plan(seed: int, num_workers: int, *, kills: int = 1,
@@ -108,6 +125,19 @@ def seeded_kill_plan(seed: int, num_workers: int, *, kills: int = 1,
     return [KillSpec(worker=rng.randrange(num_workers),
                      after_step=rng.randrange(*step_range))
             for _ in range(kills)]
+
+
+def seeded_shrink_plan(seed: int, num_workers: int, *,
+                       step_range: tuple[int, int] = (3, 12)
+                       ) -> list[KillSpec]:
+    """A permanent-loss schedule: one seed-chosen worker's machine dies
+    for good (its kill re-fires every generation), forcing the
+    supervisor down the shrink path — reform at N-1 with a resharded
+    restore."""
+    rng = random.Random(f"dtx-shrink:{seed}")
+    return [KillSpec(worker=rng.randrange(num_workers),
+                     after_step=rng.randrange(*step_range),
+                     permanent=True)]
 
 
 class RecoverySupervisor:
@@ -142,11 +172,36 @@ class RecoverySupervisor:
                  retry_policy: RetryPolicy | None = None,
                  health: WorkerHealthTracker | None = None,
                  stall_timeout_s: float | None = None,
+                 heartbeat_grace_s: float | None = None,
                  generation_timeout_s: float = 600.0,
                  poll_interval_s: float = 0.05,
                  kill_plan: Sequence[KillSpec] = (),
+                 max_failure_history: int = 256,
+                 shrink_after: int | None = None,
+                 min_workers: int = 1,
                  telemetry_dir: str | None = None,
                  work_dir: str | None = None):
+        """Knobs beyond the obvious:
+
+        - ``stall_timeout_s`` — heartbeat *staleness* budget: a worker
+          whose newest heartbeat is older than this is declared stalled
+          (None disables supervisor-side stall detection).
+        - ``heartbeat_grace_s`` — separate budget for a worker that has
+          not heartbeat at all yet this generation (spawn + imports +
+          first compile are much slower than a steady-state step);
+          defaults to ``stall_timeout_s``. Both budgets are per
+          construction — nothing is hard-coded inside the loop.
+        - ``max_failure_history`` — cap on retained
+          :class:`WorkerFailure` entries: a long flapping run keeps the
+          NEWEST this-many failures (``failures_total`` still counts
+          them all), so supervisor memory stays bounded.
+        - ``shrink_after`` — the shrink policy: after this many
+          consecutive failed restarts of the SAME task slot, stop
+          re-spawning into the hole — reform at N-1 workers (never
+          below ``min_workers``) and let the topology-elastic restore
+          reshard the checkpoint onto the smaller cluster. ``None``
+          disables shrinking (restart budget semantics unchanged).
+        """
         self._fn = worker_fn
         self._num_workers = num_workers
         self._args = args
@@ -159,9 +214,19 @@ class RecoverySupervisor:
             backoff_multiplier=2.0, max_backoff_s=10.0)
         self.health = health or WorkerHealthTracker()
         self._stall_timeout_s = stall_timeout_s
+        self._heartbeat_grace_s = (heartbeat_grace_s
+                                   if heartbeat_grace_s is not None
+                                   else stall_timeout_s)
         self._generation_timeout_s = generation_timeout_s
         self._poll_s = poll_interval_s
-        self._pending_kills: list[KillSpec] = list(kill_plan)
+        # chaos kills as mutable records: permanent specs re-fire once
+        # per generation until their slot is shrunk away
+        self._kills: list[dict] = [{"spec": s, "fired_gen": None}
+                                   for s in kill_plan]
+        self.max_failure_history = max_failure_history
+        self.shrink_after = shrink_after
+        self.min_workers = min_workers
+        self._fail_streak: dict[int, int] = {}
         self._telemetry_dir = telemetry_dir
         self._dir = work_dir or tempfile.mkdtemp(prefix="dtx_supervisor_")
         os.makedirs(self._dir, exist_ok=True)
@@ -171,9 +236,15 @@ class RecoverySupervisor:
                 os.path.join(telemetry_dir, "events-supervisor.jsonl"),
                 process_id="supervisor")
         self.history: list[WorkerFailure] = []
+        self.failures_total = 0
         self.generation = 0
         self.restarts_used = 0
         self._runner: mpr.MultiProcessRunner | None = None
+
+    @property
+    def num_workers(self) -> int:
+        """Current cluster size (shrinks under the shrink policy)."""
+        return self._num_workers
 
     # -- telemetry --------------------------------------------------------
     def _event(self, name: str, **fields):
@@ -239,7 +310,7 @@ class RecoverySupervisor:
             timeout=self._generation_timeout_s)
         self._event("recovery.run_start", num_workers=self._num_workers,
                     max_restarts=self.max_restarts,
-                    chaos_kills=len(self._pending_kills))
+                    chaos_kills=len(self._kills))
         self._clear_heartbeats()
         self._runner.start()
         self._event("recovery.generation_start", generation=0)
@@ -298,7 +369,15 @@ class RecoverySupervisor:
             time.sleep(self._poll_s)
 
     def _fire_due_kills(self, exits):
-        for spec in list(self._pending_kills):
+        for rec in list(self._kills):
+            spec = rec["spec"]
+            if rec["fired_gen"] is not None and (
+                    not spec.permanent
+                    or rec["fired_gen"] >= self.generation):
+                continue                    # spent (or already fired
+            if spec.worker >= self._num_workers:   # this generation)
+                self._kills.remove(rec)     # slot shrunk away: retire
+                continue
             if ("worker", spec.worker) in exits:
                 continue                    # already down — keep waiting
             hb = self._heartbeat(spec.worker)
@@ -306,44 +385,117 @@ class RecoverySupervisor:
                 continue
             self._event("recovery.chaos_kill", generation=self.generation,
                         worker=spec.worker, after_step=spec.after_step,
-                        at_step=hb[1])
+                        at_step=hb[1], permanent=spec.permanent)
             self._runner.terminate("worker", spec.worker)
-            self._pending_kills.remove(spec)
+            rec["fired_gen"] = self.generation
+            if not spec.permanent:
+                self._kills.remove(rec)
 
     def _check_stall(self, exits, t0: float) -> WorkerFailure | None:
         if self._stall_timeout_s is None:
             return None
         now = time.time()
-        worst: tuple[float, int] | None = None    # (age, worker)
+        # (overage, age, budget, worker): worst = largest budget overrun
+        worst: tuple[float, float, float, int] | None = None
         for i in range(self._num_workers):
             if ("worker", i) in exits:
                 continue                          # finished: not stalled
             hb = self._heartbeat(i)
             # before the first heartbeat, age from generation start
-            # (covers spawn + jax import + compile)
-            age = (now - hb[0]) if hb is not None \
-                else (time.monotonic() - t0)
-            if worst is None or age > worst[0]:
-                worst = (age, i)
-        if worst is not None and worst[0] > self._stall_timeout_s:
+            # against the (typically larger) heartbeat_grace_s budget —
+            # spawn + jax import + first compile are not a stall
+            if hb is not None:
+                age, budget = now - hb[0], self._stall_timeout_s
+            else:
+                age, budget = (time.monotonic() - t0,
+                               self._heartbeat_grace_s)
+            over = age - budget
+            if worst is None or over > worst[0]:
+                worst = (over, age, budget, i)
+        if worst is not None and worst[0] > 0:
             return WorkerFailure(
-                generation=self.generation, task=("worker", worst[1]),
+                generation=self.generation, task=("worker", worst[3]),
                 kind="stall", wall=now,
-                detail=f"no heartbeat for {worst[0]:.1f}s "
-                       f"(budget {self._stall_timeout_s}s)")
+                detail=f"no heartbeat for {worst[1]:.1f}s "
+                       f"(budget {worst[2]}s)")
         return None
+
+    #: failure kinds that mean the MACHINE behind the slot lost its
+    #: memory (peer-snapshot memdir wiped): a SIGKILL stands in for
+    #: node death and a preemption reclaims the VM. A stall or an
+    #: in-process crash leaves the machine — and its memdir — alive.
+    _MACHINE_LOST_KINDS = frozenset({"killed", "preempted"})
+
+    def _record_failures(self, failures: list[WorkerFailure]):
+        import shutil
+
+        from distributed_tensorflow_tpu.cluster import elastic
+        failed_ids = set()
+        for f in failures:
+            self.history.append(f)
+            self.failures_total += 1
+            self.health.record_failure(f.task[1])
+            if f.task[1] >= 0:
+                failed_ids.add(f.task[1])
+                self._fail_streak[f.task[1]] = \
+                    self._fail_streak.get(f.task[1], 0) + 1
+            if f.kind in self._MACHINE_LOST_KINDS and f.task[1] >= 0:
+                shutil.rmtree(
+                    elastic.peer_memdir_path(self._dir, f.task[1]),
+                    ignore_errors=True)
+            self._event("recovery.worker_death", generation=f.generation,
+                        task_type=f.task[0], task_id=f.task[1],
+                        kind=f.kind, exitcode=f.exitcode, detail=f.detail)
+        # bounded memory on flapping runs: keep only the newest entries
+        if len(self.history) > self.max_failure_history:
+            del self.history[:-self.max_failure_history]
+        # a slot that did NOT fail this round broke its streak
+        for wid in list(self._fail_streak):
+            if wid not in failed_ids:
+                self._fail_streak[wid] = 0
+
+    def _maybe_shrink(self) -> int | None:
+        """Apply the shrink policy; returns the removed task id (or
+        None). The worst repeat offender's slot is dropped, higher slots
+        renumber down, and their machines' memdirs follow them."""
+        import shutil
+
+        from distributed_tensorflow_tpu.cluster import elastic
+        if self.shrink_after is None or self._num_workers <= \
+                self.min_workers:
+            return None
+        over = {w: n for w, n in self._fail_streak.items()
+                if n >= self.shrink_after}
+        if not over:
+            return None
+        removed = max(over, key=lambda w: (over[w], -w))
+        shutil.rmtree(elastic.peer_memdir_path(self._dir, removed),
+                      ignore_errors=True)
+        for i in range(removed + 1, self._num_workers):
+            src = elastic.peer_memdir_path(self._dir, i)
+            dst = elastic.peer_memdir_path(self._dir, i - 1)
+            shutil.rmtree(dst, ignore_errors=True)
+            if os.path.isdir(src):
+                os.replace(src, dst)
+        self._fail_streak = {
+            (w - 1 if w > removed else w): n
+            for w, n in self._fail_streak.items() if w != removed}
+        for rec in list(self._kills):       # chaos plan follows the
+            w = rec["spec"].worker          # machines, not the slots
+            if w == removed:
+                self._kills.remove(rec)     # the dead machine is gone
+            elif w > removed:
+                rec["spec"] = dataclasses.replace(rec["spec"],
+                                                  worker=w - 1)
+        self._num_workers -= 1
+        return removed
 
     def _recover(self, failures: list[WorkerFailure],
                  backoff: Backoff):
         """Bounded recovery: record → kill stragglers → (budget
-        permitting) back off, bump the generation, reform, un-quarantine
-        the restarted lanes."""
-        for f in failures:
-            self.history.append(f)
-            self.health.record_failure(f.task[1])
-            self._event("recovery.worker_death", generation=f.generation,
-                        task_type=f.task[0], task_id=f.task[1],
-                        kind=f.kind, exitcode=f.exitcode, detail=f.detail)
+        permitting) back off, bump the generation, maybe shrink,
+        reform, un-quarantine the restarted lanes."""
+        self._record_failures(failures)
         # a stalled task is still alive; every straggler of the dead
         # generation gets killed before the namespace moves on
         for key in self._runner.alive_tasks():
@@ -354,16 +506,23 @@ class RecoverySupervisor:
         if self.restarts_used >= self.max_restarts:
             self._event("recovery.failed", generation=self.generation,
                         restarts=self.restarts_used,
-                        failures=len(self.history))
+                        failures=self.failures_total)
             raise RecoveryFailedError(
                 f"restart budget exhausted ({self.restarts_used}/"
                 f"{self.max_restarts} restarts used) after "
-                f"{len(self.history)} failure(s): "
+                f"{self.failures_total} failure(s): "
                 + "; ".join(f.describe() for f in self.history[-5:]),
                 self.history)
         self.restarts_used += 1
         delay = backoff.next_s()
         self.generation += 1
+        removed = self._maybe_shrink()
+        if removed is not None:
+            self._event("recovery.reshard", generation=self.generation,
+                        removed_task=removed,
+                        old_workers=self._num_workers + 1,
+                        new_workers=self._num_workers,
+                        streak=self.shrink_after)
         span_cm = (self._log.span if self._log is not None
                    else _events.span)
         with span_cm("recovery.recover", generation=self.generation,
@@ -374,11 +533,14 @@ class RecoverySupervisor:
             self._event("recovery.restart", generation=self.generation,
                         restart=self.restarts_used,
                         budget_left=self.max_restarts - self.restarts_used,
-                        backoff_s=round(delay, 3))
+                        backoff_s=round(delay, 3),
+                        num_workers=self._num_workers)
             self._runner.reform(
                 mpr.create_cluster_spec(num_workers=self._num_workers),
-                env=self._child_env(self.generation))
+                env=self._child_env(self.generation),
+                allow_resize=removed is not None)
             for f in failures:
-                self.health.worker_restarted(f.task[1])
+                if 0 <= f.task[1] < self._num_workers:
+                    self.health.worker_restarted(f.task[1])
         self._event("recovery.generation_start",
                     generation=self.generation)   # also flushes the span

@@ -295,29 +295,58 @@ class MultiProcessRunner:
         self._spawn(key, new_env)
 
     def reform(self, cluster_spec: Mapping[str, Sequence[str]] | None = None,
-               *, env: Mapping[str, str] | None = None):
+               *, env: Mapping[str, str] | None = None,
+               allow_resize: bool = False):
         """Full-cluster restart: kill every task, swap in a fresh cluster
         spec (fresh coordination-service ports — required: the dead
         incarnation's service socket may linger in TIME_WAIT), and
         respawn all tasks via :meth:`restart` with the new ``TF_CONFIG``
         plus ``env`` overrides. The recovery supervisor's reform
-        primitive."""
+        primitive.
+
+        ``allow_resize=True`` lets the new spec change the cluster
+        shape (topology-elastic reform): dropped task slots are
+        archived into :attr:`history`, new slots are spawned fresh, and
+        every task's ``DTX_MPR_NUM_TASKS``/``DTX_MPR_TASK_INDEX`` are
+        re-derived from the new spec."""
         self.terminate_all()
         if cluster_spec is not None:
             new = {k: list(v) for k, v in cluster_spec.items()}
             if sorted((t, len(v)) for t, v in new.items()) != \
                     sorted((t, len(v)) for t, v in self._spec.items()):
-                raise ValueError(
-                    f"reform must keep the cluster shape: "
-                    f"{self._spec.keys()} -> {new.keys()}")
-            self._spec = new
+                if not allow_resize:
+                    raise ValueError(
+                        f"reform must keep the cluster shape: "
+                        f"{self._spec.keys()} -> {new.keys()}")
+                old_keys = set(self._task_keys())
+                self._spec = new
+                for key in sorted(old_keys - set(self._task_keys())):
+                    # dropped slot: archive its last incarnation
+                    self._collect(key)
+                    self.history.append(self._results.pop(key))
+                    self._procs.pop(key, None)
+                    self._conns.pop(key, None)
+                    self._stdout.pop(key, None)
+                    self._task_env.pop(key, None)
+            else:
+                self._spec = new
+        ntasks = self.num_tasks
         for task_index, key in enumerate(self._task_keys()):
-            updates = {"TF_CONFIG": json.dumps({
-                "cluster": self._spec,
-                "task": {"type": key[0], "index": key[1]},
-            })}
+            updates = {
+                "TF_CONFIG": json.dumps({
+                    "cluster": self._spec,
+                    "task": {"type": key[0], "index": key[1]},
+                }),
+                "DTX_MPR_NUM_TASKS": str(ntasks),
+                "DTX_MPR_TASK_INDEX": str(task_index),
+            }
             updates.update(env or {})
-            self.restart(key[0], key[1], env=updates)
+            if key in self._procs:
+                self.restart(key[0], key[1], env=updates)
+            else:                         # grown slot: fresh spawn
+                new_env = self._base_env(key[0], key[1], task_index)
+                new_env.update(updates)
+                self._spawn(key, new_env)
 
     def poll(self) -> dict[tuple[str, int], int]:
         """Exit codes of tasks whose current incarnation has exited

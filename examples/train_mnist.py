@@ -30,12 +30,18 @@ if _REPO not in sys.path:
 _POOL = 512
 
 
-def elastic_worker(ckpt_dir, total_steps, save_every, per_batch, lr):
+def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
+                   local_dir=None, snapshot_every=None, snapshot_keep=2):
     """One generation of one elastic worker: bootstrap from TF_CONFIG,
-    restore from the latest intact checkpoint, train data-parallel
-    (grads allgather-averaged across processes), checkpoint every
-    ``save_every`` steps, heartbeat every step. Module-level so the
-    supervisor's spawn machinery can pickle it by reference."""
+    restore down the recovery ladder (own host snapshot > peer replica
+    > local disk > durable disk), train data-parallel (grads
+    allgather-averaged across processes), checkpoint every
+    ``save_every`` steps with host snapshots every ``snapshot_every``
+    in between, heartbeat every step. The per-worker batch is derived
+    from the CURRENT process count (``global_batch // nproc``), so the
+    same worker fn runs at any topology the supervisor reforms to.
+    Module-level so the supervisor's spawn machinery can pickle it by
+    reference."""
     from distributed_tensorflow_tpu.cluster import bootstrap, elastic
     runtime = bootstrap.initialize()
     import jax
@@ -45,6 +51,8 @@ def elastic_worker(ckpt_dir, total_steps, save_every, per_batch, lr):
 
     from distributed_tensorflow_tpu.checkpoint.checkpoint import (
         Checkpoint, CheckpointManager)
+    from distributed_tensorflow_tpu.checkpoint.peer_snapshot import (
+        SnapshotStore)
     from distributed_tensorflow_tpu.models.mnist_cnn import (
         create_train_state, synthetic_data)
     from distributed_tensorflow_tpu.telemetry import events as tv_events
@@ -72,25 +80,34 @@ def elastic_worker(ckpt_dir, total_steps, save_every, per_batch, lr):
 
     leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
     ckpt = Checkpoint(leaves=list(leaves))
-    mgr = CheckpointManager(ckpt, ckpt_dir, checkpoint_name="elastic")
+    # snapshot_every == 0 disables the host/peer memory tiers entirely
+    memdir = elastic.peer_memdir()
+    store = (SnapshotStore(memdir, keep=snapshot_keep)
+             if memdir and snapshot_every != 0 else None)
+    mgr = CheckpointManager(ckpt, ckpt_dir, checkpoint_name="elastic",
+                            local_dir=local_dir, snapshot_store=store)
     start_step = 0
-    latest = mgr.latest_checkpoint
-    if latest is not None:
-        restored = Checkpoint(leaves=list(leaves)).restore(latest)
+    res = mgr.restore_latest()
+    if res is not None:
+        tier, start_step, restored = res
         params, opt_state = jax.tree_util.tree_unflatten(
             treedef, [restored[f"leaves/{i}"] for i in range(len(leaves))])
-        start_step = int(latest.rsplit("-", 1)[1])
         print(f"[gen {runtime.generation} p{runtime.process_id}] resumed "
-              f"from {os.path.basename(latest)} at step {start_step}")
+              f"at step {start_step} from the {tier} tier")
 
     nproc, pid = runtime.num_processes, runtime.process_id
-    gb = per_batch * nproc
+    per_batch = max(1, global_batch // nproc)
     loss = float("nan")
     import time as _time
+
+    def refresh_tracked():
+        ckpt._objects["leaves"] = list(
+            jax.tree_util.tree_flatten((params, opt_state))[0])
+
     for step in range(start_step, total_steps):
         elastic.heartbeat(step)
         t0 = _time.perf_counter()
-        start = (step * gb + pid * per_batch) % _POOL
+        start = (step * global_batch + pid * per_batch) % _POOL
         idx = (np.arange(per_batch) + start) % _POOL
         loss, grads = grad_fn(params, data["image"][idx],
                               data["label"][idx])
@@ -102,12 +119,16 @@ def elastic_worker(ckpt_dir, total_steps, save_every, per_batch, lr):
         tv_events.event("train.step", step=step, loss=float(loss),
                         dur_s=round(_time.perf_counter() - t0, 6))
         if (step + 1) % save_every == 0:
-            ckpt._objects["leaves"] = list(
-                jax.tree_util.tree_flatten((params, opt_state))[0])
+            refresh_tracked()
             mgr.save(checkpoint_number=step + 1)
+        elif (store is not None and snapshot_every
+              and (step + 1) % snapshot_every == 0):
+            refresh_tracked()
+            mgr.snapshot(step + 1)   # memory-only: the cheap hot tier
         if step % 10 == 0 and pid == 0:
             print(f"[gen {runtime.generation}] step {step}: "
                   f"loss={float(loss):.4f}")
+    ckpt.sync()
     bootstrap.shutdown()
     return runtime.process_id, start_step, float(loss)
 
@@ -116,28 +137,44 @@ def run_elastic(args):
     import tempfile
 
     from distributed_tensorflow_tpu.resilience import (
-        RecoverySupervisor, seeded_kill_plan)
+        RecoverySupervisor, seeded_kill_plan, seeded_shrink_plan)
 
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="mnist_elastic_")
+    local_dir = args.local_ckpt_dir
+    if local_dir is None and not args.no_local_tier:
+        local_dir = ckpt_dir.rstrip("/") + ".local"
+    snapshot_every = args.snapshot_every
+    if snapshot_every is None:
+        snapshot_every = max(1, args.save_every // 2)
     kill_plan = ()
     if args.kill_seed is not None:
-        kill_plan = seeded_kill_plan(args.kill_seed, args.workers,
-                                     kills=args.kills,
-                                     step_range=(2, max(3, args.steps - 4)))
+        step_range = (2, max(3, args.steps - 4))
+        if args.permanent_kill:
+            kill_plan = seeded_shrink_plan(args.kill_seed, args.workers,
+                                           step_range=step_range)
+        else:
+            kill_plan = seeded_kill_plan(args.kill_seed, args.workers,
+                                         kills=args.kills,
+                                         step_range=step_range)
         print(f"chaos kill plan (seed {args.kill_seed}): {kill_plan}")
     sup = RecoverySupervisor(
         elastic_worker, num_workers=args.workers,
-        args=(ckpt_dir, args.steps, args.save_every, args.global_batch //
-              args.workers, args.lr),
+        args=(ckpt_dir, args.steps, args.save_every, args.global_batch,
+              args.lr),
+        kwargs={"local_dir": local_dir,
+                "snapshot_every": 0 if args.no_snapshots
+                else snapshot_every},
         max_restarts=args.restart_budget, kill_plan=kill_plan,
+        shrink_after=args.shrink_after, min_workers=args.min_workers,
         generation_timeout_s=args.generation_timeout,
         telemetry_dir=args.telemetry_dir)
     result = sup.run()
     for pid, start_step, loss in sorted(result.return_values):
         print(f"worker {pid}: resumed@{start_step} final loss={loss:.4f}")
     print(f"done: {sup.restarts_used} restart(s), "
-          f"{len(sup.history)} recorded failure(s), "
-          f"final generation {sup.generation}")
+          f"{sup.failures_total} recorded failure(s), "
+          f"final generation {sup.generation}, "
+          f"final cluster size {sup.num_workers}")
     if args.telemetry_dir:
         print(f"recovery timeline: python tools/obs_report.py "
               f"{args.telemetry_dir}")
@@ -169,6 +206,26 @@ def main():
                          "derived from this seed")
     ap.add_argument("--kills", type=int, default=1,
                     help="elastic chaos: number of scheduled kills")
+    ap.add_argument("--permanent-kill", action="store_true",
+                    help="elastic chaos: the seed-chosen worker's "
+                         "machine dies for good (kill re-fires every "
+                         "generation; pair with --shrink-after)")
+    ap.add_argument("--shrink-after", type=int, default=None,
+                    help="elastic: after N failed restarts of the same "
+                         "task, reform at one fewer worker "
+                         "(topology-elastic resharded restore)")
+    ap.add_argument("--min-workers", type=int, default=1,
+                    help="elastic: never shrink below this many workers")
+    ap.add_argument("--local-ckpt-dir", default=None,
+                    help="elastic: node-local fast checkpoint tier "
+                         "(default: <ckpt-dir>.local)")
+    ap.add_argument("--no-local-tier", action="store_true",
+                    help="elastic: disable the local disk tier")
+    ap.add_argument("--snapshot-every", type=int, default=None,
+                    help="elastic: host-snapshot cadence between disk "
+                         "saves (default: save-every // 2)")
+    ap.add_argument("--no-snapshots", action="store_true",
+                    help="elastic: disable host/peer snapshot tiers")
     ap.add_argument("--generation-timeout", type=float, default=600.0,
                     help="elastic: per-generation wall budget (s)")
     args = ap.parse_args()

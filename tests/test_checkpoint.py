@@ -453,3 +453,159 @@ def test_sidecar_rotation_race_skips_and_continues(tmp_path):
     results = ev.run()
     assert steps == [2]               # 1 skipped, 2 evaluated, stop
     assert [s for s, _ in results] == [2]
+
+
+# -- fast-recovery tiers (ISSUE 7) ------------------------------------------
+
+def test_tiered_save_commits_local_then_durable(tmp_path):
+    """With a local tier, the save commits locally first and pipelines
+    an identical durable commit; both indexes carry their tier and
+    latest_checkpoint prefers the warmer tier at the same step."""
+    import json
+
+    state = {"w": np.arange(6.0)}
+    mgr = CheckpointManager(Checkpoint(state=state),
+                            str(tmp_path / "durable"),
+                            local_dir=str(tmp_path / "local"))
+    path = mgr.save(checkpoint_number=3)        # async by default
+    mgr.checkpoint.sync()
+    assert path == str(tmp_path / "local" / "ckpt-3")
+    for tier, d in (("local", "local"), ("durable", "durable")):
+        idx = tmp_path / d / "ckpt-3" / "checkpoint.index.json"
+        assert idx.exists(), tier
+        assert json.loads(idx.read_text())["tier"] == tier
+    assert mgr.latest_checkpoint == str(tmp_path / "local" / "ckpt-3")
+    # both tiers restore identically
+    for d in ("local", "durable"):
+        restored = Checkpoint(state={"w": np.zeros(6)}).restore(
+            str(tmp_path / d / "ckpt-3"))
+        np.testing.assert_array_equal(restored["state/w"], np.arange(6.0))
+
+
+def test_latest_prefers_freshest_intact_tier(tmp_path):
+    """A fresher local checkpoint beats an older durable one; a TORN
+    local tier falls back to the durable copy of the same step."""
+    state = {"w": np.arange(3.0)}
+    mgr = CheckpointManager(Checkpoint(state=state),
+                            str(tmp_path / "durable"),
+                            local_dir=str(tmp_path / "local"))
+    mgr.save(checkpoint_number=1)
+    mgr.save(checkpoint_number=2)
+    mgr.checkpoint.sync()
+    # durable lost step 2 (e.g. pipelined commit raced a crash)
+    import shutil
+    shutil.rmtree(tmp_path / "durable" / "ckpt-2")
+    assert mgr.latest_checkpoint == str(tmp_path / "local" / "ckpt-2")
+    # now tear the local step 2: its shard no longer matches the index
+    with open(tmp_path / "local" / "ckpt-2" / "shard_0.npz", "r+b") as f:
+        f.truncate(4)
+    assert mgr.latest_checkpoint == str(tmp_path / "local" / "ckpt-1")
+
+
+def test_sweep_never_deletes_pending_async_commit(tmp_path, monkeypatch):
+    """Regression (save(async) racing _sweep): rotation must skip a
+    checkpoint whose pipelined durable commit is still copying out of
+    the local tier — deleting it mid-flight tears the durable copy."""
+    import threading
+
+    from distributed_tensorflow_tpu.checkpoint import (
+        checkpoint as ckpt_mod)
+
+    entered, gate = threading.Event(), threading.Event()
+    real_copy = ckpt_mod.shutil.copy2
+
+    def slow_copy(src, dst, **kw):
+        entered.set()
+        assert gate.wait(30), "test gate never released"
+        return real_copy(src, dst, **kw)
+
+    monkeypatch.setattr(ckpt_mod.shutil, "copy2", slow_copy)
+    state = {"w": np.arange(5.0)}
+    mgr = CheckpointManager(Checkpoint(state=state),
+                            str(tmp_path / "durable"),
+                            local_dir=str(tmp_path / "local"),
+                            max_to_keep=0)      # sweep wants everything
+    mgr.save(checkpoint_number=1)               # async: local commits,
+    assert entered.wait(30)                     # durable copy is held
+    assert (tmp_path / "local" / "ckpt-1" /
+            "checkpoint.index.json").exists()
+    mgr._sweep()                                # racing sweep
+    assert (tmp_path / "local" / "ckpt-1").exists(), \
+        "sweep deleted a checkpoint with an in-flight commit"
+    gate.set()
+    mgr.checkpoint.sync()                       # commit finishes clean
+    restored = Checkpoint(state={"w": np.zeros(5)}).restore(
+        str(tmp_path / "durable" / "ckpt-1"))
+    np.testing.assert_array_equal(restored["state/w"], np.arange(5.0))
+    mgr._sweep()                                # no longer pending
+    assert not (tmp_path / "local" / "ckpt-1").exists()
+    assert not (tmp_path / "durable" / "ckpt-1").exists()
+
+
+def test_commit_fsyncs_directories(tmp_path, monkeypatch):
+    """The tmp->final renames are followed by directory fsyncs of the
+    checkpoint dir and its parent (file-content fsync alone does not
+    make the directory ENTRY crash-durable)."""
+    from distributed_tensorflow_tpu.checkpoint import (
+        checkpoint as ckpt_mod)
+
+    synced = []
+    monkeypatch.setattr(ckpt_mod, "_fsync_dir",
+                        lambda p: synced.append(os.path.abspath(p)))
+    ckpt = Checkpoint(state={"w": np.arange(2.0)})
+    path = ckpt.save(str(tmp_path / "ckpt"))
+    assert os.path.abspath(path) in synced
+    assert os.path.abspath(str(tmp_path)) in synced
+
+
+def test_restore_stitches_and_reshards_multifile_checkpoint(tmp_path,
+                                                            mesh8):
+    """Reshard-on-load: a checkpoint laid out as N shard files (per-host
+    slices with axis-0 offsets) restores onto a DIFFERENT topology —
+    the parts are stitched in slice order and re-placed under the
+    restoring variable's own sharding."""
+    import json
+
+    import jax
+
+    table = np.arange(32.0).reshape(16, 2)
+    v = ShardedVariable(table, mesh=mesh8, shard_axis_name="dp")
+    path = Checkpoint(emb=v).save(str(tmp_path / "ckpt"))
+
+    # rewrite the single shard file as two, as a 2-host job would have
+    # (rows 0:10 at offset 0, rows 10:16 at offset 10; file order
+    # deliberately swapped vs slice order)
+    with np.load(os.path.join(path, "shard_0.npz")) as z:
+        full = z["emb"]
+    os.unlink(os.path.join(path, "shard_0.npz"))
+    np.savez(os.path.join(path, "shard_0.npz"),
+             **{"emb": full[10:], "emb::off": np.array([10])})
+    np.savez(os.path.join(path, "shard_1.npz"),
+             **{"emb": full[:10], "emb::off": np.array([0])})
+    idx_path = os.path.join(path, "checkpoint.index.json")
+    with open(idx_path) as f:
+        index = json.load(f)
+    index.pop("shards", None)       # sizes changed; pre-checksum format
+    with open(idx_path, "w") as f:
+        json.dump(index, f)
+
+    # same topology: stitched restore matches
+    v.assign(np.zeros((16, 2)))
+    Checkpoint(emb=v).restore(path)
+    np.testing.assert_array_equal(v.read_value(), table)
+
+    # different topology: 4-device mesh built from the same host
+    from jax.sharding import Mesh
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    v4 = ShardedVariable(np.zeros((16, 2)), mesh=mesh4,
+                         shard_axis_name="dp")
+    Checkpoint(emb=v4).restore(path)
+    np.testing.assert_array_equal(v4.read_value(), table)
+
+    # a GAP between slices must raise, not mis-stitch silently
+    np.savez(os.path.join(path, "shard_1.npz"),
+             **{"emb": full[:8], "emb::off": np.array([0])})
+    from distributed_tensorflow_tpu.checkpoint import (
+        CheckpointCorruptError)
+    with pytest.raises(CheckpointCorruptError, match="abut"):
+        Checkpoint(emb=v).restore(path)

@@ -22,6 +22,7 @@ from distributed_tensorflow_tpu.resilience import (
     RecoveryFailedError,
     RecoverySupervisor,
     seeded_kill_plan,
+    seeded_shrink_plan,
 )
 from distributed_tensorflow_tpu.testing import multi_process_runner as mpr
 
@@ -155,6 +156,96 @@ def _elastic_mnist_worker(ckpt_dir, total_steps, save_every):
     return runtime.process_id, start_step, final_loss
 
 
+def _tiered_mnist_worker(ckpt_dir, local_dir, until_step, save_every,
+                         snapshot_every, global_batch):
+    """One generation of a tiered elastic worker (ISSUE 7): restore
+    down the ladder host > peer > local > durable via
+    ``CheckpointManager.restore_latest``, train data-parallel on a
+    FIXED global batch (per-worker share derived from the current
+    process count, so any topology computes the same global gradient),
+    snapshot every ``snapshot_every`` steps, save every ``save_every``
+    and at ``until_step``. Returns (pid, start_step, tier, final_loss).
+    """
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+    runtime = bootstrap.initialize()
+    import jax
+    from jax.experimental import multihost_utils
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, CheckpointManager)
+    from distributed_tensorflow_tpu.checkpoint.peer_snapshot import (
+        SnapshotStore)
+    from distributed_tensorflow_tpu.models.mnist_cnn import synthetic_data
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tdir:
+        tv_events.configure(tdir, process_id=runtime.process_id)
+
+    grad_fn, apply_fn, loss_fn, state = _mnist_loss_and_grad_fns()
+    params, opt_state = state["params"], state["opt_state"]
+    data = synthetic_data(_POOL)
+
+    leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
+    ckpt = Checkpoint(leaves=list(leaves))
+    memdir = elastic.peer_memdir()
+    store = SnapshotStore(memdir, keep=2) if memdir else None
+    mgr = CheckpointManager(ckpt, ckpt_dir, checkpoint_name="el",
+                            local_dir=local_dir, snapshot_store=store)
+
+    start_step, tier = 0, "none"
+    res = mgr.restore_latest()
+    if res is not None:
+        tier, start_step, restored = res
+        params, opt_state = jax.tree_util.tree_unflatten(
+            treedef, [restored[f"leaves/{i}"] for i in range(len(leaves))])
+
+    nproc, pid = runtime.num_processes, runtime.process_id
+    per = global_batch // nproc
+    assert per * nproc == global_batch, (global_batch, nproc)
+
+    def refresh():
+        ckpt._objects["leaves"] = list(
+            jax.tree_util.tree_flatten((params, opt_state))[0])
+
+    for step in range(start_step, until_step):
+        elastic.heartbeat(step)
+        idx = (np.arange(per) + step * global_batch + pid * per) % _POOL
+        _, grads = grad_fn(params, data["image"][idx], data["label"][idx])
+        if nproc > 1:
+            grads = jax.tree_util.tree_map(
+                lambda g: np.asarray(
+                    multihost_utils.process_allgather(g)).mean(0), grads)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        if (step + 1) % save_every == 0 or step + 1 == until_step:
+            refresh()
+            mgr.save(checkpoint_number=step + 1)
+        elif snapshot_every and (step + 1) % snapshot_every == 0:
+            refresh()
+            mgr.snapshot(step + 1)
+    final_loss = float(loss_fn(params, data["image"][:128],
+                               data["label"][:128]))
+    ckpt.sync()
+    bootstrap.shutdown()
+    return runtime.process_id, start_step, tier, final_loss
+
+
+def _uninterrupted_global_reference(total_steps, global_batch):
+    """The same training computed in-process on the full global batch:
+    the workers' equal-share mean-of-means IS the global-batch mean, at
+    any worker count — the invariant topology-elastic resume rides."""
+    from distributed_tensorflow_tpu.models.mnist_cnn import synthetic_data
+
+    grad_fn, apply_fn, loss_fn, state = _mnist_loss_and_grad_fns()
+    params, opt_state = state["params"], state["opt_state"]
+    data = synthetic_data(_POOL)
+    for step in range(total_steps):
+        idx = (np.arange(global_batch) + step * global_batch) % _POOL
+        _, grads = grad_fn(params, data["image"][idx], data["label"][idx])
+        params, opt_state = apply_fn(params, opt_state, grads)
+    return float(loss_fn(params, data["image"][:128], data["label"][:128]))
+
+
 def _uninterrupted_mnist_reference(total_steps, nshards=2):
     """The same training computed in-process with no faults: per-shard
     grads meaned across shards is exactly what the workers' allgather
@@ -278,6 +369,77 @@ def test_seeded_kill_plan_deterministic():
         assert 0 <= spec.worker < 2
 
 
+def test_seeded_shrink_plan_deterministic():
+    a = seeded_shrink_plan(5, 3)
+    assert a == seeded_shrink_plan(5, 3) and len(a) == 1
+    assert a[0].permanent and 0 <= a[0].worker < 3
+    assert seeded_shrink_plan(6, 3) != a
+
+
+def test_supervisor_caps_failure_history(tmp_path):
+    """A flapping run must not grow supervisor memory unboundedly: the
+    retained history keeps only the NEWEST max_failure_history entries
+    while failures_total still counts every death."""
+    sup = RecoverySupervisor(_always_crash_worker, num_workers=2,
+                             max_restarts=3, max_failure_history=3,
+                             generation_timeout_s=120)
+    with pytest.raises(RecoveryFailedError) as ei:
+        sup.run()
+    # 1-2 recorded deaths per generation x 4 generations (the second
+    # crasher sometimes dies as an unrecorded straggler)
+    assert 4 <= sup.failures_total <= 8
+    assert len(sup.history) == 3            # bounded
+    assert len(ei.value.history) == 3
+    # the retained entries are the NEWEST ones (final generation kept)
+    gens = sorted(f.generation for f in sup.history)
+    assert gens[-1] == 3 and gens[0] >= 1, gens
+
+
+def _slow_start_worker():
+    time.sleep(6)
+    elastic.heartbeat(1)
+    return int(os.environ.get("DTX_MPR_TASK_INDEX", "0"))
+
+
+def test_heartbeat_grace_decoupled_from_stall_budget(tmp_path):
+    """A worker that needs longer than the steady-state staleness
+    budget BEFORE its first heartbeat (spawn + imports + compile) must
+    not be declared stalled while heartbeat_grace_s covers it."""
+    sup = RecoverySupervisor(_slow_start_worker, num_workers=1,
+                             max_restarts=0, stall_timeout_s=2,
+                             heartbeat_grace_s=60,
+                             generation_timeout_s=120)
+    result = sup.run()
+    assert result.return_values == [0]
+    assert sup.restarts_used == 0 and sup.history == []
+
+
+def _resize_probe_worker():
+    return (int(os.environ.get("DTX_MPR_TASK_INDEX", "-1")),
+            int(os.environ.get("DTX_MPR_NUM_TASKS", "-1")),
+            int(os.environ.get("DTX_CLUSTER_GENERATION", "0")))
+
+
+def test_runner_reform_allow_resize_shrinks_cluster(tmp_path):
+    runner = mpr.MultiProcessRunner(
+        _resize_probe_worker, mpr.create_cluster_spec(num_workers=3),
+        timeout=120)
+    runner.start()
+    # shape change without opt-in still refuses
+    with pytest.raises(ValueError, match="cluster shape"):
+        runner.reform(mpr.create_cluster_spec(num_workers=2))
+    runner.reform(mpr.create_cluster_spec(num_workers=2),
+                  env={"DTX_CLUSTER_GENERATION": "1"}, allow_resize=True)
+    result = runner.join(timeout=120)
+    vals = sorted(result.return_values)
+    # 2 tasks, re-derived task index/count, new generation visible
+    assert vals == [(0, 2, 1), (1, 2, 1)]
+    assert len(result.tasks) == 2
+    # all three gen-0 incarnations archived (2 restarted + 1 dropped)
+    assert len(runner.history) == 3
+    runner.terminate_all()
+
+
 # ---------------------------------------------------------------------------
 # the headline: chaos SIGKILL mid-run -> recover -> resume -> converge
 # ---------------------------------------------------------------------------
@@ -387,3 +549,121 @@ def test_supervisor_detects_stall_via_heartbeat(tmp_path):
     assert sorted(result.return_values) == [0, 1]
     assert sup.restarts_used == 1
     assert any(f.kind == "stall" for f in sup.history), sup.history
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: multi-tier fast recovery + topology-elastic resume
+# ---------------------------------------------------------------------------
+
+GB = 24                 # divisible by every topology below (4, 3, 2)
+
+
+def test_elastic_peer_tier_recovery_no_disk_restore(tmp_path):
+    """Single-worker death recovers from MEMORY: the straggler restores
+    from its own host snapshots, the killed worker (memdir wiped by the
+    supervisor) fetches its state from the surviving peer's replica
+    over the coordination KV — no disk restore, and the resume point is
+    FRESHER than the newest disk checkpoint (snapshot cadence 2 vs save
+    cadence 5). Final loss still matches the uninterrupted reference,
+    and obs_report gates the recovery.restore_tier timeline + MTTR."""
+    ckpt_dir, local_dir = tmp_path / "ckpt", tmp_path / "local"
+    run_dir = tmp_path / "telemetry"
+    sup = RecoverySupervisor(
+        _tiered_mnist_worker, num_workers=2,
+        args=(str(ckpt_dir), str(local_dir), TOTAL_STEPS, SAVE_EVERY, 2,
+              GB),
+        max_restarts=2,
+        kill_plan=[KillSpec(worker=1, after_step=8)],
+        generation_timeout_s=420, telemetry_dir=str(run_dir))
+    result = sup.run()
+    assert sup.restarts_used >= 1
+    assert any(f.kind == "killed" for f in sup.history), sup.history
+
+    values = sorted(result.return_values)
+    assert len(values) == 2
+    tiers = {tier for _pid, _start, tier, _loss in values}
+    assert tiers <= {"host", "peer"}, values     # NO disk tier touched
+    assert "peer" in tiers, values               # the wiped worker
+    for _pid, start_step, _tier, _loss in values:
+        # resumed from a SNAPSHOT step (cadence 2), fresher than the
+        # newest disk checkpoint the kill-at-step-8 left behind (5)
+        assert start_step % 2 == 0
+        assert start_step >= 6, values
+
+    expect = _uninterrupted_global_reference(TOTAL_STEPS, GB)
+    for _pid, _start, _tier, loss in values:
+        assert abs(loss - expect) < max(1e-3, 0.05 * abs(expect)), \
+            (loss, expect)
+
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "obs_report.py"),
+         str(run_dir), "--check", "--require", "recovery.restore_tier",
+         "--mttr-budget", "120"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # every post-recovery restore chose the warmest available tier
+    events = [json.loads(line) for line in
+              (run_dir / "events-supervisor.jsonl")
+              .read_text().splitlines() if line]
+    assert any(e["ev"] == "recovery.restart" for e in events)
+
+
+def test_supervisor_shrinks_after_permanent_loss(tmp_path):
+    """Permanent machine loss: the same worker dies in every
+    generation; after shrink_after failed restarts of that slot the
+    supervisor reforms at N-1 with a resharded restore
+    (recovery.reshard), and the smaller cluster still converges to the
+    uninterrupted reference (fixed global batch)."""
+    ckpt_dir, local_dir = tmp_path / "ckpt", tmp_path / "local"
+    run_dir = tmp_path / "telemetry"
+    sup = RecoverySupervisor(
+        _tiered_mnist_worker, num_workers=3,
+        args=(str(ckpt_dir), str(local_dir), TOTAL_STEPS, SAVE_EVERY, 2,
+              GB),
+        max_restarts=4, shrink_after=2, min_workers=2,
+        kill_plan=[KillSpec(worker=1, after_step=6, permanent=True)],
+        generation_timeout_s=420, telemetry_dir=str(run_dir))
+    result = sup.run()
+    assert sup.num_workers == 2                 # shrunk from 3
+    values = sorted(result.return_values)
+    assert len(values) == 2                     # final generation: N-1
+    expect = _uninterrupted_global_reference(TOTAL_STEPS, GB)
+    for _pid, _start, _tier, loss in values:
+        assert abs(loss - expect) < max(1e-3, 0.05 * abs(expect)), \
+            (loss, expect)
+    events = [json.loads(line) for line in
+              (run_dir / "events-supervisor.jsonl")
+              .read_text().splitlines() if line]
+    reshards = [e for e in events if e["ev"] == "recovery.reshard"]
+    assert len(reshards) == 1, [e["ev"] for e in events]
+    assert reshards[0]["old_workers"] == 3
+    assert reshards[0]["new_workers"] == 2
+    assert reshards[0]["removed_task"] == 1
+
+
+def test_topology_elastic_resume_parity_4_3_4(tmp_path):
+    """Resume-parity across topology changes: train 4 workers, resume
+    the SAME checkpoint stream on 3, then grow back to 4 — every phase
+    reshards the previous phase's checkpoint on load, and the final
+    loss matches an uninterrupted single-stream reference because the
+    global batch is fixed (each topology computes the same global
+    gradient)."""
+    ckpt_dir, local_dir = tmp_path / "ckpt", tmp_path / "local"
+    phases = [(4, 8), (3, 14), (4, 20)]
+    expected_starts = [0, 8, 14]
+    for (nw, until), want_start in zip(phases, expected_starts):
+        result = mpr.run(
+            _tiered_mnist_worker, num_workers=nw,
+            args=(str(ckpt_dir), str(local_dir), until, 4, 0, GB),
+            timeout=300)
+        values = sorted(result.return_values)
+        assert len(values) == nw
+        for _pid, start, _tier, _loss in values:
+            assert start == want_start, (nw, until, values)
+    expect = _uninterrupted_global_reference(20, GB)
+    for _pid, _start, _tier, loss in values:
+        assert abs(loss - expect) < max(1e-3, 0.05 * abs(expect)), \
+            (loss, expect)

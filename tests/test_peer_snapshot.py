@@ -1,0 +1,173 @@
+"""Host/peer snapshot tiers: store retention, memdir survival, pack
+round-trip, ring assignment, and the cluster-consistent restore
+negotiation (all in-process — the multi-process drills live in
+tests/test_elastic.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import peer_snapshot as ps
+from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+)
+
+
+def _snap(owner=0, step=1, world=2, arrays=None):
+    return ps.HostSnapshot(
+        owner=owner, step=step, world=world,
+        index={"leaves": {"w": {"kind": "array", "shape": [3],
+                                "dtype": "float64"}}, "format": 1},
+        arrays=arrays if arrays is not None
+        else {"w": np.arange(3.0) + step})
+
+
+def test_pack_unpack_roundtrip():
+    snap = _snap(owner=1, step=7, world=4)
+    out = ps.unpack(ps.pack(snap))
+    assert (out.owner, out.step, out.world) == (1, 7, 4)
+    assert out.index == snap.index
+    np.testing.assert_array_equal(out.arrays["w"], snap.arrays["w"])
+
+
+def test_pack_unpack_empty_arrays():
+    """A non-chief's capture of fully replicated state has no arrays —
+    still a valid (and required) snapshot."""
+    snap = _snap(arrays={})
+    out = ps.unpack(ps.pack(snap))
+    assert out.arrays == {}
+    assert out.step == 1
+
+
+def test_store_prunes_per_owner_keep(tmp_path):
+    store = ps.SnapshotStore(str(tmp_path), keep=2)
+    for step in (1, 2, 3):
+        store.put(_snap(owner=0, step=step))
+    store.put(_snap(owner=1, step=1))
+    inv = store.inventory()
+    assert sorted(inv[0]) == [2, 3]          # oldest evicted
+    assert sorted(inv[1]) == [1]             # other owners untouched
+    # memdir mirror pruned too
+    assert sorted(os.listdir(tmp_path / "o0")) == ["s2", "s3"]
+
+
+def test_store_memdir_survives_restart(tmp_path):
+    store = ps.SnapshotStore(str(tmp_path), keep=2)
+    store.put(_snap(owner=0, step=5))
+    store.put(_snap(owner=1, step=5))
+    # "process restart": a fresh store over the same memdir
+    store2 = ps.SnapshotStore(str(tmp_path), keep=2)
+    assert store2.load_surviving() == 2
+    got = store2.get(1, 5)
+    np.testing.assert_array_equal(got.arrays["w"], np.arange(3.0) + 5)
+    # torn mirror (no meta.json) is skipped, not fatal
+    os.unlink(tmp_path / "o0" / "s5" / "meta.json")
+    store3 = ps.SnapshotStore(str(tmp_path), keep=2)
+    assert store3.load_surviving() == 1
+
+
+def test_ring_assignment():
+    assert ps.ring_source(0, 4) == 1
+    assert ps.ring_source(3, 4) == 0
+    for pid in range(4):
+        assert ps.ring_replicator(ps.ring_source(pid, 4), 4) == pid
+
+
+def test_decide_prefers_fresh_complete_memory_over_disk():
+    inv = {0: {0: {8: 2}, 1: {8: 2}}}        # pid 0 holds both owners @8
+    d = ps._decide(inv, disk_best=(5, "/ckpt-5", "local"))
+    assert d["source"] == "memory" and d["step"] == 8
+    assert d["holders"] == {"0": 0, "1": 0}
+
+
+def test_decide_incomplete_memory_falls_to_disk():
+    inv = {0: {0: {8: 2}}}                   # owner 1's snapshot lost
+    d = ps._decide(inv, disk_best=(5, "/ckpt-5", "durable"))
+    assert d["source"] == "disk" and d["step"] == 5
+    assert d["tier"] == "durable" and d["mem_step"] is None
+
+
+def test_decide_memory_wins_step_ties():
+    inv = {0: {0: {5: 1}}}
+    d = ps._decide(inv, disk_best=(5, "/ckpt-5", "local"))
+    assert d["source"] == "memory"           # warmer tier at same step
+
+
+def test_decide_nothing_anywhere():
+    assert ps._decide({0: {}}, None) == {"source": "none"}
+
+
+def test_decide_holders_prefer_owner_then_lowest_pid():
+    inv = {0: {1: {4: 2}},                   # pid 0 replicates owner 1
+           1: {1: {4: 2}, 0: {4: 2}}}       # pid 1 has own + owner 0
+    d = ps._decide(inv, None)
+    assert d["holders"] == {"0": 1, "1": 1}  # owner serves itself
+
+
+def test_manager_restore_latest_host_tier_single_process(tmp_path):
+    state = {"w": np.arange(4.0)}
+    store = ps.SnapshotStore(str(tmp_path / "mem"), keep=2)
+    mgr = CheckpointManager(Checkpoint(state=state),
+                            str(tmp_path / "durable"),
+                            local_dir=str(tmp_path / "local"),
+                            snapshot_store=store)
+    mgr.save(checkpoint_number=4)
+    mgr.checkpoint.sync()
+    state["w"] = np.arange(4.0) * 2          # drift, then snapshot only
+    mgr.snapshot(6)
+    tier, step, restored = mgr.restore_latest()
+    assert (tier, step) == ("host", 6)       # memory fresher than disk
+    np.testing.assert_array_equal(restored["state/w"], np.arange(4.0) * 2)
+
+    # memdir wiped (machine death) -> local disk tier at the save step
+    import shutil
+    shutil.rmtree(tmp_path / "mem")
+    ck2 = Checkpoint(state={"w": np.zeros(4)})
+    mgr2 = CheckpointManager(ck2, str(tmp_path / "durable"),
+                             local_dir=str(tmp_path / "local"),
+                             snapshot_store=ps.SnapshotStore(
+                                 str(tmp_path / "mem"), keep=2))
+    tier2, step2, restored2 = mgr2.restore_latest()
+    assert (tier2, step2) == ("local", 4)
+    np.testing.assert_array_equal(restored2["state/w"], np.arange(4.0))
+
+
+def test_restore_latest_emits_restore_tier_event(tmp_path, monkeypatch):
+    from distributed_tensorflow_tpu.telemetry import events as tv
+    monkeypatch.setattr(tv, "_LOG", None)
+    tv.configure(str(tmp_path / "tel"), process_id=0)
+    try:
+        state = {"w": np.arange(2.0)}
+        mgr = CheckpointManager(Checkpoint(state=state),
+                                str(tmp_path / "durable"))
+        mgr.save(checkpoint_number=3)
+        res = mgr.restore_latest()
+        assert res[0] == "durable" and res[1] == 3
+    finally:
+        tv.shutdown()
+    events = tv.read_events(str(tmp_path / "tel" / "events-0.jsonl"))
+    evs = [e for e in events if e["ev"] == "recovery.restore_tier"]
+    assert evs, events
+    ev = evs[-1]
+    assert ev["tier"] == "durable" and ev["step"] == 3
+    assert ev["best_available"] == "durable"
+    assert ev["available"]["durable"] == 3
+    assert ev["available"]["memory"] is None
+
+
+def test_exchange_noop_single_process():
+    """Outside a distributed job the exchange is a no-op (no KV)."""
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        coordination_service)
+    agent = coordination_service()
+    if agent.is_distributed:
+        pytest.skip("test assumes single-process run")
+    store = ps.SnapshotStore(None, keep=1)
+    assert ps.exchange(store, _snap(), agent) is False
+
+
+def test_store_keep_validation():
+    with pytest.raises(ValueError, match="keep"):
+        ps.SnapshotStore(None, keep=0)

@@ -11,10 +11,14 @@ passes; the survival rate is the headline robustness number.
 seed runs an elastic 2-worker MNIST job under the recovery supervisor
 (examples/train_mnist.py --elastic) with a seed-derived worker SIGKILL
 schedule (resilience/supervisor.seeded_kill_plan). A seed survives only
-when the job completes AND ``obs_report.py --check --require
-recovery.restart --require recovery.run_complete`` confirms the
-telemetry recorded an actual recovery — a swept run that "passes"
-without ever recovering is a failure of the harness, not a success.
+when the job completes AND ``obs_report.py --check --require`` confirms
+the telemetry recorded an actual recovery with a ``recovery.
+restore_tier`` event — AND that recovery restored from the warmest tier
+that held the freshest state (a run that fell back to cold disk while a
+peer replica was available fails the seed). ``--shrink`` makes the
+seed-chosen machine die permanently: the supervisor must reform at N-1
+workers via a resharded restore (``recovery.reshard`` gated).
+``--mttr-budget`` additionally bounds each recovery's measured MTTR.
 
 Usage::
 
@@ -22,6 +26,7 @@ Usage::
     python tools/chaos_sweep.py --seeds 5 --base-seed 100 --slow
     python tools/chaos_sweep.py --seeds 3 -- -k preemption
     python tools/chaos_sweep.py --kill --seeds 3      # SIGKILL sweep
+    python tools/chaos_sweep.py --kill --shrink --workers 3 --seeds 3
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -58,13 +63,43 @@ def run_seed(seed: int, include_slow: bool, extra: list[str]) -> tuple[bool, flo
     return ok, dt
 
 
+def _restore_tier_gate(run_dir: str) -> "list[str]":
+    """A recovery must restore from the WARMEST tier that held the
+    freshest state: any ``recovery.restore_tier`` event whose chosen
+    tier is colder than its recorded ``best_available`` is a failure of
+    the fast-recovery ladder, even if the run converged. Returns the
+    violation messages (empty = ok)."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    rank = {"host": 0, "peer": 0, "memory": 0, "local": 1,
+            "durable": 2, "none": 3}
+    bad = []
+    for pid, events in read_run(run_dir).items():
+        for ev in events:
+            if ev.get("ev") != "recovery.restore_tier":
+                continue
+            if not ev.get("generation"):
+                continue          # gen-0 cold start: nothing to recover
+            tier, best = ev.get("tier"), ev.get("best_available")
+            if rank.get(tier, 3) > rank.get(best, 3):
+                bad.append(
+                    f"p{pid} gen{ev.get('generation')}: restored from "
+                    f"{tier!r} but {best!r} held the freshest state "
+                    f"(available={ev.get('available')})")
+    return bad
+
+
 def run_kill_seed(seed: int, *, workers: int, steps: int,
                   save_every: int, budget: int,
-                  keep_dirs: bool) -> tuple[bool, float]:
+                  keep_dirs: bool, shrink: bool = False,
+                  mttr_budget: "float | None" = None) -> tuple[bool, float]:
     """One supervised elastic run with a seed-derived SIGKILL schedule;
-    survival requires BOTH a clean exit and telemetry proof (via
-    ``obs_report --check --require``) that a recovery actually ran."""
-    run_dir = tempfile.mkdtemp(prefix=f"chaos_kill_s{seed}_")
+    survival requires a clean exit AND telemetry proof (via ``obs_report
+    --check --require``) that a recovery actually ran, restored from
+    the warmest available tier, and (``shrink``) reformed at N-1 via a
+    resharded restore."""
+    kind = "shrink" if shrink else "kill"
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_{kind}_s{seed}_")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     cmd = [sys.executable, os.path.join(REPO, "examples", "train_mnist.py"),
@@ -73,23 +108,40 @@ def run_kill_seed(seed: int, *, workers: int, steps: int,
            "--restart-budget", str(budget),
            "--ckpt-dir", os.path.join(run_dir, "ckpt"),
            "--telemetry-dir", run_dir]
+    if shrink:
+        cmd += ["--permanent-kill", "--shrink-after", "2",
+                "--min-workers", str(max(1, workers - 1))]
     t0 = time.monotonic()
     proc = subprocess.run(cmd, cwd=REPO, env=env,
                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     ok = proc.returncode == 0
     if ok:
-        gate = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "obs_report.py"),
-             run_dir, "--check", "--require", "recovery.restart",
-             "--require", "recovery.run_complete"],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        gate_cmd = [sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    run_dir, "--check", "--require", "recovery.restart",
+                    "--require", "recovery.run_complete",
+                    "--require", "recovery.restore_tier"]
+        if shrink:
+            gate_cmd += ["--require", "recovery.reshard"]
+        if mttr_budget is not None:
+            gate_cmd += ["--mttr-budget", str(mttr_budget)]
+        gate = subprocess.run(gate_cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
         if gate.returncode != 0:
             ok = False
             print(f"--- seed {seed}: run finished but telemetry gate "
                   f"FAILED (rc={gate.returncode}) ---")
             print(gate.stdout.decode(errors="replace").strip())
-    else:
+    if ok:
+        violations = _restore_tier_gate(run_dir)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: recovery restored from a COLDER "
+                  f"tier than available ---")
+            for v in violations:
+                print(f"    {v}")
+    if not ok and proc.returncode != 0:
         tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
         print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
         print("\n".join(tail))
@@ -113,6 +165,15 @@ def main(argv=None) -> int:
     ap.add_argument("--kill", action="store_true",
                     help="sweep seed-driven worker SIGKILLs through the "
                          "recovery supervisor instead of fault schedules")
+    ap.add_argument("--shrink", action="store_true",
+                    help="with --kill: permanent-loss schedules — the "
+                         "seed-chosen machine dies for good and the "
+                         "supervisor must reform at N-1 via a resharded "
+                         "restore (recovery.reshard gated)")
+    ap.add_argument("--mttr-budget", type=float, default=None,
+                    help="--kill: fail a seed whose recovery MTTR "
+                         "exceeds this many seconds "
+                         "(obs_report --mttr-budget)")
     ap.add_argument("--workers", type=int, default=2,
                     help="--kill: workers per supervised run")
     ap.add_argument("--steps", type=int, default=20,
@@ -127,6 +188,10 @@ def main(argv=None) -> int:
                     help="extra args forwarded to pytest (after --)")
     args = ap.parse_args(argv)
 
+    if args.shrink and not args.kill:
+        ap.error("--shrink requires --kill")
+    if args.shrink and args.workers < 2:
+        ap.error("--shrink needs at least 2 workers to shrink from")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
         if args.kill:
@@ -134,7 +199,9 @@ def main(argv=None) -> int:
                                    steps=args.steps,
                                    save_every=args.save_every,
                                    budget=args.restart_budget,
-                                   keep_dirs=args.keep_dirs)
+                                   keep_dirs=args.keep_dirs,
+                                   shrink=args.shrink,
+                                   mttr_budget=args.mttr_budget)
         else:
             ok, dt = run_seed(s, args.slow, args.pytest_args)
         results.append((s, ok, dt))

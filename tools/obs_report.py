@@ -139,6 +139,10 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                         "infeed_wait_s": round(pid_wait, 6)}
 
     recovery.sort(key=lambda ev: ev.get("wall", 0.0))
+    restore_tiers = collections.Counter(
+        ev.get("tier", "?") for ev in recovery
+        if ev.get("ev") == "recovery.restore_tier"
+        and ev.get("tier") != "none")      # "none" = cold start
     return {
         "processes": per_pid,
         "step_time": _percentiles(steps),
@@ -161,8 +165,38 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                              for ev in recovery),
             "failed": any(ev.get("ev") == "recovery.failed"
                           for ev in recovery),
+            "reshards": sum(1 for ev in recovery
+                            if ev.get("ev") == "recovery.reshard"),
+            "restore_tiers": dict(restore_tiers),
+            "mttr_s": recovery_mttrs(recovery),
         } if recovery else None,
     }
+
+
+def recovery_mttrs(recovery: "list[dict]") -> "dict[int, float]":
+    """Per-recovery MTTR over the recovery timeline: for each reformed
+    generation g, wall time from the FIRST ``recovery.worker_death`` of
+    generation g-1 to the moment the new generation is restored — the
+    last ``recovery.restore_tier`` event of generation g when workers
+    emitted one, else the supervisor's ``recovery.generation_start``.
+    Returns {generation: mttr_seconds}."""
+    death_start: dict[int, float] = {}
+    resumed: dict[int, float] = {}
+    for ev in recovery:
+        wall, name = ev.get("wall"), ev.get("ev")
+        gen = ev.get("generation")
+        if not isinstance(wall, (int, float)) or gen is None:
+            continue
+        if name == "recovery.worker_death":
+            death_start.setdefault(int(gen), wall)
+            death_start[int(gen)] = min(death_start[int(gen)], wall)
+        elif name == "recovery.restore_tier":
+            resumed[int(gen)] = max(resumed.get(int(gen), wall), wall)
+        elif name == "recovery.generation_start":
+            resumed.setdefault(int(gen), wall)
+    return {g + 1: round(resumed[g + 1] - w0, 3)
+            for g, w0 in sorted(death_start.items())
+            if g + 1 in resumed}
 
 
 def read_rollup_scalars(target: str) -> dict:
@@ -209,6 +243,18 @@ def _fmt_recovery_line(ev: dict) -> str:
                     f"backoff {ev.get('backoff_s')}s)")
     elif name == "recovery.recover":
         tail.append(f"recovered in {_fmt_ms(ev.get('dur_s'))}")
+    elif name == "recovery.restore_tier":
+        if ev.get("tier") == "none":
+            tail.append(f"p{ev.get('pid')} cold start "
+                        f"(nothing to restore)")
+        else:
+            tail.append(f"p{ev.get('pid')} restored from "
+                        f"{ev.get('tier')} tier at step {ev.get('step')}"
+                        + (" (resharded)" if ev.get("resharded") else ""))
+    elif name == "recovery.reshard":
+        tail.append(f"shrink {ev.get('old_workers')}->"
+                    f"{ev.get('new_workers')} workers "
+                    f"(task {ev.get('removed_task')} gone for good)")
     elif name == "recovery.run_complete":
         tail.append(f"restarts={ev.get('restarts')}")
     elif name == "recovery.failed":
@@ -263,7 +309,17 @@ def render_text(report: dict, rollup: dict) -> str:
                   else "RECOVERY FAILED (budget exhausted)"
                   if rec["failed"] else "in progress")
         out.append(f"recovery: {rec['worker_deaths']} worker death(s), "
-                   f"{rec['restarts']} restart(s) — {status}")
+                   f"{rec['restarts']} restart(s)"
+                   + (f", {rec['reshards']} shrink(s)"
+                      if rec.get("reshards") else "")
+                   + f" — {status}")
+        if rec.get("restore_tiers"):
+            out.append("restore tiers: " + "  ".join(
+                f"{t}×{n}" for t, n in sorted(
+                    rec["restore_tiers"].items())))
+        for gen, mttr in sorted((rec.get("mttr_s") or {}).items()):
+            out.append(f"MTTR (gen {gen}): {mttr:.3f}s "
+                       f"(death -> restored)")
         out.append("recovery timeline:")
         for ev in report["recovery_timeline"]:
             out.append(_fmt_recovery_line(ev))
@@ -274,10 +330,12 @@ def render_text(report: dict, rollup: dict) -> str:
     return "\n".join(out)
 
 
-def check(target: str, require: "list[str] | None" = None) -> int:
+def check(target: str, require: "list[str] | None" = None,
+          mttr_budget: "float | None" = None) -> int:
     """Validate every event file; 0 = ok (torn tails reported but
-    tolerated), 1 = corrupt/malformed or a ``require``d event is absent
-    from the whole run, 2 = nothing to check."""
+    tolerated), 1 = corrupt/malformed, a ``require``d event is absent
+    from the whole run, or a recovery's MTTR exceeded ``mttr_budget``
+    seconds; 2 = nothing to check."""
     files = _event_files(target)
     if not files:
         print(f"obs_report --check: no events-*.jsonl under {target}",
@@ -285,6 +343,7 @@ def check(target: str, require: "list[str] | None" = None) -> int:
         return 2
     rc = 0
     seen_names: set = set()
+    recovery_events: list = []
     for path in files:
         try:
             events = read_events(path, tolerate_torn_tail=True)
@@ -294,6 +353,10 @@ def check(target: str, require: "list[str] | None" = None) -> int:
             continue
         seen_names.update(ev.get("ev") for ev in events
                           if isinstance(ev.get("ev"), str))
+        recovery_events.extend(
+            ev for ev in events
+            if isinstance(ev.get("ev"), str)
+            and ev["ev"].startswith("recovery."))
         torn = _torn_tail(path)
         note = "  (torn tail line tolerated)" if torn else ""
         print(f"ok       {path}: {len(events)} events{note}")
@@ -303,6 +366,18 @@ def check(target: str, require: "list[str] | None" = None) -> int:
             print(f"MISSING  required event {req!r} never recorded "
                   f"in {target}", file=sys.stderr)
             rc = 1
+    if mttr_budget is not None:
+        recovery_events.sort(key=lambda ev: ev.get("wall", 0.0))
+        mttrs = recovery_mttrs(recovery_events)
+        for gen, mttr in sorted(mttrs.items()):
+            status = "ok" if mttr <= mttr_budget else "OVER BUDGET"
+            line = (f"mttr     gen {gen}: {mttr:.3f}s "
+                    f"(budget {mttr_budget}s) {status}")
+            if mttr > mttr_budget:
+                print(line, file=sys.stderr)
+                rc = 1
+            else:
+                print(line)
     return rc
 
 
@@ -318,13 +393,21 @@ def main(argv=None) -> int:
     ap.add_argument("--require", action="append", metavar="EVENT",
                     help="with --check: fail unless an event with this "
                          "name (or namespace prefix) was recorded, e.g. "
-                         "--require recovery.restart")
+                         "--require recovery.restore_tier")
+    ap.add_argument("--mttr-budget", type=float, default=None,
+                    metavar="SECONDS",
+                    help="with --check: fail if any recovery's MTTR "
+                         "(first worker death -> cluster restored) "
+                         "exceeds this many seconds")
     args = ap.parse_args(argv)
 
     if args.check:
-        return check(args.target, require=args.require)
+        return check(args.target, require=args.require,
+                     mttr_budget=args.mttr_budget)
     if args.require:
         ap.error("--require only applies with --check")
+    if args.mttr_budget is not None:
+        ap.error("--mttr-budget only applies with --check")
 
     files = _event_files(args.target)
     if not files:
