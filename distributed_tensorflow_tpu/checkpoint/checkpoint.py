@@ -52,6 +52,16 @@ _INDEX_FILE = "checkpoint.index.json"
 _LATEST_FILE = "checkpoint"  # ≙ the reference's `checkpoint` state file
 
 
+def checkpoint_span_id(path: str) -> str:
+    """Causality id shared by every telemetry span of ONE logical save:
+    the ``checkpoint.save`` capture and each tier's
+    ``checkpoint.commit`` (local/durable/host). The id derives from the
+    checkpoint's tier-invariant basename (``<name>-<number>``), so the
+    whole tier ladder links into one flow chain in the merged trace
+    (telemetry/trace.py) without threading ids across processes."""
+    return f"ckpt/{os.path.basename(path)}"
+
+
 class CheckpointCorruptError(RuntimeError):
     """A shard file fails its recorded checksum/size — the checkpoint is
     torn (truncated write, partial commit) and must not be restored."""
@@ -154,12 +164,18 @@ class Checkpoint:
         """
         # span covers the BLOCKING portion (device->host + commit when
         # sync; device->host + thread handoff when async) — the async
-        # file IO reports separately via the checkpoint.commit event
+        # file IO reports separately via the checkpoint.commit event.
+        # span_id is shared by the save span and every tier commit of
+        # this save (local/durable/host — the basename is tier-invariant)
+        # so the capture->commit ladder renders as one flow chain in the
+        # merged trace (telemetry/trace.py).
+        span_id = checkpoint_span_id(path)
         with telemetry.span("checkpoint.save", path=path,
-                            async_write=async_write):
+                            async_write=async_write, span_id=span_id):
             return self._write_impl(path, async_write=async_write,
                                     tier=tier, pipeline_to=pipeline_to,
-                                    on_captured=on_captured)
+                                    on_captured=on_captured,
+                                    span_id=span_id)
 
     def _capture(self) -> tuple[dict[str, np.ndarray], dict]:
         """Device->host snapshot of the tracked pytree: the shard arrays
@@ -196,7 +212,7 @@ class Checkpoint:
 
     def _write_impl(self, path: str, *, async_write: bool,
                     tier: str = "durable", pipeline_to: str | None = None,
-                    on_captured=None) -> str:
+                    on_captured=None, span_id: str | None = None) -> str:
         proc = jax.process_index()
         tmp = f"{path}.tmp.{proc}"
         os.makedirs(tmp, exist_ok=True)
@@ -218,7 +234,7 @@ class Checkpoint:
                 # never hit disk (rename is only atomic for the
                 # directory entry).
                 with telemetry.span("checkpoint.commit", path=path,
-                                    tier=tier):
+                                    tier=tier, span_id=span_id):
                     shard = os.path.join(tmp, f"shard_{proc}.npz")
                     with open(shard, "wb") as f:
                         np.savez(f, **host_arrays)
@@ -231,7 +247,8 @@ class Checkpoint:
                     # same hardened protocol (fresh tmp, barriers,
                     # index-last)
                     with telemetry.span("checkpoint.commit",
-                                        path=pipeline_to, tier="durable"):
+                                        path=pipeline_to, tier="durable",
+                                        span_id=span_id):
                         tmp2 = f"{pipeline_to}.tmp.{proc}"
                         os.makedirs(tmp2, exist_ok=True)
                         shutil.copy2(os.path.join(path,
@@ -449,7 +466,8 @@ class Checkpoint:
         """Restore from ``path``. DistributedVariables are assigned in
         place (re-placed with their sharding); plain leaves are returned in
         the result pytree."""
-        with telemetry.span("checkpoint.restore", path=path):
+        with telemetry.span("checkpoint.restore", path=path,
+                            span_id=checkpoint_span_id(path)):
             return self._restore_impl(path)
 
     def _restore_impl(self, path: str) -> dict:
@@ -815,7 +833,9 @@ class CheckpointManager:
         agent = coordination_service()
         index = dict(index)
         index["tier"] = "host"
-        with telemetry.span("checkpoint.commit", tier="host", step=step):
+        with telemetry.span("checkpoint.commit", tier="host", step=step,
+                            span_id=checkpoint_span_id(
+                                f"{self._name}-{step}")):
             # copy: the capture aliases live host buffers for plain-np
             # leaves; a retained snapshot must not track future updates
             snap = _ps.HostSnapshot(

@@ -311,20 +311,36 @@ class CoordinationServiceAgent:
         Raises :class:`BarrierTimeoutError` on timeout — the failing-fast
         behavior the reference's check_health/barrier path has
         (collective_all_reduce_strategy.py:990) rather than hanging.
+
+        When telemetry is on, a successful barrier emits a
+        ``clock.sync`` event: the release is a shared instant every
+        participant observes within the release latency, so the trace
+        assembler (telemetry/trace.py) uses the per-process walls
+        recorded here to estimate per-host clock offsets.
         """
         faults.fire("coord.barrier", tag=name, exc=BarrierTimeoutError,
                     msg=f"injected barrier timeout at {name!r}")
+        raw_name = name
         name = elastic.namespace(name)
         c = self._client
         if c is None:
             self._local.barrier(name, timeout_s, 1)
-            return
-        try:
-            c.wait_at_barrier(name, int(timeout_s * 1000))
-        except Exception as e:
-            raise BarrierTimeoutError(
-                f"barrier {name!r} timed out after {timeout_s}s "
-                f"(a peer process is hung or dead): {e}") from e
+        else:
+            try:
+                c.wait_at_barrier(name, int(timeout_s * 1000))
+            except Exception as e:
+                raise BarrierTimeoutError(
+                    f"barrier {name!r} timed out after {timeout_s}s "
+                    f"(a peer process is hung or dead): {e}") from e
+        self._emit_clock_sync(raw_name)
+
+    @staticmethod
+    def _emit_clock_sync(barrier_name: str):
+        """One ``clock.sync`` record per barrier release (no-op with
+        telemetry off — a single None check inside events.event)."""
+        from distributed_tensorflow_tpu.telemetry import events as _tv
+        if _tv.enabled():
+            _tv.event("clock.sync", barrier=barrier_name)
 
     # -- liveness ---------------------------------------------------------
     def live_processes(self) -> list[int]:

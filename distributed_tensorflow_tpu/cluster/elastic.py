@@ -87,8 +87,15 @@ def heartbeat(step: int | None = None):
         return
     task = os.environ.get("DTX_MPR_TASK_INDEX", "0")
     try:
+        import time
+        # "<step> <wall>": the wall clock is this worker's reading of
+        # the write instant; the supervisor pairs it with the file's
+        # mtime (its own clock domain) into a ``clock.hb`` telemetry
+        # event — the heartbeat half of cross-host clock alignment
+        # (telemetry/trace.estimate_clock_offsets).
         with open(os.path.join(d, f"heartbeat-{task}"), "w") as f:
-            f.write("" if step is None else str(int(step)))
+            f.write(("" if step is None else str(int(step)))
+                    + f" {time.time():.6f}")
     except OSError:
         pass                      # supervisor dir raced away: non-fatal
 

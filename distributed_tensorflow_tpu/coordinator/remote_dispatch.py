@@ -96,6 +96,16 @@ def _result_key(gen: int, worker_id: int, seq: int) -> str:
     return f"{_gen_dir(gen)}/result/{worker_id}/{seq}"
 
 
+def closure_span_id(gen: int, worker_id: int, seq: int) -> str:
+    """Stable causality id one closure carries across processes: the
+    coordinator's ``dispatch.send``/``dispatch.result`` events and the
+    worker's ``worker.execute`` span all stamp it, so the merged trace
+    (telemetry/trace.py) links them into one flow chain. (gen, worker,
+    seq) already uniquely names a closure on the KV control plane — the
+    span id is just its printable form."""
+    return f"dispatch/g{gen}/w{worker_id}/c{seq}"
+
+
 def _done_key(gen: int, worker_id: int) -> str:
     """Watermark: next seq this worker should run (restart fast-forward)."""
     return f"{_gen_dir(gen)}/done/{worker_id}"
@@ -193,6 +203,15 @@ class RemoteLane:
         with self._seq_lock:
             seq = self._seq
             self._seq += 1
+        if telemetry_events.enabled():
+            # span_id threads the closure through the merged timeline:
+            # dispatch.send (coordinator) -> worker.execute (worker) ->
+            # dispatch.result (coordinator) render as one flow chain in
+            # the assembled trace (telemetry/trace.py).
+            telemetry_events.event(
+                "dispatch.send", worker=self.worker_id, closure=seq,
+                span_id=closure_span_id(self.generation, self.worker_id,
+                                        seq))
         self.agent.key_value_set(
             _task_key(self.generation, self.worker_id, seq), payload)
         return seq
@@ -287,9 +306,16 @@ class RemoteLane:
                 pass
         status, data = pickle.loads(res)
         if status == "ok":
+            if telemetry_events.enabled():
+                telemetry_events.event(
+                    "dispatch.result", worker=self.worker_id, closure=seq,
+                    span_id=closure_span_id(self.generation,
+                                            self.worker_id, seq))
             return data
         telemetry_events.event("dispatch.closure_error",
-                               worker=self.worker_id, closure=seq)
+                               worker=self.worker_id, closure=seq,
+                               span_id=closure_span_id(
+                                   self.generation, self.worker_id, seq))
         raise RemoteClosureError(
             f"closure failed on worker {self.worker_id}:\n{data}")
 
@@ -434,7 +460,12 @@ class RemoteWorkerService:
                 fn, args, kwargs = pickle.loads(payload)
                 try:
                     with telemetry_registry.timer(
-                            "worker/closure_execution").time():
+                            "worker/closure_execution").time(), \
+                        telemetry_events.span(
+                            "worker.execute", worker=self.worker_id,
+                            closure=seq,
+                            span_id=closure_span_id(gen, self.worker_id,
+                                                    seq)):
                         args = resolve_resources(args, self.resources)
                         kwargs = resolve_resources(kwargs, self.resources)
                         # the service instance is discoverable by closures

@@ -765,6 +765,12 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     - ``"gspmd"`` — one compiler-scheduled sync (the pre-ISSUE-6 path).
     - ``"auto"`` (default) — "bucketed" on >1-device pure-dp meshes
       (no MoE, default step), "gspmd" otherwise.
+    - ``"none"`` — MEASUREMENT ONLY: the bucketed step with the gradient
+      sync deleted (each shard applies its LOCAL grads — replicas
+      diverge, so never train with this). Timing full vs "none" isolates
+      the step's exposed collective time; bench.py's phase-breakdown
+      rows (``compute_frac``/``collective_frac``/``overlap_eff``) are
+      the full/none/collective-only delta.
 
     ``step_factory(cfg, model, tx)`` lets variants (BERT MLM) swap the
     per-step loss while reusing all sharding/jit wiring.
@@ -773,16 +779,17 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
         data_axes as mesh_data_axes
     pure_dp = (set(mesh.shape) <= {"dcn", "dp"} and mesh.size > 1
                and cfg.moe_experts == 0 and step_factory is None)
-    if grad_sync not in ("auto", "bucketed", "gspmd"):
+    if grad_sync not in ("auto", "bucketed", "gspmd", "none"):
         raise ValueError(f"grad_sync={grad_sync!r}; expected auto/"
-                         f"bucketed/gspmd")
-    if grad_sync == "bucketed" and not pure_dp:
+                         f"bucketed/gspmd/none")
+    if grad_sync in ("bucketed", "none") and not pure_dp:
         raise ValueError(
-            "grad_sync='bucketed' needs a pure data-parallel mesh "
+            f"grad_sync={grad_sync!r} needs a pure data-parallel mesh "
             f"(axes ⊆ {{dcn, dp}}, >1 device, no MoE); got "
             f"{dict(mesh.shape)}")
-    if pure_dp and grad_sync in ("auto", "bucketed"):
-        return _make_bucketed_dp_train_step(cfg, mesh, global_batch, seed)
+    if pure_dp and grad_sync in ("auto", "bucketed", "none"):
+        return _make_bucketed_dp_train_step(cfg, mesh, global_batch, seed,
+                                            sync=grad_sync != "none")
     if cfg.mesh is None:
         cfg = dataclasses.replace(cfg, mesh=mesh)
     model = TransformerLM(cfg)
@@ -827,14 +834,19 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
 
 
 def _make_bucketed_dp_train_step(cfg: TransformerConfig, mesh: Mesh,
-                                 global_batch: int, seed: int = 0):
+                                 global_batch: int, seed: int = 0,
+                                 *, sync: bool = True):
     """Pure data-parallel train step with explicit comm/compute overlap:
     the whole step runs under shard_map, per-device grads are reduced by
     collectives.GradientBucketer in reverse layer order (last-layer
     buckets launch while earlier layers still differentiate), and the
     replicated optimizer applies locally. Parameters are replicated on a
     pure-dp mesh, so state/step signatures match the GSPMD path
-    (state replicated, batch sharded over dcn×dp)."""
+    (state replicated, batch sharded over dcn×dp).
+
+    ``sync=False`` deletes the gradient collectives (grad_sync="none"):
+    the identical program minus the reduction, for isolating exposed
+    collective time in phase-breakdown measurements."""
     from distributed_tensorflow_tpu.cluster.topology import \
         data_axes as mesh_data_axes
     from distributed_tensorflow_tpu.parallel.collectives import (
@@ -880,8 +892,9 @@ def _make_bucketed_dp_train_step(cfg: TransformerConfig, mesh: Mesh,
         # so grads sync as a bucketed MEAN allreduce
         loss, grads = jax.value_and_grad(loss_fn)(state["params"],
                                                   batch["tokens"])
-        grads = bucketer.all_reduce(grads, op=ReduceOp.MEAN)
-        loss = collectives_all_reduce(loss, data_axes, ReduceOp.MEAN)
+        if sync:
+            grads = bucketer.all_reduce(grads, op=ReduceOp.MEAN)
+            loss = collectives_all_reduce(loss, data_axes, ReduceOp.MEAN)
         updates, opt_state = tx.update(grads, state["opt_state"],
                                        state["params"])
         params = optax.apply_updates(state["params"], updates)
@@ -965,6 +978,19 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
             f"(global_batch/num_microbatches = {mb}) divisible by "
             f"dp={n_dp}; raise global_batch or lower num_microbatches")
     per_stage = cfg.n_layers // n_stages
+    # One pipeline.schedule event per built step: the compiled schedule
+    # is a single fused program, so the trace assembler renders its
+    # analytic per-stage timeline (pipeline.schedule_spans) from this
+    # record next to the measured step spans.
+    from distributed_tensorflow_tpu import telemetry as _telemetry
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        bubble_fraction as _bubble)
+    _telemetry.event("pipeline.schedule", schedule=schedule,
+                     n_stages=int(n_stages),
+                     n_micro=int(num_microbatches),
+                     bubble_fraction=round(_bubble(n_stages,
+                                                   num_microbatches,
+                                                   schedule), 6))
     # inside the shard_map region blocks run per-shard: no nested
     # sharding machinery, direct attention kernel
     cfg_local = dataclasses.replace(cfg, mesh=None)

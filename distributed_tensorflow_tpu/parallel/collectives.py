@@ -398,6 +398,25 @@ class GradientBucketer:
                 outer_axis=self.outer_axis, op=op)
         return all_reduce(flat, self.axis_names, op)
 
+    def plan_summary(self, leaves: Sequence) -> "list[dict]":
+        """Human/bench-readable view of :meth:`plan`: one dict per
+        bucket with ``{"leaves": n, "bytes": b, "dtype": name}`` in
+        launch order. ``tools/trace_report.py`` and bench rows report
+        these so the overlap numbers can be checked against the actual
+        bucket schedule."""
+        sizes = [int(np.prod(jnp.shape(x))) if jnp.shape(x) else 1
+                 for x in leaves]
+        dtypes = [jnp.result_type(x) for x in leaves]
+        out = []
+        for bucket in plan_buckets(sizes, dtypes, self.bytes_per_pack,
+                                   reverse=self.reverse):
+            dt = jnp.dtype(dtypes[bucket[0]])
+            out.append({"leaves": len(bucket),
+                        "bytes": sum(sizes[i] * dt.itemsize
+                                     for i in bucket),
+                        "dtype": dt.name})
+        return out
+
     def all_reduce(self, tree, op: ReduceOp | str = ReduceOp.SUM):
         """Bucketed allreduce of a pytree (the gradient-sync shape)."""
         op = ReduceOp.from_any(op)
@@ -416,6 +435,51 @@ class GradientBucketer:
                 out[i] = jnp.reshape(reduced[off:off + size], shape)
                 off += size
         return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def simulate_overlap(ready_s: Sequence[float], dur_s: Sequence[float],
+                     backward_end_s: float | None = None) -> dict:
+    """Model the overlapped bucket schedule and account its win.
+
+    ``ready_s[i]`` is when backprop has produced bucket *i*'s gradients
+    (so its collective may launch); ``dur_s[i]`` is that bucket's
+    reduction time. Buckets run on ONE communication channel in launch
+    order (the wire serializes), each starting at
+    ``max(ready, previous bucket's finish)`` — the Horovod/DDP fusion
+    buffer model. ``backward_end_s`` defaults to the last ready time.
+
+    Returns::
+
+        {"serial_s":   sum of dur_s (what an unoverlapped tail sync
+                       would add to the step),
+         "exposed_s":  how far the last bucket finishes past the end of
+                       backward — the part that actually extends the
+                       critical path,
+         "overlap_eff": 1 - exposed/serial (1.0 = fully hidden),
+         "finish_s":   per-bucket finish times}
+
+    This is the hand-checkable counterpart of the *measured* overlap
+    efficiency (bench.py times the full / sync-free / collective-only
+    steps); tests pin this model against a hand-computed 2-bucket
+    schedule.
+    """
+    if len(ready_s) != len(dur_s):
+        raise ValueError(f"{len(ready_s)} ready times vs "
+                         f"{len(dur_s)} durations")
+    finish: list[float] = []
+    t = 0.0
+    for ready, dur in zip(ready_s, dur_s):
+        t = max(float(ready), t) + float(dur)
+        finish.append(t)
+    serial = float(sum(dur_s))
+    bwd_end = (float(backward_end_s) if backward_end_s is not None
+               else (max(ready_s) if ready_s else 0.0))
+    exposed = max(0.0, (finish[-1] if finish else 0.0) - bwd_end)
+    eff = None
+    if serial > 0:
+        eff = max(0.0, min(1.0, 1.0 - exposed / serial))
+    return {"serial_s": serial, "exposed_s": exposed,
+            "overlap_eff": eff, "finish_s": finish}
 
 
 # ---------------------------------------------------------------------------

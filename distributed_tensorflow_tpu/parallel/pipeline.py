@@ -103,6 +103,72 @@ def bubble_fraction(n_stages: int, n_micro: int,
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
+def schedule_spans(n_stages: int, n_micro: int, schedule: str = "gpipe",
+                   *, t_cycle_s: float = 1.0) -> "list[list[dict]]":
+    """Analytic per-stage busy spans of one pipeline step.
+
+    The compiled schedule runs as ONE fused XLA program — individual
+    stage activity is invisible to host-side telemetry — so the trace
+    renders the schedule's *analytic* timeline instead: per stage, the
+    list of busy intervals ``{"t0": s, "t1": s, "kind": "fwd"|"bwd"|
+    "fwd+bwd"}`` in units of ``t_cycle_s`` (one pipeline cycle; for
+    1F1B a cycle holds one forward AND one backward, for GPipe's
+    forward sweep one forward — measured step time / total cycles gives
+    the real scale). ``tools/trace_report.py --pipeline`` turns these
+    into synthetic stage tracks next to the measured spans.
+
+    The derived idle share matches :func:`bubble_fraction` exactly
+    (regression-tested), so the rendered bubbles are the formula, drawn.
+    """
+    s, m = int(n_stages), int(n_micro)
+    if s < 1 or m < 1:
+        raise ValueError(f"need n_stages>=1 and n_micro>=1, got {s}/{m}")
+    spans: list[list[dict]] = [[] for _ in range(s)]
+
+    def busy(stage: int, tick: int, kind: str):
+        spans[stage].append({"t0": tick * t_cycle_s,
+                             "t1": (tick + 1) * t_cycle_s, "kind": kind})
+
+    if schedule == "gpipe":
+        # forward sweep: stage k runs microbatch j at tick j + k; the
+        # autodiff reverse schedule mirrors it (same bubble), so one
+        # sweep of m + s - 1 ticks IS the schedule's shape.
+        for k in range(s):
+            for j in range(m):
+                busy(k, j + k, "fwd+bwd")
+    elif schedule == "1f1b":
+        # lockstep realization (pipeline_1f1b_value_and_grad): cycle c
+        # runs forward f = c - k on stage k and backward
+        # b = c - (2 * s - 2 - k); m + 2 * (s - 1) cycles total.
+        for k in range(s):
+            for c in range(m + 2 * (s - 1)):
+                f, b = c - k, c - (2 * s - 2 - k)
+                fwd, bwd = 0 <= f < m, 0 <= b < m
+                if fwd or bwd:
+                    busy(k, c, "fwd+bwd" if fwd and bwd
+                         else "fwd" if fwd else "bwd")
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return spans
+
+
+def schedule_idle_fraction(spans: "list[list[dict]]") -> float:
+    """Idle share of a :func:`schedule_spans` timeline: 1 - busy time /
+    (stages x makespan). A cycle running only one of its two lanes
+    (``fwd`` or ``bwd`` alone in the lockstep 1F1B model) counts
+    half-busy. Equals :func:`bubble_fraction` by construction
+    (regression-tested in tests/test_pipeline.py)."""
+    if not spans:
+        return 0.0
+    end = max((sp["t1"] for row in spans for sp in row), default=0.0)
+    if end <= 0:
+        return 0.0
+    busy = sum((sp["t1"] - sp["t0"])
+               * (1.0 if sp["kind"] == "fwd+bwd" else 0.5)
+               for row in spans for sp in row)
+    return 1.0 - busy / (len(spans) * end)
+
+
 def pipeline_1f1b_value_and_grad(stage_fn: Callable, head_fn: Callable,
                                  params_local, head_params,
                                  x_microbatches, targets_microbatches,

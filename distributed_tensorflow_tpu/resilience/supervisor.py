@@ -227,6 +227,7 @@ class RecoverySupervisor:
         self.shrink_after = shrink_after
         self.min_workers = min_workers
         self._fail_streak: dict[int, int] = {}
+        self._hb_seen: dict[int, int | None] = {}
         self._telemetry_dir = telemetry_dir
         self._dir = work_dir or tempfile.mkdtemp(prefix="dtx_supervisor_")
         os.makedirs(self._dir, exist_ok=True)
@@ -268,20 +269,28 @@ class RecoverySupervisor:
         return env
 
     def _clear_heartbeats(self):
+        self._hb_seen: dict[int, int | None] = {}
         for i in range(self._num_workers):
             try:
                 os.unlink(elastic.heartbeat_path(self._dir, i))
             except OSError:
                 pass
 
-    def _heartbeat(self, worker: int) -> tuple[float, int | None] | None:
-        """(mtime, step) of a worker's heartbeat file, None if absent."""
+    def _heartbeat(self, worker: int) \
+            -> "tuple[float, int | None, float | None] | None":
+        """(mtime, step, worker_wall) of a worker's heartbeat file, None
+        if absent. ``worker_wall`` is the worker's own wall-clock reading
+        at write time (see cluster/elastic.heartbeat); older single-token
+        files parse with wall None."""
         path = elastic.heartbeat_path(self._dir, worker)
         try:
             mtime = os.path.getmtime(path)
             with open(path) as f:
-                text = f.read().strip()
-            return mtime, int(text) if text else None
+                parts = f.read().split()
+            step = int(parts[0]) if parts and parts[0].isdigit() else None
+            wall = (float(parts[-1])
+                    if parts and "." in parts[-1] else None)
+            return mtime, step, wall
         except (OSError, ValueError):
             return None
 
@@ -356,6 +365,7 @@ class RecoverySupervisor:
                     for k, c in sorted(bad.items())]
             if len(exits) == runner.num_tasks:
                 return None
+            self._observe_heartbeats()
             self._fire_due_kills(exits)
             stalled = self._check_stall(exits, t0)
             if stalled is not None:
@@ -367,6 +377,26 @@ class RecoverySupervisor:
                     detail=f"generation exceeded "
                            f"{self._generation_timeout_s}s")]
             time.sleep(self._poll_s)
+
+    def _observe_heartbeats(self):
+        """Telemetry-only: record one ``clock.hb`` event per fresh
+        worker heartbeat, pairing the worker's self-reported wall clock
+        with the heartbeat file's mtime (this process's clock domain).
+        These pairs are how the trace assembler
+        (telemetry/trace.estimate_clock_offsets) aligns the
+        supervisor's recovery timeline with the workers' step
+        timelines. No-op without a telemetry log."""
+        if self._log is None:
+            return
+        for i in range(self._num_workers):
+            hb = self._heartbeat(i)
+            if (hb is not None and hb[1] is not None
+                    and hb[2] is not None
+                    and hb[1] != self._hb_seen.get(i)):
+                self._hb_seen[i] = hb[1]
+                self._event("clock.hb", generation=self.generation,
+                            worker=i, step=hb[1],
+                            worker_wall=hb[2], mtime=hb[0])
 
     def _fire_due_kills(self, exits):
         for rec in list(self._kills):

@@ -18,6 +18,12 @@ coordinator monitored_timer metrics + tf.summary event files, SURVEY.md
 - :mod:`stall`     — StallDetector layered on coordinator/watchdog.py:
   no step within ``factor`` x trailing median -> ``stall.suspected``
   naming the slowest worker, non-fatal.
+- :mod:`trace`     — cross-host trace assembly: every process's JSONL
+  merged into ONE Chrome-trace/Perfetto JSON with per-host clock
+  offsets estimated from barrier/heartbeat sync points, span causality
+  via ``span_id`` flow arrows, and a bottleneck classifier (input- /
+  comm- / compute- / checkpoint- / recovery-bound) with explicit
+  thresholds; rendered by ``tools/trace_report.py``.
 
 Quick start::
 
@@ -71,6 +77,16 @@ from distributed_tensorflow_tpu.telemetry.stall import (
     StallDetector,
     suspect_worker,
 )
+from distributed_tensorflow_tpu.telemetry.trace import (
+    BOTTLENECK_THRESHOLDS,
+    assemble_run,
+    assemble_trace,
+    classify_run,
+    estimate_clock_offsets,
+    overlap_efficiency,
+    trace_completeness,
+    write_trace,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Timer",
@@ -82,4 +98,7 @@ __all__ = [
     "merge_rollup", "publish_snapshot", "read_snapshots",
     "rollup_scalars",
     "StallDetector", "suspect_worker",
+    "BOTTLENECK_THRESHOLDS", "assemble_run", "assemble_trace",
+    "classify_run", "estimate_clock_offsets", "overlap_efficiency",
+    "trace_completeness", "write_trace",
 ]

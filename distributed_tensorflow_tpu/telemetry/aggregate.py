@@ -150,6 +150,30 @@ def collect_rollup(agent=None, worker_ids=None) -> dict:
     return merge_rollup(read_snapshots(agent, worker_ids))
 
 
+def phase_summary(rollup: dict) -> dict:
+    """Fleet-wide step-phase view of a rollup: the per-step phase
+    fractions StepTelemetry publishes (``training/phase/<name>_frac``
+    histograms) as count-weighted p50s, plus the worst worker's p95 and
+    the mean/min overlap efficiency across workers. The fleet answer to
+    "is anyone input/comm/checkpoint-bound?" without reading any
+    worker's event file."""
+    metrics = rollup.get("metrics", {})
+    phases: dict = {}
+    for name, entry in metrics.items():
+        if not name.startswith("training/phase/") \
+                or not name.endswith("_frac"):
+            continue
+        phase = name[len("training/phase/"):-len("_frac")]
+        phases[phase] = {k: entry[k] for k in ("p50", "p95", "count")
+                        if k in entry}
+    overlap = metrics.get("training/overlap_eff", {})
+    vals = [v for v in (overlap.get("per_worker") or {}).values()
+            if isinstance(v, (int, float))]
+    return {"phases": phases,
+            "overlap_eff": {"mean": sum(vals) / len(vals),
+                            "min": min(vals)} if vals else None}
+
+
 def rollup_scalars(rollup: dict) -> dict:
     """Flatten a rollup into TensorBoard scalar tags:
     ``fleet/<metric>/<stat> -> float``."""

@@ -73,6 +73,16 @@ class EventLog:
                                              encoding="utf-8")
         self._t0 = time.monotonic()
         self._last_t = 0.0
+        # Elastic-cluster generation stamp: a reformed cluster's restarted
+        # process appends to the SAME events-<pid>.jsonl, so the trace
+        # assembler needs each record to say which incarnation wrote it.
+        # Generation 0 (non-elastic default) stays unstamped — byte-
+        # identical records to before.
+        try:
+            from distributed_tensorflow_tpu.cluster import elastic
+            self._gen = elastic.generation()
+        except Exception:
+            self._gen = 0
         if run_id:
             self.event("run.start", run_id=run_id)
 
@@ -93,6 +103,8 @@ class EventLog:
             rec["t"] = round(t, 6)
             rec["wall"] = round(time.time(), 6)
             rec["pid"] = self.process_id
+            if self._gen:
+                rec["gen"] = self._gen
             rec.update(fields)
             self._f.write(json.dumps(rec) + "\n")
         return rec

@@ -71,6 +71,18 @@ def stack_batches(batches: Iterable):
         lambda *xs: jnp.stack(xs), *batches)
 
 
+#: Step-phase names StepTelemetry accepts (seconds within one step):
+#: host compute proper is whatever remains after the others.
+#: - ``compute``    time in the compiled step's compute (measured or
+#:   calibrated — see bench.py's phase breakdown)
+#: - ``collective`` EXPOSED gradient-collective time (host-timed sync
+#:   like the elastic worker's allgather, or calibrated residual)
+#: - ``host``       host-side callback/bookkeeping time (Model.fit
+#:   times its callback list here)
+#: - ``ckpt_block`` step-loop time blocked on checkpoint capture/commit
+STEP_PHASES = ("compute", "collective", "host", "ckpt_block")
+
+
 class StepTelemetry:
     """Per-step telemetry for a host-driven step loop.
 
@@ -87,8 +99,19 @@ class StepTelemetry:
             state, metrics = step_fn(state, loop.next())
             steps.step_completed(i, loss=metrics["loss"])
 
+    **Phase attribution:** pass ``phases={"compute": s, "collective": s,
+    ...}`` (keys from :data:`STEP_PHASES`) and optionally
+    ``overlap_eff`` (fraction of collective time hidden behind the
+    backward pass). Phases land as ``<name>_s`` fields on the
+    ``train.step`` event — ``tools/obs_report.py`` renders the per-step
+    phase table and names the bottleneck from them — and as
+    ``training/phase/<name>_frac`` histograms plus a
+    ``training/overlap_eff`` gauge in the registry, so fleet rollups
+    (telemetry/aggregate.py) carry p50/p95 phase fractions and the
+    mean/max overlap efficiency across workers.
+
     With telemetry off (no event log configured) the per-step cost is
-    three instrument updates; the event write is skipped.
+    a few instrument updates; the event write is skipped.
     """
 
     def __init__(self, infeed: "InfeedLoop | None" = None,
@@ -98,13 +121,22 @@ class StepTelemetry:
                                     "host-observed train step seconds")
         self._steps = reg.counter("training/steps_completed")
         self._loss = reg.gauge("training/last_loss")
+        self._phase_hists = {
+            name: reg.histogram(f"training/phase/{name}_frac",
+                                f"per-step {name} share of step time")
+            for name in STEP_PHASES}
+        self._overlap = reg.gauge(
+            "training/overlap_eff",
+            "fraction of collective time hidden behind backward")
         self._infeed = infeed
         self._stall = stall_detector
         self._last_t = time.monotonic()
         self._last_wait = 0.0
 
     def step_completed(self, step=None, loss=None,
-                       dur_s: float | None = None):
+                       dur_s: float | None = None,
+                       phases: "dict[str, float] | None" = None,
+                       overlap_eff: float | None = None):
         now = time.monotonic()
         if dur_s is None:
             dur_s = now - self._last_t
@@ -116,6 +148,13 @@ class StepTelemetry:
             total = self._infeed.total_wait_s
             wait_s = total - self._last_wait
             self._last_wait = total
+        if phases:
+            for name, seconds in phases.items():
+                hist = self._phase_hists.get(name)
+                if hist is not None and dur_s > 0:
+                    hist.record(seconds / dur_s)
+        if overlap_eff is not None:
+            self._overlap.set(round(float(overlap_eff), 4))
         if loss is not None:
             try:
                 loss = float(loss)
@@ -131,6 +170,11 @@ class StepTelemetry:
                 fields["loss"] = loss
             if wait_s is not None:
                 fields["infeed_wait_s"] = round(wait_s, 6)
+            if phases:
+                for name, seconds in phases.items():
+                    fields[f"{name}_s"] = round(float(seconds), 6)
+            if overlap_eff is not None:
+                fields["overlap_eff"] = round(float(overlap_eff), 4)
             telemetry.event("train.step", **fields)
         if self._stall is not None:
             self._stall.step_completed(step=step, dur_s=dur_s)

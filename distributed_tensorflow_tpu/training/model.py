@@ -23,6 +23,7 @@ build/compile inside ``strategy.scope()``.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Iterable, Sequence
 
 import jax
@@ -551,15 +552,24 @@ class Model:
                                              batch_size=batch_size,
                                              shuffle=shuffle,
                                              seed=self.seed + epoch):
+                # host-callback time is a named step phase: what the
+                # step loop spends OUTSIDE the compiled step (callback
+                # list + optional host metric readback) is the "host"
+                # share obs_report's phase table attributes.
+                cb_t0 = time.perf_counter()
                 cb_list.on_train_batch_begin(steps)
+                cb_s = time.perf_counter() - cb_t0
                 self._state, mstate = train_fn(
                     self._state, mstate, self._place(batch), full)
+                cb_t0 = time.perf_counter()
                 if batch_log_every and steps % batch_log_every == 0:
                     cb_list.on_train_batch_end(
                         steps, self._metric_results(mstate))
                 else:
                     cb_list.on_train_batch_end(steps, None)
-                step_telemetry.step_completed(global_step)
+                cb_s += time.perf_counter() - cb_t0
+                step_telemetry.step_completed(
+                    global_step, phases={"host": cb_s})
                 global_step += 1
                 steps += 1
                 if steps_per_epoch and steps >= steps_per_epoch:

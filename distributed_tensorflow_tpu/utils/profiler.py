@@ -170,7 +170,21 @@ def trace(target, logdir: str, duration_ms: int = 2000,
 @contextlib.contextmanager
 def step_marker(step: int):
     """Mark a training step boundary (StepMarker shows step time in the
-    trace viewer's overview page)."""
+    trace viewer's overview page).
+
+    **Step-number correlation contract:** the ``step_num`` recorded here
+    (and by ``Trace("...", step_num=i)`` annotations) is the SAME
+    integer the telemetry layer carries — ``StepTelemetry.
+    step_completed(step)`` / the ``step`` field of ``train.step`` JSONL
+    events. When telemetry is on, the marker additionally emits a
+    ``profiler.step_marker`` event stamped with that step, so an XPlane
+    trace (this module's output) and the framework timeline
+    (``tools/trace_report.py``'s output) can be lined up step-by-step
+    even though they come from different clocks. Regression-tested in
+    tests/test_profiler.py.
+    """
+    from distributed_tensorflow_tpu import telemetry as _telemetry
+    _telemetry.event("profiler.step_marker", step=int(step))
     with jax.profiler.StepTraceAnnotation("train", step_num=step):
         yield
 

@@ -106,25 +106,44 @@ def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
 
     for step in range(start_step, total_steps):
         elastic.heartbeat(step)
+        # Per-step phase attribution (the obs_report/trace_report phase
+        # table): compute = local fwd/bwd + optimizer apply, collective
+        # = the cross-process gradient allgather (host-driven here, so
+        # it is ENTIRELY exposed — overlap_eff 0 by construction; the
+        # compiled bucketed path in bench.py measures the overlapped
+        # counterpart), ckpt_block = step-loop time blocked on
+        # checkpoint capture/commit/snapshot.
         t0 = _time.perf_counter()
         start = (step * global_batch + pid * per_batch) % _POOL
         idx = (np.arange(per_batch) + start) % _POOL
         loss, grads = grad_fn(params, data["image"][idx],
                               data["label"][idx])
+        loss = float(loss)               # block: fwd/bwd complete
+        t1 = _time.perf_counter()
         if nproc > 1:
             grads = jax.tree_util.tree_map(
                 lambda g: np.asarray(
                     multihost_utils.process_allgather(g)).mean(0), grads)
+        t2 = _time.perf_counter()
         params, opt_state = apply_fn(params, opt_state, grads)
-        tv_events.event("train.step", step=step, loss=float(loss),
-                        dur_s=round(_time.perf_counter() - t0, 6))
+        jax.block_until_ready(params)
+        t3 = _time.perf_counter()
+        ckpt_s = 0.0
         if (step + 1) % save_every == 0:
             refresh_tracked()
             mgr.save(checkpoint_number=step + 1)
+            ckpt_s = _time.perf_counter() - t3
         elif (store is not None and snapshot_every
               and (step + 1) % snapshot_every == 0):
             refresh_tracked()
             mgr.snapshot(step + 1)   # memory-only: the cheap hot tier
+            ckpt_s = _time.perf_counter() - t3
+        tv_events.event(
+            "train.step", step=step, loss=loss,
+            dur_s=round(_time.perf_counter() - t0, 6),
+            compute_s=round((t1 - t0) + (t3 - t2), 6),
+            collective_s=round(t2 - t1, 6),
+            ckpt_block_s=round(ckpt_s, 6))
         if step % 10 == 0 and pid == 0:
             print(f"[gen {runtime.generation}] step {step}: "
                   f"loss={float(loss):.4f}")

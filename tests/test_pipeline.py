@@ -227,3 +227,49 @@ def test_transformer_1f1b_schedule_matches_gpipe(devices):
     with pytest.raises(ValueError):
         make_pipelined_train_step(cfg, mesh, 8, num_microbatches=4,
                                   schedule="interleaved-2x")
+
+
+def test_schedule_spans_idle_matches_bubble_fraction():
+    """The analytic per-stage timeline (trace rendering) and the closed
+    form are the same schedule: derived idle share == bubble_fraction
+    for both schedules across shapes, and every span sits inside the
+    schedule's makespan."""
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        bubble_fraction, schedule_idle_fraction, schedule_spans)
+    for sched in ("gpipe", "1f1b"):
+        for s, m in ((1, 4), (2, 4), (4, 8), (3, 5), (4, 16)):
+            spans = schedule_spans(s, m, sched)
+            assert len(spans) == s
+            got = schedule_idle_fraction(spans)
+            assert got == pytest.approx(bubble_fraction(s, m, sched)), \
+                (sched, s, m)
+            cycles = (m + s - 1) if sched == "gpipe" else m + 2 * (s - 1)
+            assert all(0.0 <= sp["t0"] < sp["t1"] <= cycles
+                       for row in spans for sp in row)
+    with pytest.raises(ValueError):
+        schedule_spans(2, 4, "pipedream-2bw")
+    with pytest.raises(ValueError):
+        schedule_spans(0, 4)
+
+
+def test_pipelined_step_emits_schedule_event(tmp_path, devices):
+    """make_pipelined_train_step records a pipeline.schedule telemetry
+    event (schedule, stages, microbatches, bubble fraction) — the hook
+    trace_report --pipeline renders analytic stage tracks from."""
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig, make_pipelined_train_step)
+    cfg = TransformerConfig.tiny()
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    telemetry.configure(str(tmp_path), process_id=0)
+    try:
+        make_pipelined_train_step(cfg, mesh, 8, num_microbatches=4,
+                                  schedule="1f1b")
+    finally:
+        telemetry.shutdown()
+    [ev] = [e for e in telemetry.read_events(
+        telemetry.event_log_path(str(tmp_path), 0))
+        if e["ev"] == "pipeline.schedule"]
+    assert ev["schedule"] == "1f1b"
+    assert ev["n_stages"] == 2 and ev["n_micro"] == 4
+    assert ev["bubble_fraction"] == pytest.approx(2 / 6, abs=1e-6)

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Perf trajectory over the checked-in bench history + regression gate.
+
+The repo accumulates one ``BENCH_r<NN>.json`` (driver-captured headline
+run) and ``SCALING_r<NN>.json`` (scaling curve) per round, but the
+trajectory only ever lived in commit messages. This tool renders the
+whole history as one table and gates new rounds against it::
+
+    python tools/bench_trend.py               # trajectory table
+    python tools/bench_trend.py --json        # machine-readable
+    python tools/bench_trend.py --check       # CI gate: latest round
+                                              # must hold >=90% of the
+                                              # BEST prior round, per
+                                              # metric series
+
+Series:
+
+- ``bench/<metric>`` — the headline row of each ``BENCH_r*.json``
+  (value + mfu/step-time extras when present);
+- ``scaling/<workload>/<metric>/dev<NN>[/sched]`` — every row of each
+  ``SCALING_r*.json`` keyed like tools/scaling_sweep.py's row_key.
+
+``--check`` fails (exit 1) when the LATEST round of any series drops
+more than ``--regression-frac`` (default 10%) below the best PRIOR
+round of that series. Rounds whose capture failed (rc != 0 / no parsed
+payload) are reported and skipped, never treated as zeros.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def load_bench_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
+    """``{series: {round: {"value": v, ...extras}}}`` from
+    BENCH_r*.json. The driver format wraps the headline JSON line under
+    ``parsed``; a file without a usable payload is skipped (noted under
+    the ``__skipped__`` pseudo-series)."""
+    series: dict = {"__skipped__": {}}
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            series["__skipped__"][rnd] = f"{path}: unreadable ({e})"
+            continue
+        parsed = data.get("parsed")
+        if data.get("rc", 0) != 0 or not isinstance(parsed, dict) \
+                or "metric" not in parsed:
+            series["__skipped__"][rnd] = (
+                f"{path}: rc={data.get('rc')}, no parsed headline")
+            continue
+        extra = parsed.get("extra") or {}
+        series.setdefault(f"bench/{parsed['metric']}", {})[rnd] = {
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "mfu": extra.get("mfu"),
+            "step_time_ms": extra.get("step_time_ms"),
+        }
+    return series
+
+
+def load_scaling_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from SCALING_r*.json rows."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "SCALING_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            key = (f"scaling/{row.get('workload')}/{row.get('metric')}"
+                   f"/dev{row.get('devices'):02d}")
+            if row.get("schedule"):
+                key += f"/{row['schedule']}"
+            series.setdefault(key, {})[rnd] = {
+                "value": row.get("throughput"),
+                "efficiency_pct": row.get("efficiency_pct"),
+                "overlap_eff": row.get("overlap_eff"),
+            }
+    return series
+
+
+def check_regressions(series: "dict[str, dict[int, dict]]",
+                      regression_frac: float) -> "list[str]":
+    """Latest round of each series vs the BEST prior round: a drop past
+    ``regression_frac`` is a failure. One-round series pass (nothing
+    prior to regress from)."""
+    failures = []
+    for name, rounds in sorted(series.items()):
+        if name == "__skipped__" or len(rounds) < 2:
+            continue
+        ordered = sorted(rounds)
+        latest = ordered[-1]
+        latest_v = rounds[latest].get("value")
+        prior = {r: rounds[r].get("value") for r in ordered[:-1]
+                 if isinstance(rounds[r].get("value"), (int, float))}
+        if not prior or not isinstance(latest_v, (int, float)):
+            continue
+        best_r = max(prior, key=lambda r: prior[r])
+        floor = prior[best_r] * (1.0 - regression_frac)
+        if latest_v < floor:
+            failures.append(
+                f"{name}: r{latest:02d} = {latest_v} is "
+                f"{1 - latest_v / prior[best_r]:.1%} below the best "
+                f"prior round r{best_r:02d} = {prior[best_r]} "
+                f"(allowed {regression_frac:.0%})")
+    return failures
+
+
+def render(series: "dict[str, dict[int, dict]]") -> str:
+    out = []
+    rounds_all = sorted({r for name, rs in series.items()
+                         if name != "__skipped__" for r in rs})
+    out.append("== perf trajectory ==")
+    for name, rounds in sorted(series.items()):
+        if name == "__skipped__":
+            continue
+        cells = []
+        for r in rounds_all:
+            v = rounds.get(r, {}).get("value")
+            cells.append(f"r{r:02d}={v:g}" if isinstance(
+                v, (int, float)) else f"r{r:02d}=-")
+        best = max((d["value"] for d in rounds.values()
+                    if isinstance(d.get("value"), (int, float))),
+                   default=None)
+        out.append(f"{name}")
+        out.append("  " + "  ".join(cells)
+                   + (f"  (best {best:g})" if best is not None else ""))
+        mfus = {r: d.get("mfu") for r, d in rounds.items()
+                if d.get("mfu") is not None}
+        if mfus:
+            out.append("  mfu: " + "  ".join(
+                f"r{r:02d}={v:.3f}" for r, v in sorted(mfus.items())))
+    for r, why in sorted(series.get("__skipped__", {}).items()):
+        out.append(f"skipped round r{r:02d}: {why}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=REPO,
+                    help="repo root holding BENCH_r*/SCALING_r* files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged history as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="fail when the latest round regresses "
+                         ">--regression-frac vs the best prior round")
+    ap.add_argument("--regression-frac", type=float, default=0.10,
+                    help="max allowed drop vs the best prior round "
+                         "(default 0.10)")
+    args = ap.parse_args(argv)
+
+    series = load_bench_history(args.repo)
+    series.update(load_scaling_history(args.repo))
+    real = {k: v for k, v in series.items() if k != "__skipped__" and v}
+    if not real:
+        print(f"bench_trend: no BENCH_r*/SCALING_r* history under "
+              f"{args.repo}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(series, indent=2, sort_keys=True))
+    else:
+        print(render(series))
+
+    if args.check:
+        failures = check_regressions(series, args.regression_frac)
+        if failures:
+            for msg in failures:
+                print(f"bench_trend: REGRESSION — {msg}",
+                      file=sys.stderr)
+            return 1
+        n = sum(1 for k, v in real.items() if len(v) >= 2)
+        print(f"bench_trend: OK — {len(real)} series, {n} gated "
+              f"(>=2 rounds), no regression past "
+              f"{args.regression_frac:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,22 @@ def run_kill_seed(seed: int, *, workers: int, steps: int,
                   f"tier than available ---")
             for v in violations:
                 print(f"    {v}")
+    if ok:
+        # Trace-assembler completeness (ISSUE 8): every generation's
+        # spans must be present and mergeable into ONE timeline — a
+        # SIGKILL'd worker's torn tail is tolerated, a generation-sized
+        # hole or unassemblable trace is not.
+        gate = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "trace_report.py"),
+             run_dir, "--check"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: trace assembly gate FAILED "
+                  f"(rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
     if not ok and proc.returncode != 0:
         tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
         print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")

@@ -45,6 +45,13 @@ if _REPO not in sys.path:
 
 from distributed_tensorflow_tpu.telemetry.events import (  # noqa: E402
     EventLogCorruptError, read_events)
+from distributed_tensorflow_tpu.telemetry.trace import (  # noqa: E402
+    classify_run)
+
+#: train.step phase fields (seconds) accumulated into the attribution
+#: table; emitted by StepTelemetry(phases=...) / the elastic worker.
+_PHASE_FIELDS = ("compute_s", "collective_s", "infeed_wait_s", "host_s",
+                 "ckpt_block_s")
 
 
 def _event_files(target: str) -> list[str]:
@@ -88,6 +95,10 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     steps: list[float] = []
     infeed_wait = 0.0
     step_time_total = 0.0
+    phase_totals = {k: 0.0 for k in _PHASE_FIELDS}
+    phase_seen = {k: False for k in _PHASE_FIELDS}
+    step_rows: list[dict] = []
+    overlap_effs: list[float] = []
     retries = collections.Counter()
     failures = collections.Counter()
     faults_by_site = collections.Counter()
@@ -95,6 +106,7 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     stalls: list[dict] = []
     recovery: list[dict] = []
     per_pid: dict[int, dict] = {}
+    wall_min = wall_max = None
 
     # the supervisor writes under pid "supervisor": sort keys as strings
     for pid, events in sorted(events_by_pid.items(), key=lambda kv:
@@ -103,6 +115,10 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
         pid_wait = 0.0
         for ev in events:
             name = ev.get("ev")
+            w = ev.get("wall")
+            if isinstance(w, (int, float)):
+                wall_min = w if wall_min is None else min(wall_min, w)
+                wall_max = w if wall_max is None else max(wall_max, w)
             if name == "train.step":
                 d = ev.get("dur_s")
                 if isinstance(d, (int, float)):
@@ -111,6 +127,26 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 w = ev.get("infeed_wait_s")
                 if isinstance(w, (int, float)):
                     pid_wait += w
+                    phase_totals["infeed_wait_s"] += w
+                    phase_seen["infeed_wait_s"] = True
+                row = {"pid": pid, "step": ev.get("step"),
+                       "gen": ev.get("gen", 0), "dur_s": d}
+                for k in _PHASE_FIELDS:
+                    if k == "infeed_wait_s":
+                        continue
+                    v = ev.get(k)
+                    if isinstance(v, (int, float)):
+                        phase_totals[k] += v
+                        phase_seen[k] = True
+                        row[k] = v
+                wv = ev.get("infeed_wait_s")
+                if isinstance(wv, (int, float)):
+                    row["infeed_wait_s"] = wv
+                oe = ev.get("overlap_eff")
+                if isinstance(oe, (int, float)):
+                    overlap_effs.append(oe)
+                    row["overlap_eff"] = oe
+                step_rows.append(row)
             elif name == "dispatch.retry":
                 retries[f"worker {ev.get('worker')}"] += 1
             elif name in ("dispatch.failure", "dispatch.closure_error",
@@ -143,9 +179,58 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
         ev.get("tier", "?") for ev in recovery
         if ev.get("ev") == "recovery.restore_tier"
         and ev.get("tier") != "none")      # "none" = cold start
+    mttrs = recovery_mttrs(recovery)
+
+    # -- step-phase attribution + bottleneck class (ISSUE 8) -------------
+    # checkpoint blocking is attributable two ways: the per-step
+    # ckpt_block_s phase (when the step loop emits it) and the
+    # checkpoint.save span durations (always emitted). Take the larger —
+    # they measure the same blocking from two vantage points.
+    ckpt_block = max(phase_totals["ckpt_block_s"],
+                     sum(ckpt.get("checkpoint.save", [])))
+    wall_span = ((wall_max - wall_min)
+                 if wall_min is not None and wall_max is not None else 0.0)
+    fractions = {}
+    phases_report = None
+    if step_time_total > 0:
+        fractions = {
+            "infeed": phase_totals["infeed_wait_s"] / step_time_total,
+            "collective": phase_totals["collective_s"] / step_time_total,
+            "checkpoint": ckpt_block / step_time_total,
+            "recovery": (sum(mttrs.values()) / wall_span
+                         if wall_span > 0 else 0.0),
+        }
+        if phase_seen["compute_s"]:
+            compute_frac = phase_totals["compute_s"] / step_time_total
+        else:
+            # no measured compute phase: compute is the remainder after
+            # every attributed non-compute phase
+            others = sum(phase_totals[k] for k in (
+                "collective_s", "infeed_wait_s", "host_s",
+                "ckpt_block_s"))
+            compute_frac = max(0.0, 1.0 - others / step_time_total)
+        phases_report = {
+            "step_time_total_s": round(step_time_total, 6),
+            "fractions": {
+                "compute": round(compute_frac, 4),
+                "collective": round(fractions["collective"], 4),
+                "infeed_wait": round(fractions["infeed"], 4),
+                "host": round(phase_totals["host_s"] / step_time_total,
+                              4),
+                "ckpt_block": round(ckpt_block / step_time_total, 4),
+            },
+            "attributed": {k: phase_seen[k] for k in _PHASE_FIELDS},
+            "overlap_eff": (round(sum(overlap_effs) / len(overlap_effs),
+                                  4) if overlap_effs else None),
+        }
+    bottleneck = classify_run(fractions) if fractions else None
+
     return {
         "processes": per_pid,
         "step_time": _percentiles(steps),
+        "phases": phases_report,
+        "bottleneck": bottleneck,
+        "steps_table": step_rows,
         "infeed_wait_fraction": (round(infeed_wait / step_time_total, 4)
                                  if step_time_total > 0 else None),
         "retries": dict(retries),
@@ -168,7 +253,7 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
             "reshards": sum(1 for ev in recovery
                             if ev.get("ev") == "recovery.reshard"),
             "restore_tiers": dict(restore_tiers),
-            "mttr_s": recovery_mttrs(recovery),
+            "mttr_s": mttrs,
         } if recovery else None,
     }
 
@@ -263,6 +348,51 @@ def _fmt_recovery_line(ev: dict) -> str:
     return head + " ".join(str(p) for p in tail)
 
 
+def _render_phase_table(report: dict, out: "list[str]",
+                        max_rows: int = 40):
+    """Per-step phase table (every k-th step when the run is long) and
+    the phase-fraction summary + named bottleneck class."""
+    ph = report.get("phases")
+    if not ph:
+        return
+    fr = ph["fractions"]
+    out.append("phase attribution (fraction of total step time):")
+    out.append("  " + "  ".join(f"{k} {v:.1%}"
+                                for k, v in fr.items()))
+    if ph.get("overlap_eff") is not None:
+        out.append(f"  collective overlap efficiency "
+                   f"{ph['overlap_eff']:.1%} (share of collective time "
+                   f"hidden behind backward)")
+    rows = [r for r in report.get("steps_table", [])
+            if any(k in r for k in _PHASE_FIELDS)]
+    if rows:
+        stride = max(1, (len(rows) + max_rows - 1) // max_rows)
+        if stride > 1:
+            out.append(f"per-step phases (every {stride}th step of "
+                       f"{len(rows)}):")
+        else:
+            out.append("per-step phases:")
+        hdr = (f"  {'pid':>4} {'gen':>3} {'step':>6} {'dur':>9} "
+               f"{'compute':>9} {'collect':>9} {'infeed':>9} "
+               f"{'host':>9} {'ckpt':>9}")
+        out.append(hdr)
+        for r in rows[::stride]:
+            def cell(key):
+                v = r.get(key)
+                return _fmt_ms(v) if isinstance(v, (int, float)) else "-"
+            out.append(
+                f"  {str(r['pid']):>4} {r.get('gen', 0):>3} "
+                f"{str(r.get('step', '-')):>6} {cell('dur_s'):>9} "
+                f"{cell('compute_s'):>9} {cell('collective_s'):>9} "
+                f"{cell('infeed_wait_s'):>9} {cell('host_s'):>9} "
+                f"{cell('ckpt_block_s'):>9}")
+    b = report.get("bottleneck")
+    if b:
+        why = ("; ".join(b["reasons"]) if b["reasons"]
+               else "no phase exceeded its threshold")
+        out.append(f"bottleneck: {b['class']} ({why})")
+
+
 def render_text(report: dict, rollup: dict) -> str:
     out = []
     st = report["step_time"]
@@ -276,6 +406,7 @@ def render_text(report: dict, rollup: dict) -> str:
     if report["infeed_wait_fraction"] is not None:
         out.append(f"infeed wait {report['infeed_wait_fraction']:.1%} "
                    f"of step time")
+    _render_phase_table(report, out)
     for pid, info in sorted(report["processes"].items(),
                             key=lambda kv: str(kv[0])):
         p = info["step_time"]
@@ -330,12 +461,28 @@ def render_text(report: dict, rollup: dict) -> str:
     return "\n".join(out)
 
 
+def _events_by_pid(files: "list[str]") -> dict:
+    """{pid: events} keyed by the events-<pid>.jsonl suffix (numeric ids
+    as ints, the supervisor as the string "supervisor")."""
+    import re
+    out: dict = {}
+    for path in files:
+        m = re.search(r"events-([A-Za-z0-9_]+)\.jsonl$", path)
+        suffix = m.group(1) if m else str(len(out))
+        pid = int(suffix) if suffix.isdigit() else suffix
+        out[pid] = read_events(path)
+    return out
+
+
 def check(target: str, require: "list[str] | None" = None,
-          mttr_budget: "float | None" = None) -> int:
+          mttr_budget: "float | None" = None,
+          expect_bottleneck: "str | None" = None,
+          forbid_bottleneck: "list[str] | None" = None) -> int:
     """Validate every event file; 0 = ok (torn tails reported but
     tolerated), 1 = corrupt/malformed, a ``require``d event is absent
-    from the whole run, or a recovery's MTTR exceeded ``mttr_budget``
-    seconds; 2 = nothing to check."""
+    from the whole run, a recovery's MTTR exceeded ``mttr_budget``
+    seconds, or the run's bottleneck class violates
+    ``expect_bottleneck``/``forbid_bottleneck``; 2 = nothing to check."""
     files = _event_files(target)
     if not files:
         print(f"obs_report --check: no events-*.jsonl under {target}",
@@ -378,6 +525,29 @@ def check(target: str, require: "list[str] | None" = None,
                 rc = 1
             else:
                 print(line)
+    if expect_bottleneck or forbid_bottleneck:
+        try:
+            report = summarize(_events_by_pid(files))
+        except EventLogCorruptError:
+            return 1                    # already reported above
+        b = report.get("bottleneck")
+        cls = b["class"] if b else None
+        detail = ("; ".join(b["reasons"]) if b and b["reasons"]
+                  else "no threshold tripped")
+        if cls is None:
+            print("BOTTLENECK no train.step events: class "
+                  "unclassifiable", file=sys.stderr)
+            rc = 1
+        else:
+            print(f"bottleneck class: {cls} ({detail})")
+            if expect_bottleneck and cls != expect_bottleneck:
+                print(f"BOTTLENECK expected {expect_bottleneck!r}, "
+                      f"classified {cls!r}", file=sys.stderr)
+                rc = 1
+            if forbid_bottleneck and cls in forbid_bottleneck:
+                print(f"BOTTLENECK forbidden class {cls!r} "
+                      f"({detail})", file=sys.stderr)
+                rc = 1
     return rc
 
 
@@ -399,34 +569,41 @@ def main(argv=None) -> int:
                     help="with --check: fail if any recovery's MTTR "
                          "(first worker death -> cluster restored) "
                          "exceeds this many seconds")
+    ap.add_argument("--expect-bottleneck", default=None, metavar="CLASS",
+                    help="with --check: fail unless the run classifies "
+                         "as this bottleneck class (input-bound / "
+                         "comm-bound / compute-bound / checkpoint-bound "
+                         "/ recovery-bound)")
+    ap.add_argument("--forbid-bottleneck", action="append",
+                    metavar="CLASS",
+                    help="with --check: fail when the run classifies as "
+                         "this class (repeatable) — e.g. "
+                         "--forbid-bottleneck input-bound gates a "
+                         "training fleet on host-boundedness")
     args = ap.parse_args(argv)
 
     if args.check:
         return check(args.target, require=args.require,
-                     mttr_budget=args.mttr_budget)
-    if args.require:
-        ap.error("--require only applies with --check")
-    if args.mttr_budget is not None:
-        ap.error("--mttr-budget only applies with --check")
+                     mttr_budget=args.mttr_budget,
+                     expect_bottleneck=args.expect_bottleneck,
+                     forbid_bottleneck=args.forbid_bottleneck)
+    for opt, name in ((args.require, "--require"),
+                      (args.mttr_budget, "--mttr-budget"),
+                      (args.expect_bottleneck, "--expect-bottleneck"),
+                      (args.forbid_bottleneck, "--forbid-bottleneck")):
+        if opt is not None and opt != []:
+            ap.error(f"{name} only applies with --check")
 
     files = _event_files(args.target)
     if not files:
         print(f"obs_report: no events-*.jsonl under {args.target}",
               file=sys.stderr)
         return 2
-    events_by_pid = {}
-    import re
-    for path in files:
-        # numeric suffixes are cluster process ids; the recovery
-        # supervisor writes under "supervisor"
-        m = re.search(r"events-([A-Za-z0-9_]+)\.jsonl$", path)
-        suffix = m.group(1) if m else str(len(events_by_pid))
-        pid = int(suffix) if suffix.isdigit() else suffix
-        try:
-            events_by_pid[pid] = read_events(path)
-        except EventLogCorruptError as e:
-            print(f"obs_report: {e}", file=sys.stderr)
-            return 1
+    try:
+        events_by_pid = _events_by_pid(files)
+    except EventLogCorruptError as e:
+        print(f"obs_report: {e}", file=sys.stderr)
+        return 1
     report = summarize(events_by_pid)
     rollup = read_rollup_scalars(args.target)
     if args.json:

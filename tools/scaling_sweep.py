@@ -120,6 +120,27 @@ def main() -> int:
         print("scaling_sweep: no previous SCALING_r*.json — "
               "regression gate skipped")
 
+    # gate 1b: phase breakdown + overlap (ISSUE 8) — multi-device
+    # transformer rows must carry measured attribution, not just
+    # throughput, with sane ranges
+    for r in dp_rows:
+        if r["devices"] == 1:
+            continue
+        for field in ("compute_frac", "collective_frac",
+                      "infeed_wait_frac", "overlap_eff"):
+            if field not in r:
+                failures.append(f"{row_key(r)}: missing phase field "
+                                f"{field!r}")
+        eff = r.get("overlap_eff")
+        if eff is not None and not (0.0 <= eff <= 1.0):
+            failures.append(f"{row_key(r)}: overlap_eff {eff} outside "
+                            f"[0, 1]")
+        cf, xf = r.get("compute_frac"), r.get("collective_frac")
+        if isinstance(cf, (int, float)) and isinstance(xf, (int, float)) \
+                and cf + xf > 1.02:
+            failures.append(f"{row_key(r)}: compute_frac {cf} + "
+                            f"collective_frac {xf} > 1")
+
     # gate 3: scaling.* telemetry wiring
     os.environ.setdefault("JAX_PLATFORMS", "cpu")   # import-safe off-TPU
     from distributed_tensorflow_tpu import telemetry

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Assemble a run's per-process event logs into ONE Chrome-trace JSON
+and summarize the merged timeline.
+
+Usage::
+
+    python tools/trace_report.py RUN_DIR                # write + summary
+    python tools/trace_report.py RUN_DIR -o out.json    # explicit output
+    python tools/trace_report.py RUN_DIR --check        # CI gate
+    python tools/trace_report.py RUN_DIR --pipeline     # synthetic
+                                                        # stage tracks
+
+``RUN_DIR`` is a telemetry directory (``DTX_TELEMETRY_DIR`` /
+``telemetry.configure``): one ``events-<pid>.jsonl`` per process plus
+the recovery supervisor's ``events-supervisor.jsonl``. The merged trace
+lands at ``<RUN_DIR>/trace.json`` by default — open it at
+https://ui.perfetto.dev or ``chrome://tracing``. Per-host clocks are
+aligned from the run's own sync points (barrier-release ``clock.sync``
+events + supervisor heartbeat ``clock.hb`` observations — see
+telemetry/trace.py); spans sharing a ``span_id`` (dispatched closures,
+tiered checkpoint commits) render as flow arrows.
+
+``--check`` is the CI gate ``chaos_sweep --kill`` runs per seed: exit
+non-zero when any event file is corrupt mid-file (torn FINAL lines from
+SIGKILL'd writers are tolerated and reported), when a cluster
+generation left no mergeable worker events (the timeline has a hole),
+or when the assembled trace is not valid JSON.
+
+``--pipeline`` appends synthetic per-stage tracks derived from any
+``pipeline.schedule`` events in the run (the compiled schedule is one
+fused XLA program, so stage activity is analytic — see
+parallel/pipeline.schedule_spans).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributed_tensorflow_tpu.telemetry import events as tv_events  # noqa: E402
+from distributed_tensorflow_tpu.telemetry import trace as tv_trace  # noqa: E402
+
+
+def _torn_tails(run_dir: str) -> "list[str]":
+    import glob
+    out = []
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "events-*.jsonl"))):
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                lines = [ln for ln in f.read().split("\n") if ln]
+            if lines:
+                json.loads(lines[-1])
+        except ValueError:
+            out.append(path)
+    return out
+
+
+def _pipeline_tracks(events_by_pid: dict, trace: dict):
+    """Append synthetic per-stage tracks for every pipeline.schedule
+    event, scaled so one schedule spans the median measured step."""
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        schedule_spans)
+    scheds = [ev for events in events_by_pid.values() for ev in events
+              if ev.get("ev") == "pipeline.schedule"]
+    if not scheds:
+        return 0
+    step_durs = sorted(
+        ev["dur_s"] for events in events_by_pid.values() for ev in events
+        if ev.get("ev") == "train.step"
+        and isinstance(ev.get("dur_s"), (int, float)))
+    step_s = step_durs[len(step_durs) // 2] if step_durs else 1.0
+    n = 0
+    for k, ev in enumerate(scheds):
+        s, m = ev.get("n_stages", 1), ev.get("n_micro", 1)
+        sched = ev.get("schedule", "gpipe")
+        cycles = (m + s - 1) if sched == "gpipe" else (m + 2 * (s - 1))
+        spans = schedule_spans(s, m, sched,
+                               t_cycle_s=step_s / max(1, cycles))
+        pid = tv_trace._SYNTHETIC_PID_BASE + 1000 + k
+        trace["traceEvents"].append(
+            {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": f"pipeline schedule {sched} "
+                              f"(pp={s}, m={m}, analytic)"}})
+        for stage, row in enumerate(spans):
+            trace["traceEvents"].append(
+                {"ph": "M", "pid": pid, "tid": stage + 1,
+                 "name": "thread_name",
+                 "args": {"name": f"stage {stage}"}})
+            for sp in row:
+                trace["traceEvents"].append(
+                    {"ph": "X", "pid": pid, "tid": stage + 1,
+                     "name": sp["kind"], "cat": "pipeline",
+                     "ts": round(sp["t0"] * 1e6, 3),
+                     "dur": round((sp["t1"] - sp["t0"]) * 1e6, 3),
+                     "args": {"schedule": sched}})
+                n += 1
+    return n
+
+
+def summarize_trace(run_dir: str) -> dict:
+    """Everything --check and the text summary need, in one read."""
+    events_by_pid = tv_events.read_run(run_dir)
+    offsets = tv_trace.estimate_clock_offsets(events_by_pid)
+    completeness = tv_trace.trace_completeness(events_by_pid)
+    return {"events_by_pid": events_by_pid, "offsets": offsets,
+            "completeness": completeness,
+            "torn_tails": _torn_tails(run_dir)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("target", help="telemetry run directory")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output trace path (default "
+                         "<RUN_DIR>/trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: corrupt files / missing generations "
+                         "/ unassemblable trace exit non-zero")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="append analytic per-stage pipeline tracks "
+                         "for pipeline.schedule events")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.target):
+        print(f"trace_report: {args.target} is not a directory",
+              file=sys.stderr)
+        return 2
+    try:
+        info = summarize_trace(args.target)
+    except tv_events.EventLogCorruptError as e:
+        print(f"trace_report: CORRUPT event log: {e}", file=sys.stderr)
+        return 1
+    events_by_pid = info["events_by_pid"]
+    if not events_by_pid:
+        print(f"trace_report: no events-*.jsonl under {args.target}",
+              file=sys.stderr)
+        return 2
+
+    trace = tv_trace.assemble_trace(
+        events_by_pid, offsets=info["offsets"],
+        run_id=os.path.basename(os.path.normpath(args.target)))
+    n_pipeline = (_pipeline_tracks(events_by_pid, trace)
+                  if args.pipeline else 0)
+    out_path = args.out or os.path.join(args.target, "trace.json")
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+        f.write("\n")
+
+    comp = info["completeness"]
+    meta = trace["otherData"]
+    summary = {
+        "trace": out_path,
+        "processes": meta["processes"],
+        "events": sum(len(v) for v in events_by_pid.values()),
+        "flow_links": meta["flow_links"],
+        "clock_offsets_s": meta["clock_offsets_s"],
+        "clock_unaligned": meta["clock_unaligned"],
+        "generations": comp["generations"],
+        "missing_generations": comp["missing"],
+        "torn_tails": info["torn_tails"],
+        "pipeline_spans": n_pipeline,
+    }
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(f"trace written: {out_path}")
+        print(f"  processes: {', '.join(meta['processes'])}")
+        print(f"  events: {summary['events']}  "
+              f"flow links: {meta['flow_links']}")
+        offs = ", ".join(f"p{p}={v * 1e3:+.2f}ms"
+                         for p, v in meta["clock_offsets_s"].items())
+        print(f"  clock offsets vs reference: {offs}"
+              + (f"  (unaligned: {meta['clock_unaligned']})"
+                 if meta["clock_unaligned"] else ""))
+        for g, d in comp["generations"].items():
+            print(f"  gen {g}: {d['worker_events']} worker events "
+                  f"from pids {d['pids']}")
+        for path in info["torn_tails"]:
+            print(f"  torn tail tolerated: {path}")
+        if n_pipeline:
+            print(f"  pipeline: {n_pipeline} analytic stage spans")
+        print("  open at https://ui.perfetto.dev or chrome://tracing")
+
+    if args.check:
+        rc = 0
+        if comp["missing"]:
+            print(f"trace_report: INCOMPLETE — generations "
+                  f"{comp['missing']} left no mergeable worker events",
+                  file=sys.stderr)
+            rc = 1
+        try:
+            with open(out_path, "r", encoding="utf-8") as f:
+                json.load(f)
+        except ValueError as e:
+            print(f"trace_report: assembled trace is not valid JSON: "
+                  f"{e}", file=sys.stderr)
+            rc = 1
+        if rc == 0:
+            print(f"trace check ok: {len(meta['processes'])} processes, "
+                  f"generations {sorted(comp['generations'])} all "
+                  f"mergeable"
+                  + (f", {len(info['torn_tails'])} torn tail(s) "
+                     f"tolerated" if info["torn_tails"] else ""))
+        return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
