@@ -663,6 +663,142 @@ def run_scaling(out_path: str | None = None, max_devices: int | None = None):
     return result
 
 
+def run_serving(out_path: str | None = None, *, qps: float | None = None,
+                n_requests: int | None = None, seed: int = 0):
+    """Request-level serving bench (ISSUE 9): p50/p99 end-to-end latency
+    and generated tokens/s at a target QPS through the continuous-
+    batching engine (serving/engine.py).
+
+    Arrival schedule: seeded Poisson process at ``qps`` (exponential
+    interarrivals from one ``random.Random`` stream — identical
+    schedule every run at a given seed), driven closed-loop: the bench
+    thread both injects due arrivals and turns the engine crank, so a
+    request's measured latency includes its queueing delay when the
+    engine falls behind the schedule. Greedy decode, mixed prompt and
+    output lengths (the block-allocated cache's reason to exist).
+
+    Emits one JSON row (and a ``serving.row`` telemetry event);
+    ``--out`` additionally writes the SERVING_r*.json shape
+    tools/serve_sweep.py gates and tools/bench_trend.py trends.
+    """
+    import random as _random
+
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.serving import InferenceEngine, Request
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig.transformer_big(max_seq_len=1024,
+                                                scan_layers=False)
+        n_requests = n_requests or 48
+        qps = qps or 8.0
+        engine_kw = dict(num_blocks=1024, block_size=16, max_slots=16,
+                         max_prompt_len=128)
+        prompt_range, new_range = (16, 128), (16, 64)
+    else:
+        cfg = TransformerConfig.tiny(max_seq_len=64)
+        n_requests = n_requests or 24
+        qps = qps or 40.0
+        engine_kw = dict(num_blocks=64, block_size=8, max_slots=8,
+                         max_prompt_len=16)
+        prompt_range, new_range = (4, 16), (4, 12)
+
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = InferenceEngine(cfg, params,
+                             queue_capacity=n_requests + 1, **engine_kw)
+
+    rng = _random.Random(f"dtx-serve-bench:{seed}")
+    workload = []
+    for i in range(n_requests):
+        plen = rng.randrange(*prompt_range)
+        workload.append(Request(
+            id=f"b{i:04d}",
+            tokens=tuple(rng.randrange(cfg.vocab_size)
+                         for _ in range(plen)),
+            max_new_tokens=rng.randrange(*new_range)))
+    arrivals, t = [], 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(qps)
+        arrivals.append(t)
+
+    # warm both compiled programs (prefill + decode) off the clock
+    engine.generate([[1, 2, 3]], max_new_tokens=2)
+
+    done: dict[str, dict] = {}
+    pending = list(zip(arrivals, workload))
+    t0 = time.perf_counter()
+    arrival_wall: dict[str, float] = {}
+    while len(done) < n_requests:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            due, req = pending.pop(0)
+            engine.submit(req)
+            arrival_wall[req.id] = due
+        if engine.scheduler.idle:
+            if pending:                       # ahead of schedule: wait
+                time.sleep(max(0.0, pending[0][0] - now))
+            continue
+        for rec in engine.step():
+            if rec["id"] in arrival_wall:
+                # latency vs the SCHEDULED arrival (includes any lag
+                # between due time and actual submission)
+                rec["latency_s"] = ((time.perf_counter() - t0)
+                                    - arrival_wall[rec["id"]])
+                done[rec["id"]] = rec
+    span = time.perf_counter() - t0
+
+    lats = sorted(r["latency_s"] for r in done.values())
+    ttfts = sorted(r["ttft_s"] for r in done.values()
+                   if r.get("ttft_s") is not None)
+
+    def pct(vals, q):
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))] \
+            if vals else None
+
+    new_tokens = sum(len(r["tokens"]) for r in done.values()
+                     if r.get("tokens"))
+    stats = engine.stats()
+    row = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(new_tokens / span, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "backend": backend,
+            "n_requests": n_requests,
+            "qps_target": qps,
+            "qps_achieved": round(n_requests / span, 2),
+            "p50_latency_ms": round(pct(lats, 0.50) * 1e3, 2),
+            "p99_latency_ms": round(pct(lats, 0.99) * 1e3, 2),
+            "p50_ttft_ms": (round(pct(ttfts, 0.50) * 1e3, 2)
+                            if ttfts else None),
+            "tokens_generated": new_tokens,
+            "serve_steps": stats["steps"],
+            "preemptions": stats["preemptions"],
+            "max_slots": engine.max_slots,
+            "num_blocks": engine.cache_cfg.num_blocks,
+            "block_size": engine.cache_cfg.block_size,
+            "seed": seed,
+        },
+    }
+    telemetry.event("serving.row", metric=row["metric"],
+                    value=row["value"],
+                    **{k: v for k, v in row["extra"].items()
+                       if isinstance(v, (int, float, str))})
+    print(json.dumps(row))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "serving", "backend": backend,
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": [row]}, f, indent=1)
+            f.write("\n")
+    return row
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -782,7 +918,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
-                                 "input_pipeline", "scaling"],
+                                 "input_pipeline", "scaling", "serving"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -791,14 +927,28 @@ if __name__ == "__main__":
                         help="run the device-count scaling curve "
                              "(tokens/s and images/s vs {1,2,4,8} "
                              "devices + pipeline-schedule rows)")
+    parser.add_argument("--serving", action="store_true",
+                        help="run the request-level serving bench "
+                             "(p50/p99 latency + tokens/s at --qps "
+                             "through the continuous-batching engine)")
+    parser.add_argument("--qps", type=float, default=None,
+                        help="with --serving: target arrival rate")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="with --serving: workload size")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="with --serving: arrival-schedule seed")
     parser.add_argument("--out", default=None,
-                        help="with --scaling: also write the full JSON "
-                             "curve (e.g. SCALING_r06.json)")
+                        help="with --scaling/--serving: also write the "
+                             "full JSON (e.g. SCALING_r06.json / "
+                             "SERVING_r01.json)")
     parser.add_argument("--max-devices", type=int, default=None,
                         help="with --scaling: cap the device sweep")
     args = parser.parse_args()
     if args.scaling or args.workload == "scaling":
         run_scaling(out_path=args.out, max_devices=args.max_devices)
+    elif args.serving or args.workload == "serving":
+        run_serving(out_path=args.out, qps=args.qps,
+                    n_requests=args.requests, seed=args.seed)
     elif args.workload == "resnet50":
         run_resnet50()
     elif args.workload == "bert":

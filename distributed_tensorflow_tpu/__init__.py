@@ -118,6 +118,7 @@ from distributed_tensorflow_tpu.cluster.coordination import (
 )
 from distributed_tensorflow_tpu import resilience
 from distributed_tensorflow_tpu.resilience import RetryPolicy
+from distributed_tensorflow_tpu import serving
 from distributed_tensorflow_tpu.utils import bfloat16
 from distributed_tensorflow_tpu.utils import summary
 from distributed_tensorflow_tpu.utils import tensor_tracer

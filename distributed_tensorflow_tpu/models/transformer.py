@@ -234,7 +234,7 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lengths=None):
         cfg = self.cfg
         B, S, D = x.shape
         H, hd = cfg.n_heads, cfg.head_dim
@@ -253,7 +253,19 @@ class MultiHeadAttention(nn.Module):
         k = rotary_embedding(proj("key"), seq_axis=-2)
         v = proj("value")
         mesh = cfg.mesh
-        if (mesh is not None and "sp" in mesh.shape
+        if lengths is not None:
+            # Right-padded mixed-length batch (serving prefill, BERT
+            # over variable-length inputs): the ONE factored mask rule
+            # (ops/attention.length_valid_mask) that the KV-cache
+            # incremental decode also applies — full recompute and
+            # cached decode mask identically by construction. The flash
+            # kernels take no per-row length, so this path runs the
+            # unfused reference; serving prefill shapes are
+            # latency-bound, not HBM-bound.
+            from distributed_tensorflow_tpu.ops.attention import (
+                mha_reference)
+            o = mha_reference(q, k, v, causal=cfg.causal, lengths=lengths)
+        elif (mesh is not None and "sp" in mesh.shape
                 and mesh.shape["sp"] > 1):
             # Sequence-parallel path: ring attention over the sp axis
             # (reference has no SP at all — SURVEY.md §5.7).
@@ -349,10 +361,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, _=None):
+    def __call__(self, x, lengths=None):
         cfg = self.cfg
         x = x + MultiHeadAttention(cfg, name="attn")(
-            RMSNorm(cfg.dtype, mesh=cfg.mesh)(x))
+            RMSNorm(cfg.dtype, mesh=cfg.mesh)(x), lengths)
         h = RMSNorm(cfg.dtype, mesh=cfg.mesh)(x)
         if cfg.moe_experts > 0:
             from distributed_tensorflow_tpu.parallel.moe import (
@@ -376,7 +388,12 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, return_hidden=False):
+    def __call__(self, tokens, return_hidden=False, lengths=None):
+        """``lengths`` (B,) marks a right-padded mixed-length batch:
+        every layer's attention masks padded keys via the factored
+        ``ops.attention.length_valid_mask`` rule (the full-sequence
+        recompute side of the serving KV-cache correctness contract).
+        None (the default) is the historical full-sequence behavior."""
         cfg = self.cfg
         embed = param_with_axes(
             "embed", nn.initializers.normal(0.02),
@@ -413,10 +430,10 @@ class TransformerLM(nn.Module):
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
                 axis_name="layers",
-            )(cfg, name="layers")(x, None)
+            )(cfg, name="layers")(x, lengths)
         else:
             for i in range(cfg.n_layers):
-                x, _ = block(cfg, name=f"layer_{i}")(x, None)
+                x, _ = block(cfg, name=f"layer_{i}")(x, lengths)
 
         x = RMSNorm(cfg.dtype, mesh=cfg.mesh, name="final_norm")(x)
         if return_hidden:

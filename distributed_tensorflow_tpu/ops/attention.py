@@ -28,17 +28,65 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 # Reference implementation (also the CPU fallback)
 # ---------------------------------------------------------------------------
 
+def length_valid_mask(lengths, q_len: int, kv_len: int, *,
+                      causal: bool = False, causal_offset: int | None = None,
+                      q_positions=None):
+    """Validity mask for right-padded mixed-length batches — the ONE
+    masking rule shared by full-sequence recompute (``mha_reference``)
+    and the serving engine's incremental KV-cache decode
+    (serving/decode.py). Keeping both sides on this function is the
+    correctness contract that makes cached decode match full recompute.
+
+    ``lengths``: (B,) true sequence lengths. Query ``i`` of sequence
+    ``b`` may see key ``j`` iff both lie inside the sequence
+    (``i < lengths[b]`` — via ``q_positions`` when the queries are a
+    window into a longer cache — and ``j < lengths[b]``) and, under
+    ``causal``, ``j <= i + causal_offset`` (offset defaults to
+    ``kv_len - q_len``: bottom-right alignment, the incremental-decode
+    case where the single query row sits at the END of the cache).
+
+    Returns (B, 1, q_len, kv_len) bool.
+    """
+    if causal_offset is None:
+        # explicit q_positions are ABSOLUTE cache positions: query p sees
+        # key j iff j <= p, no alignment offset
+        causal_offset = 0 if q_positions is not None else kv_len - q_len
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if q_positions is None:
+        q_ids = jnp.arange(q_len, dtype=jnp.int32)[None, :]     # (1, q)
+    else:
+        q_ids = jnp.asarray(q_positions, jnp.int32)
+        if q_ids.ndim == 1:
+            q_ids = q_ids[:, None]                              # (B, q=1)
+    k_ids = jnp.arange(kv_len, dtype=jnp.int32)
+    valid = ((q_ids[:, :, None] < lengths[:, None, None])
+             & (k_ids[None, None, :] < lengths[:, None, None]))
+    if causal:
+        valid = valid & (k_ids[None, None, :]
+                         <= q_ids[:, :, None] + causal_offset)
+    return valid[:, None]                                       # (B,1,q,k)
+
+
 def mha_reference(q, k, v, *, causal: bool = False, sm_scale: float | None = None,
-                  segment_ids=None):
-    """Unfused attention — the semantics contract for the Pallas kernels."""
+                  segment_ids=None, lengths=None, q_positions=None):
+    """Unfused attention — the semantics contract for the Pallas kernels.
+
+    ``lengths`` (B,) masks a right-padded mixed-length batch via
+    :func:`length_valid_mask`: padded keys are invisible to every query
+    and fully-padded query rows output 0. ``q_positions`` places the
+    queries at explicit cache positions (incremental decode: one query
+    at position ``lengths-1`` against a longer key buffer)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * sm_scale
     valid = None
-    if causal:
+    if causal and lengths is None:
         qs, ks = q.shape[2], k.shape[2]
         valid = jnp.tril(jnp.ones((qs, ks), dtype=bool), k=ks - qs)[None, None]
+    if lengths is not None:
+        valid = length_valid_mask(lengths, q.shape[2], k.shape[2],
+                                  causal=causal, q_positions=q_positions)
     if segment_ids is not None:
         seg_mask = (segment_ids[:, None, :, None]
                     == segment_ids[:, None, None, :])
@@ -47,8 +95,9 @@ def mha_reference(q, k, v, *, causal: bool = False, sm_scale: float | None = Non
         logits = jnp.where(valid, logits, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1)
     if valid is not None:
-        # Fully-masked query rows (causal with q_len > k_len) output 0,
-        # not the uniform average softmax-of-equal-mask-values would give.
+        # Fully-masked query rows (causal with q_len > k_len, padded
+        # rows) output 0, not the uniform average
+        # softmax-of-equal-mask-values would give.
         probs = probs * jnp.any(valid, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
                       ).astype(q.dtype)

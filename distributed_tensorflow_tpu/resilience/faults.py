@@ -40,6 +40,11 @@ Instrumented sites:
                           ``raise`` models losing the ring-replica
                           transfer at a snapshot boundary; training and
                           the disk tiers must be unaffected
+``serve.step``            serving/engine.InferenceEngine.step (tag=step
+                          index) — fires BEFORE any scheduler/cache
+                          mutation, so a ``raise`` models a transient
+                          serving-step failure the replica retries
+                          without losing or double-serving a request
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

@@ -1,0 +1,244 @@
+"""Compiled incremental decode for the transformer/BERT family.
+
+Two pure, jittable programs over a trained ``TransformerLM`` parameter
+tree (stacked-layers layout) and the block-allocated KV pool
+(serving/kv_cache.py):
+
+- :func:`make_prefill_fn` — one right-padded mixed-length batch of
+  prompts through the FULL forward (the exact math of
+  ``models/transformer.TransformerLM``, masked by the factored
+  ``ops.attention.length_valid_mask`` rule), writing every position's
+  rotary-embedded K and V into the sequences' cache blocks and
+  returning each prompt's last-position logits.
+- :func:`make_decode_fn` — ONE token per running slot: project q/k/v
+  for the new token, scatter k/v into the slot's current block, gather
+  the slot's block window, and attend the single query against it.
+  Because prefill wrote the same K/V the full forward computes and the
+  mask is the same factored rule, greedy decode through the cache
+  matches argmax over full-sequence recompute — the correctness
+  contract tests/test_serving.py pins on 1 device and on dp×tp meshes.
+
+Everything here is plain jnp (no Pallas custom calls), so on a serving
+mesh GSPMD partitions the programs directly: slots over ``dp``,
+heads/mlp/vocab over ``tp`` (:func:`param_shardings`), the pool laid
+out by ``kv_cache.pool_shardings``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM, mesh_axis_rules, rotary_embedding)
+from distributed_tensorflow_tpu.ops.attention import mha_reference
+
+
+def _plain(tree):
+    """Deep-convert FrozenDict/Mapping nodes to plain dicts so the
+    parameter tree's pytree STRUCTURE matches the shardings tree the
+    engine passes as jit in_shardings."""
+    if hasattr(tree, "items"):
+        return {k: _plain(v) for k, v in tree.items()}
+    return tree
+
+
+def canonical_params(cfg: TransformerConfig, params):
+    """Parameter tree in the stacked-layers layout the decode programs
+    index (``params["layers"]`` leaves shaped ``(L, ...)``, plain-dict
+    nodes): unstacked ``layer_<i>`` trees (scan_layers=False training)
+    are stacked."""
+    params = _plain(params)
+    if "layers" in params:
+        return params
+    names = [f"layer_{i}" for i in range(cfg.n_layers)]
+    missing = [n for n in names if n not in params]
+    if missing:
+        raise ValueError(f"params have neither 'layers' nor {missing}")
+    layers = [params.pop(n) for n in names]
+    params["layers"] = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *layers)
+    return params
+
+
+def _layer(params, l: int):
+    return jax.tree_util.tree_map(lambda a: a[l], dict(params["layers"]))
+
+
+def _rms_norm(x, scale, dtype, eps: float = 1e-6):
+    """models/transformer.RMSNorm math, parameter passed explicitly."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(dtype)
+
+
+def rotary_at(x, positions, *, base: float = 10000.0):
+    """RoPE at explicit absolute positions: ``x`` is ``(B, H, Q, hd)``,
+    ``positions`` ``(B, Q)``. Same angle formula as
+    ``models/transformer.rotary_embedding`` so a token's K is bitwise
+    the same whether computed in prefill (positions ``0..S-1``) or one
+    at a time during decode."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # (B, Q, d/2)
+    sin = jnp.sin(ang)[:, None]                                # (B,1,Q,d/2)
+    cos = jnp.cos(ang)[:, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def model_forward(cfg: TransformerConfig, params, tokens, lengths=None,
+                  *, return_kv: bool = False):
+    """Full-sequence forward over the canonical parameter tree — the
+    serving-side twin of ``TransformerLM.__call__`` (same einsums, same
+    order, no sharding-constraint machinery; GSPMD lays it out from the
+    caller's in_shardings). ``lengths`` masks a right-padded batch via
+    the factored rule. ``return_kv`` additionally returns the per-layer
+    post-RoPE K and V stacks ``(L, B, H, S, hd)`` — exactly what prefill
+    writes into the cache blocks."""
+    dt = cfg.dtype
+    embed = params["embed"]
+    x = embed.astype(dt)[tokens]                       # (B, S, D)
+    ks, vs = [], []
+    for l in range(cfg.n_layers):
+        p = _layer(params, l)
+        h = _rms_norm(x, p["RMSNorm_0"]["scale"], dt)
+        att = p["attn"]
+        q = jnp.einsum("bsd,dhk->bhsk", h, att["query"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bhsk", h, att["key"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bhsk", h, att["value"].astype(dt))
+        q = rotary_embedding(q, seq_axis=-2)
+        k = rotary_embedding(k, seq_axis=-2)
+        o = mha_reference(q, k, v, causal=cfg.causal, lengths=lengths)
+        o = jnp.einsum("bhsk,hkd->bsd", o, att["out"].astype(dt))
+        x = x + o
+        h = _rms_norm(x, p["RMSNorm_1"]["scale"], dt)
+        mlp = p["mlp"]
+        hh = jnp.einsum("bsd,df->bsf", h, mlp["wi"].astype(dt))
+        gate, up = jnp.split(hh, 2, axis=-1)
+        hh = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("bsf,fd->bsd", hh, mlp["wo"].astype(dt))
+        if return_kv:
+            ks.append(k)
+            vs.append(v)
+    x = _rms_norm(x, params["final_norm"]["scale"], dt)
+    logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(dt))
+    logits = logits.astype(jnp.float32)
+    if return_kv:
+        return logits, (jnp.stack(ks), jnp.stack(vs))
+    return logits
+
+
+def make_prefill_fn(cfg: TransformerConfig):
+    """``prefill(params, pool_k, pool_v, tokens, lengths, write_rows)``
+    → ``(last_logits, pool_k, pool_v)``.
+
+    ``tokens`` (B, S) right-padded prompts, ``lengths`` (B,) true
+    lengths, ``write_rows`` (B, S) flat pool rows per position (padded
+    positions point at the trash block). ``last_logits`` (B, vocab) are
+    the logits at each prompt's final REAL position — the first
+    generated token's distribution."""
+
+    def prefill(params, pool_k, pool_v, tokens, lengths, write_rows):
+        B, S = tokens.shape
+        logits, (ks, vs) = model_forward(cfg, params, tokens,
+                                         lengths=lengths, return_kv=True)
+        L, _, H, _, hd = ks.shape
+        rows = write_rows.reshape(-1)                       # (B*S,)
+        flat_k = ks.transpose(0, 1, 3, 2, 4).reshape(L, B * S, H, hd)
+        flat_v = vs.transpose(0, 1, 3, 2, 4).reshape(L, B * S, H, hd)
+        pool_k = pool_k.at[:, rows].set(flat_k.astype(pool_k.dtype))
+        pool_v = pool_v.at[:, rows].set(flat_v.astype(pool_v.dtype))
+        last = logits[jnp.arange(B), jnp.maximum(lengths, 1) - 1]
+        return last, pool_k, pool_v
+
+    return prefill
+
+
+def make_decode_fn(cfg: TransformerConfig):
+    """``decode(params, pool_k, pool_v, tokens, positions, lengths,
+    write_rows, window_rows)`` → ``(logits, pool_k, pool_v)``.
+
+    One incremental step for a batch of running slots: ``tokens`` (B,)
+    the token being fed, ``positions`` (B,) its absolute position,
+    ``lengths`` (B,) the post-append visible length (``positions + 1``
+    for active slots, 0 for idle ones — an idle slot attends nothing
+    and its logits row is garbage the scheduler never reads),
+    ``write_rows`` (B,) the flat pool row this token's K/V lands in,
+    ``window_rows`` (B, W) each slot's full block-window gather index.
+    """
+    if not cfg.causal:
+        raise ValueError("incremental decode requires a causal model; "
+                         "serve bidirectional (BERT) configs through the "
+                         "prefill/scoring path")
+
+    def decode(params, pool_k, pool_v, tokens, positions, lengths,
+               write_rows, window_rows):
+        dt = cfg.dtype
+        embed = params["embed"]
+        x = embed.astype(dt)[tokens]                    # (B, D)
+        pos_q = positions[:, None]                      # (B, 1)
+        for l in range(cfg.n_layers):
+            p = _layer(params, l)
+            h = _rms_norm(x, p["RMSNorm_0"]["scale"], dt)
+            att = p["attn"]
+            q = jnp.einsum("bd,dhk->bhk", h, att["query"].astype(dt))
+            k = jnp.einsum("bd,dhk->bhk", h, att["key"].astype(dt))
+            v = jnp.einsum("bd,dhk->bhk", h, att["value"].astype(dt))
+            q = rotary_at(q[:, :, None], pos_q)          # (B, H, 1, hd)
+            k = rotary_at(k[:, :, None], pos_q)[:, :, 0]  # (B, H, hd)
+            # write THEN gather: the query must see its own position
+            pool_k = pool_k.at[l, write_rows].set(k.astype(pool_k.dtype))
+            pool_v = pool_v.at[l, write_rows].set(v.astype(pool_v.dtype))
+            kw = pool_k[l][window_rows]                  # (B, W, H, hd)
+            vw = pool_v[l][window_rows]
+            kw = kw.transpose(0, 2, 1, 3).astype(dt)     # (B, H, W, hd)
+            vw = vw.transpose(0, 2, 1, 3).astype(dt)
+            o = mha_reference(q, kw, vw, causal=True, lengths=lengths,
+                              q_positions=positions)     # (B, H, 1, hd)
+            o = jnp.einsum("bhk,hkd->bd", o[:, :, 0],
+                           att["out"].astype(dt))
+            x = x + o
+            h = _rms_norm(x, p["RMSNorm_1"]["scale"], dt)
+            mlp = p["mlp"]
+            hh = jnp.einsum("bd,df->bf", h, mlp["wi"].astype(dt))
+            gate, up = jnp.split(hh, 2, axis=-1)
+            hh = jax.nn.silu(gate) * up
+            x = x + jnp.einsum("bf,fd->bd", hh, mlp["wo"].astype(dt))
+        x = _rms_norm(x, params["final_norm"]["scale"], dt)
+        logits = jnp.einsum("bd,vd->bv", x, embed.astype(dt))
+        return logits.astype(jnp.float32), pool_k, pool_v
+
+    return decode
+
+
+def param_shardings(cfg: TransformerConfig, mesh):
+    """NamedShardings for the canonical (stacked-layers) serving
+    parameter tree from the SAME logical-axis metadata training uses
+    (``LOGICAL_AXIS_RULES`` restricted to the serving mesh):
+    heads/mlp/vocab over ``tp``, everything else replicated (a dp×tp
+    serving mesh has no fsdp axis, so ``embed``'s fsdp rule maps to
+    None)."""
+    import dataclasses
+
+    from flax.linen import partitioning as nn_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # scan_layers=True yields the stacked "layers" tree directly — the
+    # canonical layout — with the leading layer axis already unsharded
+    # (the "layers" logical axis maps to None).
+    shape_cfg = dataclasses.replace(cfg, scan_layers=True, mesh=None)
+    model = TransformerLM(shape_cfg)
+    rules = mesh_axis_rules(mesh)
+    tokens = jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)
+    with nn_partitioning.axis_rules(list(rules)):
+        var_shapes = jax.eval_shape(
+            lambda r: model.init(r, tokens), jax.random.PRNGKey(0))
+        logical = nn_partitioning.get_axis_names(var_shapes["params_axes"])
+        mesh_specs = nn_partitioning.logical_to_mesh(logical)
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), mesh_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return _plain(shardings)

@@ -1,0 +1,377 @@
+"""The inference engine: sharded model + KV cache + continuous batching.
+
+One :class:`InferenceEngine` is one serving replica's model runtime:
+
+- **Weights** — passed in directly, or restored down the checkpoint
+  recovery ladder via :meth:`from_checkpoint`
+  (``CheckpointManager.restore_latest``: host snapshot > peer replica >
+  local disk > durable disk — a restarted serving replica warm-starts
+  from the same tiers a restarted trainer does).
+- **Placement** — on a ``dp×tp`` mesh the decode batch's slots shard
+  over ``dp`` and heads/mlp/vocab shard over ``tp`` using the SAME
+  logical-axis rules as training (serving/decode.param_shardings); the
+  KV pool's head axis follows (kv_cache.pool_shardings). Single-device
+  when ``mesh=None``.
+- **Stepping** — :meth:`step` is one continuous-batching iteration:
+  retire finished sequences (free their blocks), admit from the queue
+  under the token budget, prefill the newly admitted, decode one token
+  for every running sequence. Greedy (argmax) sampling — the decode
+  path's output is exactly comparable to full-sequence recompute.
+- **Telemetry** — every step is a ``serve.step`` span; every completed
+  request emits a ``serve.request`` event whose ``dur_s`` is the
+  queue→completion latency (both render in tools/obs_report.py and as
+  spans in tools/trace_report.py). Instruments live under the shared
+  ``inference/`` namespace (the one ``Model.predict`` also reports
+  into) plus ``serving/`` for engine-specific gauges.
+- **Chaos** — each step fires the ``serve.step`` injection site
+  (resilience/faults.py) BEFORE mutating any scheduler state, so an
+  injected failure is retryable: the replica runtime catches it and
+  re-runs the step; no request is lost.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu import telemetry
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM)
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.serving import decode as decode_lib
+from distributed_tensorflow_tpu.serving.kv_cache import (
+    CacheConfig, init_pool, pool_shardings)
+from distributed_tensorflow_tpu.serving.scheduler import (
+    AdmissionQueue, ContinuousBatchingScheduler, Request, Sequence)
+from distributed_tensorflow_tpu.utils.jax_compat import (
+    safe_donate_argnums)
+
+
+class InferenceEngine:
+    """Continuous-batching inference over a sharded transformer.
+
+    ``max_slots`` is the decode batch width (compiled shape; on a mesh
+    it must divide the dp shard count), ``max_prompt_len`` the compiled
+    prefill width, ``num_blocks``/``block_size`` size the KV pool, and
+    ``token_budget`` caps prefill+decode tokens per step (Orca-style
+    iteration-level fairness). ``max_seq_len`` bounds prompt+generation
+    per sequence (default: the model's ``max_seq_len``)."""
+
+    def __init__(self, cfg: TransformerConfig, params, *, mesh=None,
+                 num_blocks: int = 64, block_size: int = 16,
+                 max_slots: int = 8, max_prompt_len: int | None = None,
+                 token_budget: int | None = None,
+                 max_seq_len: int | None = None,
+                 queue_capacity: int = 256,
+                 queue_policy: str = "reject",
+                 cache_dtype=None):
+        if cfg.mesh is not None:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, mesh=None)
+        self.cfg = cfg
+        self.mesh = mesh
+        self.max_slots = max_slots
+        self.max_seq_len = min(max_seq_len or cfg.max_seq_len,
+                               cfg.max_seq_len)
+        self.max_prompt_len = min(max_prompt_len or self.max_seq_len,
+                                  self.max_seq_len)
+        self.token_budget = token_budget or (max_slots
+                                             + self.max_prompt_len)
+        cache_cfg = CacheConfig.for_model(cfg, num_blocks=num_blocks,
+                                          block_size=block_size,
+                                          dtype=cache_dtype)
+        max_blocks_per_seq = cache_cfg.blocks_for(self.max_seq_len)
+        self.cache_cfg = cache_cfg
+        self.window = max_blocks_per_seq * block_size
+        self.scheduler = ContinuousBatchingScheduler(
+            cache_cfg, max_slots=max_slots,
+            max_blocks_per_seq=max_blocks_per_seq,
+            token_budget=self.token_budget,
+            queue=AdmissionQueue(queue_capacity, queue_policy))
+
+        params = decode_lib.canonical_params(cfg, params)
+        if mesh is not None:
+            shardings = decode_lib.param_shardings(cfg, mesh)
+            params = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                dict(params), shardings)
+        else:
+            params = jax.tree_util.tree_map(jnp.asarray, dict(params))
+        self.params = params
+        self.pool = init_pool(cache_cfg, mesh)
+
+        prefill = decode_lib.make_prefill_fn(cfg)
+        decode = decode_lib.make_decode_fn(cfg) if cfg.causal else None
+        if mesh is not None:
+            # jit under the mesh context so GSPMD partitions over it;
+            # inputs arrive host-side and get sharded by in_shardings
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dp = "dp" if "dp" in mesh.shape else None
+            pool_sh = pool_shardings(mesh)
+            rep = NamedSharding(mesh, P())
+            slotv = NamedSharding(mesh, P(dp))
+            self._prefill = jax.jit(
+                prefill,
+                in_shardings=(shardings, pool_sh, pool_sh, rep, rep, rep),
+                out_shardings=(rep, pool_sh, pool_sh),
+                donate_argnums=safe_donate_argnums((1, 2)))
+            self._decode = jax.jit(
+                decode,
+                in_shardings=(shardings, pool_sh, pool_sh, slotv, slotv,
+                              slotv, slotv,
+                              NamedSharding(mesh, P(dp, None))),
+                out_shardings=(NamedSharding(mesh, P(dp, None)),
+                               pool_sh, pool_sh),
+                donate_argnums=safe_donate_argnums((1, 2))) if decode is not None else None
+        else:
+            self._prefill = jax.jit(prefill, donate_argnums=safe_donate_argnums((1, 2)))
+            self._decode = (jax.jit(decode, donate_argnums=safe_donate_argnums((1, 2)))
+                            if decode is not None else None)
+
+        # shared inference namespace (Model.predict reports here too)
+        reg = telemetry.get_registry()
+        self._m_req_latency = reg.histogram(
+            "inference/request_latency",
+            "admission -> completion seconds per serving request")
+        self._m_ttft = reg.histogram(
+            "inference/time_to_first_token",
+            "admission -> first generated token seconds")
+        self._m_completed = reg.counter("inference/requests_completed")
+        self._m_tokens = reg.counter("inference/tokens_generated")
+        self._m_step = reg.histogram("serving/step_time",
+                                     "one continuous-batching iteration")
+        self._m_running = reg.gauge("serving/sequences_running")
+        self._m_queued = reg.gauge("serving/requests_queued")
+        self._m_blocks_free = reg.gauge("serving/blocks_free")
+        self._m_preempt = reg.counter("serving/preemptions")
+
+        self._step_idx = 0
+        self._submitted: dict[str, float] = {}      # id -> wall arrival
+
+    # -- weights -----------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, cfg: TransformerConfig, directory: str, *,
+                        checkpoint_name: str = "ckpt",
+                        local_dir: str | None = None,
+                        snapshot_store=None, seed: int = 0,
+                        **engine_kwargs) -> "InferenceEngine":
+        """Restore serving weights down the recovery ladder. The
+        checkpoint must have been written as ``Checkpoint(params=...)``
+        over a ``TransformerLM(cfg)`` parameter tree; ``local_dir`` /
+        ``snapshot_store`` enable the warm tiers exactly as they do for
+        trainers (CheckpointManager.restore_latest walks host > peer >
+        local > durable and emits ``recovery.restore_tier``). With
+        nothing restorable anywhere, falls back to seed-deterministic
+        fresh init (cold start)."""
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint, CheckpointManager)
+        from distributed_tensorflow_tpu.training.model import (
+            _unflatten_like)
+
+        model = TransformerLM(cfg)
+        tokens = jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(seed), tokens)["params"]
+        params = (params.unfreeze() if hasattr(params, "unfreeze")
+                  else dict(params))
+        ckpt = Checkpoint(params=params)
+        mgr = CheckpointManager(ckpt, directory,
+                                checkpoint_name=checkpoint_name,
+                                local_dir=local_dir,
+                                snapshot_store=snapshot_store)
+        res = mgr.restore_latest()
+        if res is not None:
+            _tier, _step, flat = res
+            params = _unflatten_like(params, flat, "params")
+        return cls(cfg, params, **engine_kwargs)
+
+    # -- request lifecycle -------------------------------------------------
+    def submit(self, request: Request) -> "Request | None":
+        """Queue a request; returns the request the queue evicted to
+        make room (policy ``evict_oldest``), if any. Raises
+        ``QueueOverflowError`` under the ``reject`` policy."""
+        if len(request.tokens) > self.max_prompt_len:
+            raise ValueError(
+                f"request {request.id}: prompt {len(request.tokens)} > "
+                f"max_prompt_len {self.max_prompt_len}")
+        if not self.cfg.causal and request.max_new_tokens > 0:
+            raise ValueError(
+                f"request {request.id}: bidirectional (non-causal) "
+                f"configs serve scoring requests only "
+                f"(max_new_tokens=0)")
+        if (len(request.tokens) + request.max_new_tokens
+                > self.max_seq_len):
+            raise ValueError(
+                f"request {request.id}: prompt + max_new_tokens "
+                f"exceeds max_seq_len {self.max_seq_len}")
+        evicted = self.scheduler.queue.submit(request)
+        self._submitted[request.id] = time.time()
+        if evicted is not None:
+            self._submitted.pop(evicted.id, None)
+        self._m_queued.set(len(self.scheduler.queue))
+        return evicted
+
+    def _prefill_one(self, seq: Sequence):
+        """Run one admitted sequence's prompt through the compiled
+        prefill (fixed (1, max_seq_len) shape — wider than
+        max_prompt_len so a PREEMPTED sequence's replayed prompt, which
+        includes its already-generated tokens, always fits) and bank its
+        first greedy token."""
+        P = self.max_seq_len
+        toks = np.zeros((1, P), np.int32)
+        toks[0, :seq.prompt_len] = seq.request.tokens
+        rows = seq.table.rows(np.arange(P))[None]       # (1, P)
+        lengths = np.asarray([seq.prompt_len], np.int32)
+        last, self.pool["k"], self.pool["v"] = self._prefill(
+            self.params, self.pool["k"], self.pool["v"],
+            jnp.asarray(toks), jnp.asarray(lengths), jnp.asarray(rows))
+        self.scheduler.commit_prefill(seq)
+        first = int(np.asarray(jnp.argmax(last[0])))
+        if seq.request.max_new_tokens > 0:
+            self.scheduler.append_token(seq, first)
+        else:
+            seq.first_token_s = time.monotonic()
+            seq.score_token = first                    # scoring request
+
+    def _decode_batch(self, batch: list[Sequence]):
+        """One incremental token for every running sequence. The decode
+        program has a fixed (max_slots,) batch; idle slots feed trash
+        rows with length 0 and their logits are never read."""
+        B, W = self.max_slots, self.window
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        lengths = np.zeros(B, np.int32)
+        write_rows = np.zeros(B, np.int32)     # trash block row 0
+        window_rows = np.zeros((B, W), np.int32)
+        for seq in batch:
+            s = seq.slot
+            # feed the last banked token at position length-1 (it was
+            # appended by the previous prefill/decode step)
+            tokens[s] = seq.last_token
+            positions[s] = seq.length - 1
+            lengths[s] = seq.length
+            write_rows[s] = seq.table.row_of(seq.length - 1)
+            window_rows[s] = seq.table.window_rows()
+        logits, self.pool["k"], self.pool["v"] = self._decode(
+            self.params, self.pool["k"], self.pool["v"],
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(lengths), jnp.asarray(write_rows),
+            jnp.asarray(window_rows))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        for seq in batch:
+            self.scheduler.append_token(seq, int(nxt[seq.slot]))
+
+    def step(self) -> list[dict]:
+        """One continuous-batching iteration; returns completion records
+        for every request finished this step."""
+        t0 = time.monotonic()
+        # chaos site FIRST: an injected raise leaves scheduler/cache
+        # state untouched, so the caller can simply retry the step
+        faults.fire("serve.step", tag=self._step_idx)
+        sched = self.scheduler
+        finished: list[dict] = []
+        with telemetry.span("serve.step", step=self._step_idx) as sp:
+            # 1. retire finished sequences -> blocks free immediately
+            for seq in list(sched.finished()):
+                finished.append(self._complete(seq))
+            admitted = sched.admit()
+            for seq in admitted:
+                self._prefill_one(seq)
+            # scoring requests (max_new_tokens=0) finish at prefill
+            for seq in list(sched.finished()):
+                finished.append(self._complete(seq))
+            batch = sched.grow_for_decode() if self._decode else []
+            if batch:
+                self._decode_batch(batch)
+            sp["admitted"] = len(admitted)
+            sp["decoded"] = len(batch)
+            sp["finished"] = len(finished)
+            sp["queued"] = len(sched.queue)
+            sp["blocks_free"] = sched.allocator.num_free
+        self._step_idx += 1
+        self._m_step.record(time.monotonic() - t0)
+        self._m_running.set(len(sched.running))
+        self._m_queued.set(len(sched.queue))
+        self._m_blocks_free.set(sched.allocator.num_free)
+        if sched.preemptions > self._m_preempt.value:
+            self._m_preempt.increment(
+                sched.preemptions - self._m_preempt.value)
+        return finished
+
+    def _complete(self, seq: Sequence) -> dict:
+        self.scheduler.finish(seq)
+        req = seq.request
+        now = time.time()
+        arrival = self._submitted.pop(req.id, now)
+        latency = max(0.0, now - arrival)
+        ttft = ((seq.first_token_s - seq.admitted_s)
+                if seq.first_token_s is not None else None)
+        generated = list(req.generated_prefix) + list(seq.generated)
+        tokens = (generated if (req.max_new_tokens > 0
+                                or req.generated_prefix)
+                  else [getattr(seq, "score_token", -1)])
+        prompt_tokens = len(req.tokens) - len(req.generated_prefix)
+        self._m_req_latency.record(latency)
+        if ttft is not None:
+            self._m_ttft.record(ttft)
+        self._m_completed.increment()
+        self._m_tokens.increment(len(seq.generated))
+        telemetry.event(
+            "serve.request", id=req.id, dur_s=round(latency, 6),
+            prompt_tokens=prompt_tokens, new_tokens=len(generated),
+            ttft_s=round(ttft, 6) if ttft is not None else None,
+            preemptions=seq.preemptions)
+        return {"id": req.id, "tokens": tokens,
+                "prompt_tokens": prompt_tokens,
+                "latency_s": latency, "ttft_s": ttft,
+                "preemptions": seq.preemptions}
+
+    # -- convenience -------------------------------------------------------
+    def run_until_idle(self, *, max_steps: int = 100000,
+                       retry_faults: bool = False) -> dict:
+        """Drive :meth:`step` until queue and slots drain; returns
+        ``{request_id: completion record}``. ``retry_faults=True``
+        re-runs a step whose ``serve.step`` chaos site raised (the
+        replica runtime's behavior)."""
+        from distributed_tensorflow_tpu.resilience.faults import (
+            FaultInjected)
+        out: dict[str, dict] = {}
+        for _ in range(max_steps):
+            if self.scheduler.idle:
+                break
+            try:
+                for rec in self.step():
+                    out[rec["id"]] = rec
+            except FaultInjected:
+                if not retry_faults:
+                    raise
+        return out
+
+    def generate(self, prompts, *, max_new_tokens: int = 16,
+                 eos_id: int | None = None) -> list[list[int]]:
+        """Batch convenience: greedy-decode ``prompts`` (lists of token
+        ids) through the continuous-batching path; returns the generated
+        token lists in prompt order."""
+        for i, p in enumerate(prompts):
+            self.submit(Request(id=f"g{i}", tokens=tuple(p),
+                                max_new_tokens=max_new_tokens,
+                                eos_id=eos_id))
+        done = self.run_until_idle()
+        return [done[f"g{i}"]["tokens"] for i in range(len(prompts))]
+
+    def stats(self) -> dict:
+        sched = self.scheduler
+        return {
+            "steps": self._step_idx,
+            "running": len(sched.running),
+            "queued": len(sched.queue),
+            "blocks_free": sched.allocator.num_free,
+            "blocks_total": self.cache_cfg.usable_blocks,
+            "preemptions": sched.preemptions,
+            "queue_rejected": sched.queue.rejected,
+            "queue_evicted": sched.queue.evicted,
+            "requests_completed": self._m_completed.value,
+            "tokens_generated": self._m_tokens.value,
+        }

@@ -1,0 +1,222 @@
+"""Block-allocated KV cache for incremental decode.
+
+The serving engine keeps every running sequence's attention keys/values
+on device between decode steps. A naive per-slot ``(max_seq,)`` buffer
+wastes HBM proportional to the LONGEST request; instead the cache is a
+pool of fixed-size *blocks* (PagedAttention, Kwon et al. SOSP'23 —
+vLLM's core idea): a sequence of length ``L`` holds exactly
+``ceil(L / block_size)`` blocks, mixed-length requests share one batch,
+and a finished sequence's blocks return to the pool immediately.
+
+Two layers:
+
+- **Host side** — :class:`BlockAllocator` (the free list; physical
+  block 0 is reserved as the *trash block*: padded positions of every
+  sequence write there and reads from it are always masked) and
+  :class:`BlockTable` (a sequence's logical-position → physical-block
+  map plus the flat pool indices the device gather/scatter consume).
+- **Device side** — the pool itself, ``(n_layers, num_blocks *
+  block_size, n_heads, head_dim)`` per K and V (:func:`init_pool`),
+  flat over the block dimension so position ``p`` of a sequence maps to
+  row ``table[p // block_size] * block_size + p % block_size``. On a
+  serving mesh the head axis is sharded over ``tp`` (the same axis
+  training shards heads on) and the pool is replicated over ``dp`` —
+  ``dp`` shards the decode batch's slots, and every slot's gather may
+  touch any block (:func:`pool_shardings`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+#: Physical block every allocator reserves: padded/inactive positions
+#: scatter here and masked attention never reads it.
+TRASH_BLOCK = 0
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation (admission must wait or a
+    running sequence must be preempted)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Shape of the device-side KV pool."""
+
+    n_layers: int
+    n_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int = 16
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved trash block)")
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1          # minus the trash block
+
+    @property
+    def max_tokens(self) -> int:
+        """Cache capacity in tokens (across all sequences)."""
+        return self.usable_blocks * self.block_size
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return max(1, math.ceil(n_tokens / self.block_size))
+
+    @classmethod
+    def for_model(cls, model_cfg, *, num_blocks: int,
+                  block_size: int = 16, dtype=None) -> "CacheConfig":
+        """Pool sized for a TransformerConfig-shaped model config."""
+        return cls(n_layers=model_cfg.n_layers, n_heads=model_cfg.n_heads,
+                   head_dim=model_cfg.head_dim, num_blocks=num_blocks,
+                   block_size=block_size,
+                   dtype=dtype if dtype is not None else model_cfg.dtype)
+
+
+class BlockAllocator:
+    """Free-list over the physical blocks of one pool.
+
+    Blocks are interchangeable fixed-size units, so there is no external
+    fragmentation by construction — any free block satisfies any
+    request; the only waste is internal (the tail of a sequence's last
+    block), bounded by ``block_size - 1`` tokens per sequence.
+    Allocation is lowest-id-first so reuse is deterministic
+    (test- and replay-friendly)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> list[int]:
+        """``n`` blocks, lowest ids first; raises
+        :class:`OutOfBlocksError` (allocating nothing) when fewer than
+        ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise OutOfBlocksError(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(of {self.num_blocks - 1} usable)")
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, blocks) -> None:
+        """Return blocks to the pool. Double-free and freeing the trash
+        block are programming errors and raise."""
+        blocks = list(blocks)
+        for b in blocks:
+            if b == TRASH_BLOCK:
+                raise ValueError("cannot free the reserved trash block")
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+        for b in sorted(blocks, reverse=True):
+            self._allocated.remove(b)
+            self._free.append(b)
+        # keep lowest-id-first allocation order deterministic
+        self._free.sort(reverse=True)
+
+
+class BlockTable:
+    """One sequence's logical-position → physical-row mapping.
+
+    ``max_blocks`` fixes the table's device-visible width (every slot's
+    table has the same shape so the decode step compiles once); unused
+    entries point at the trash block."""
+
+    def __init__(self, cache_cfg: CacheConfig, max_blocks: int):
+        self.cfg = cache_cfg
+        self.max_blocks = max_blocks
+        self.blocks: list[int] = []
+        self.length = 0                     # tokens written
+
+    @property
+    def capacity(self) -> int:
+        return len(self.blocks) * self.cfg.block_size
+
+    def ensure_room(self, n_tokens: int, allocator: BlockAllocator):
+        """Grow the table so ``length + n_tokens`` fits; raises
+        :class:`OutOfBlocksError` (allocating nothing) when the pool or
+        the table width cannot hold it."""
+        need = self.cfg.blocks_for(self.length + n_tokens)
+        grow = need - len(self.blocks)
+        if grow <= 0:
+            return
+        if need > self.max_blocks:
+            raise OutOfBlocksError(
+                f"sequence needs {need} blocks > max_blocks_per_seq="
+                f"{self.max_blocks}")
+        self.blocks.extend(allocator.alloc(grow))
+
+    def row_of(self, position: int) -> int:
+        """Flat pool row of logical ``position``."""
+        bs = self.cfg.block_size
+        return self.blocks[position // bs] * bs + position % bs
+
+    def rows(self, positions) -> np.ndarray:
+        """Flat pool rows for an array of logical positions; positions
+        at/past the written blocks map into the trash block."""
+        bs = self.cfg.block_size
+        table = np.full(self.max_blocks, TRASH_BLOCK, np.int32)
+        table[:len(self.blocks)] = self.blocks
+        positions = np.asarray(positions, np.int64)
+        return (table[np.minimum(positions // bs, self.max_blocks - 1)]
+                * bs + positions % bs).astype(np.int32)
+
+    def window_rows(self) -> np.ndarray:
+        """Rows of the full ``max_blocks * block_size`` attention window
+        (the decode step's gather index): logical positions 0.. in
+        order, trash rows past the allocated blocks."""
+        return self.rows(np.arange(self.max_blocks * self.cfg.block_size))
+
+    def release(self, allocator: BlockAllocator):
+        if self.blocks:
+            allocator.free(self.blocks)
+        self.blocks = []
+        self.length = 0
+
+
+def init_pool(cache_cfg: CacheConfig, mesh=None):
+    """Zero-initialized ``{"k", "v"}`` pools, placed with
+    :func:`pool_shardings` when a mesh is given."""
+    shape = (cache_cfg.n_layers,
+             cache_cfg.num_blocks * cache_cfg.block_size,
+             cache_cfg.n_heads, cache_cfg.head_dim)
+    pool = {"k": jnp.zeros(shape, cache_cfg.dtype),
+            "v": jnp.zeros(shape, cache_cfg.dtype)}
+    if mesh is not None:
+        sh = pool_shardings(mesh)
+        pool = {n: jax.device_put(a, sh) for n, a in pool.items()}
+    return pool
+
+
+def pool_shardings(mesh) -> NamedSharding:
+    """Cache layout on a serving mesh: heads over ``tp`` (matching the
+    training-side head sharding), rows replicated — ``dp`` shards the
+    decode batch's SLOTS, and any slot's block gather may touch any
+    physical row, so the row axis stays unsharded."""
+    head_axis = "tp" if "tp" in mesh.shape else None
+    return NamedSharding(mesh, P(None, None, head_axis, None))

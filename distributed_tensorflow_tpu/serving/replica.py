@@ -1,0 +1,180 @@
+"""Supervised serving replicas: the control-plane side of serving.
+
+A *serving replica* is one :class:`~distributed_tensorflow_tpu.serving.
+engine.InferenceEngine` driven by :func:`serving_replica` — a worker
+function shaped exactly like the elastic trainer the recovery
+supervisor already manages (examples/train_mnist.py elastic_worker):
+module-level (picklable by reference), heartbeats once per engine step,
+restartable from scratch at any instant. Run it under
+``resilience.RecoverySupervisor`` and a SIGKILLed replica is detected,
+its generation reformed, and the process respawned exactly like a dead
+trainer — no supervisor changes needed.
+
+**Zero dropped requests.** The replica appends one JSONL record per
+COMPLETED request to ``served-<task>.jsonl`` (line-buffered, so a
+SIGKILL loses at most the line in flight). On (re)start it reads that
+file back, treats every recorded id as done, and re-queues the rest —
+in-flight requests at kill time are simply re-served by the next
+incarnation. Greedy decode over fixed weights is deterministic, so a
+request that was half-decoded (or torn mid-write) re-generates the SAME
+tokens; ``tools/chaos_sweep.py --serve`` gates both the completeness of
+the union and the cross-generation consistency of any duplicates.
+
+**Chaos.** Besides process-level SIGKILLs, the engine's ``serve.step``
+fault site can raise mid-load; the replica retries the step under a
+RetryPolicy (the site fires before any state mutation, so a retry is
+always safe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+from distributed_tensorflow_tpu.serving.scheduler import Request
+
+
+def seeded_requests(seed: int, n: int, vocab_size: int, *,
+                    prompt_range: tuple = (4, 12),
+                    new_tokens_range: tuple = (2, 10)) -> list[Request]:
+    """Deterministic synthetic workload (the resilience/faults.py
+    seeding discipline: a string-seeded stream, stable across
+    processes/runs) — every replica incarnation regenerates the SAME
+    request set from the seed."""
+    rng = random.Random(f"dtx-serve:{seed}")
+    out = []
+    for i in range(n):
+        plen = rng.randrange(*prompt_range)
+        out.append(Request(
+            id=f"r{i:04d}",
+            tokens=tuple(rng.randrange(vocab_size) for _ in range(plen)),
+            max_new_tokens=rng.randrange(*new_tokens_range)))
+    return out
+
+
+def completed_ids(path: str) -> dict[str, list]:
+    """``{request_id: tokens}`` from a replica's completion log;
+    torn trailing lines (SIGKILL mid-write) are skipped."""
+    out: dict[str, list] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue                  # torn line: not completed
+                if "id" in rec:
+                    out[rec["id"]] = rec.get("tokens", [])
+    except OSError:
+        pass
+    return out
+
+
+def serving_replica(run_dir: str, n_requests: int, seed: int,
+                    vocab_size: int = 256, *, max_retries: int = 50,
+                    engine_kwargs: dict | None = None,
+                    ckpt_dir: str | None = None,
+                    step_delay_s: float = 0.0):
+    """One generation of one supervised serving replica.
+
+    Serves the seeded workload to completion, heartbeating every engine
+    step; restartable at any point via the completion log.
+    ``step_delay_s`` paces the step loop (models network/request-bound
+    serving; the chaos sweep uses it so a step-targeted SIGKILL has a
+    real window to land in). Returns ``(task_index,
+    n_served_this_generation, n_total_completed)``."""
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+
+    # join the distributed runtime exactly like an elastic trainer:
+    # the coordination control plane (and, on the CPU test backend, the
+    # gloo-configured runtime the spawn harness expects) needs the
+    # client BEFORE the first jax computation
+    runtime = bootstrap.initialize()
+    import contextlib
+
+    import jax
+    if runtime.num_processes <= 1:
+        # a single-replica supervised run never joins a distributed
+        # world, but the spawn harness pre-configures gloo collectives
+        # (which this jaxlib rejects without a distributed client) —
+        # reset before the first computation initializes the backend
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "none")
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+    from distributed_tensorflow_tpu.resilience.faults import FaultInjected
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    task = runtime.process_id
+    n_replicas = max(1, runtime.num_processes)
+    tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tdir:
+        tv_events.configure(tdir, process_id=task)
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    kwargs = dict(num_blocks=48, block_size=8, max_slots=4,
+                  max_prompt_len=16, queue_capacity=n_requests + 1)
+    kwargs.update(engine_kwargs or {})
+    if ckpt_dir:
+        engine = InferenceEngine.from_checkpoint(cfg, ckpt_dir, **kwargs)
+    else:
+        # seed-deterministic weights: every incarnation serves the same
+        # model, so re-served requests generate identical tokens
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+        engine = InferenceEngine(cfg, params, **kwargs)
+
+    log_path = os.path.join(run_dir, f"served-{task}.jsonl")
+    done = completed_ids(log_path)
+    # replicas statically shard the workload (request i -> replica
+    # i mod N); the union of all replicas' completion logs must cover
+    # the full request set — the chaos sweep's zero-dropped gate
+    mine = [r for i, r in enumerate(
+        seeded_requests(seed, n_requests, vocab_size))
+        if i % n_replicas == task]
+    todo = [r for r in mine if r.id not in done]
+    gen = elastic.generation()
+    print(f"[gen {gen} serve-{task}] {len(done)} already served, "
+          f"{len(todo)} of {len(mine)} to go", flush=True)
+    for r in todo:
+        engine.submit(r)
+
+    served = 0
+    step = 0
+    retries = 0
+    import time as _time
+
+    # line-buffered like the event log: a SIGKILL loses at most one line
+    with open(log_path, "a", buffering=1) as log:
+        while not engine.scheduler.idle:
+            elastic.heartbeat(step)
+            if step_delay_s:
+                _time.sleep(step_delay_s)
+            try:
+                finished = engine.step()
+            except FaultInjected:
+                retries += 1
+                if retries > max_retries:
+                    raise
+                continue              # site fired pre-mutation: retry
+            for rec in finished:
+                log.write(json.dumps({
+                    "id": rec["id"], "tokens": rec["tokens"],
+                    "prompt_tokens": rec["prompt_tokens"],
+                    "latency_s": round(rec["latency_s"], 6),
+                    "gen": gen}) + "\n")
+                served += 1
+            step += 1
+    elastic.heartbeat(step)
+    print(f"[gen {gen} serve-{task}] served {served} "
+          f"({len(done) + served}/{len(mine)} of this replica's shard), "
+          f"{retries} injected-fault retries", flush=True)
+    if tdir:
+        tv_events.shutdown()
+    bootstrap.shutdown()
+    return task, served, len(done) + served

@@ -1,0 +1,264 @@
+"""Continuous (in-flight) batching: admission queue + iteration-level
+scheduling.
+
+The Orca model (Yu et al., OSDI'22): scheduling decisions happen at
+STEP boundaries, not request boundaries. Each engine step the scheduler
+
+1. retires finished sequences — their cache blocks return to the pool
+   immediately (the blocks, not the slot count, are the real capacity);
+2. admits queued requests into free slots while the *token budget*
+   holds: a decode step costs 1 token per running sequence, a prefill
+   costs the whole prompt, and the budget caps their sum so one giant
+   prompt cannot stall every running sequence's next token;
+3. hands the engine the prefill list + the decode batch.
+
+Cache pressure is handled by preemption, newest-first: when a running
+sequence cannot grow into a new block (pool exhausted), the
+most-recently admitted sequence is pushed back to the FRONT of the
+admission queue with its blocks freed (its generated tokens are kept
+and replayed as part of the prompt on re-admission), so the oldest
+requests always finish first and the engine never deadlocks.
+
+:class:`AdmissionQueue` is bounded; on overflow it either rejects the
+new request (``policy="reject"``) or evicts the oldest WAITING request
+to make room (``policy="evict_oldest"`` — the evicted request is
+returned to the caller so the replica can surface the shed load).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Iterable
+
+from distributed_tensorflow_tpu.serving.kv_cache import (
+    BlockAllocator, BlockTable, CacheConfig, OutOfBlocksError)
+
+
+class QueueOverflowError(RuntimeError):
+    """The admission queue is full and the policy is ``reject``."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request. ``max_new_tokens=0`` is a scoring request
+    (prefill only — the BERT-family path): it completes with the
+    prompt's last-position logits argmax as its single 'token'.
+    ``generated_prefix`` is internal: tokens a PREEMPTED sequence had
+    already generated, replayed as prompt suffix on re-admission and
+    re-attached to the completion record."""
+
+    id: str
+    tokens: tuple
+    max_new_tokens: int = 16
+    eos_id: int | None = None
+    arrival_s: float = 0.0
+    generated_prefix: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "tokens", tuple(int(t)
+                                                 for t in self.tokens))
+        if not self.tokens:
+            raise ValueError(f"request {self.id}: empty prompt")
+
+
+class Sequence:
+    """Runtime state of one admitted request."""
+
+    def __init__(self, request: Request, slot: int,
+                 table: BlockTable):
+        self.request = request
+        self.slot = slot
+        self.table = table
+        self.generated: list[int] = []
+        self.prefilled = False
+        self.admitted_s = time.monotonic()
+        self.first_token_s: float | None = None
+        self.preemptions = 0
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.request.tokens)
+
+    @property
+    def length(self) -> int:
+        """Tokens currently in the cache (prompt + generated so far)."""
+        return self.table.length
+
+    @property
+    def last_token(self) -> int:
+        return (self.generated[-1] if self.generated
+                else self.request.tokens[-1])
+
+    @property
+    def done(self) -> bool:
+        if not self.prefilled:
+            return False
+        if len(self.generated) >= self.request.max_new_tokens:
+            return True
+        return (self.request.eos_id is not None and self.generated
+                and self.generated[-1] == self.request.eos_id)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of waiting requests."""
+
+    def __init__(self, capacity: int = 256, policy: str = "reject"):
+        if policy not in ("reject", "evict_oldest"):
+            raise ValueError(f"policy={policy!r}; expected 'reject' or "
+                             f"'evict_oldest'")
+        self.capacity = capacity
+        self.policy = policy
+        self._q: collections.deque[Request] = collections.deque()
+        self.rejected = 0
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def submit(self, request: Request) -> "Request | None":
+        """Enqueue; on overflow either raise (``reject``) or drop and
+        return the oldest waiting request (``evict_oldest``)."""
+        evicted = None
+        if len(self._q) >= self.capacity:
+            if self.policy == "reject":
+                self.rejected += 1
+                raise QueueOverflowError(
+                    f"admission queue full ({self.capacity})")
+            evicted = self._q.popleft()
+            self.evicted += 1
+        self._q.append(request)
+        return evicted
+
+    def push_front(self, request: Request):
+        """Re-queue a preempted sequence's request at the FRONT (it is
+        the oldest work in the system; capacity is not enforced here —
+        preemption must never lose a request)."""
+        self._q.appendleft(request)
+
+    def pop(self) -> "Request | None":
+        return self._q.popleft() if self._q else None
+
+    def peek(self) -> "Request | None":
+        return self._q[0] if self._q else None
+
+
+class ContinuousBatchingScheduler:
+    """Slot + block + budget bookkeeping for one engine."""
+
+    def __init__(self, cache_cfg: CacheConfig, *, max_slots: int,
+                 max_blocks_per_seq: int, token_budget: int,
+                 queue: AdmissionQueue | None = None):
+        self.cache_cfg = cache_cfg
+        self.allocator = BlockAllocator(cache_cfg.num_blocks)
+        self.queue = queue if queue is not None else AdmissionQueue()
+        self.max_slots = max_slots
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.token_budget = token_budget
+        self.running: dict[int, Sequence] = {}      # slot -> sequence
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self.preemptions = 0
+
+    # -- admission --------------------------------------------------------
+    def admit(self) -> list[Sequence]:
+        """Admit queued requests for this step under the token budget:
+        budget = token_budget - (1 decode token per running seq); each
+        admission consumes its prompt length. Stops at the first request
+        that does not fit (FIFO order is preserved — no starvation of
+        big prompts behind small ones)."""
+        budget = self.token_budget - len(self.running)
+        admitted: list[Sequence] = []
+        while self._free_slots and self.queue.peek() is not None:
+            req = self.queue.peek()
+            need = len(req.tokens)
+            if need > budget and (admitted or self.running):
+                break                       # never starves: alone it runs
+            blocks_needed = self.cache_cfg.blocks_for(need + 1)
+            if blocks_needed > self.max_blocks_per_seq:
+                # can never fit: fail the request rather than wedge FIFO
+                self.queue.pop()
+                raise OutOfBlocksError(
+                    f"request {req.id}: prompt of {need} tokens needs "
+                    f"{blocks_needed} blocks > max_blocks_per_seq="
+                    f"{self.max_blocks_per_seq}")
+            if blocks_needed > self.allocator.num_free:
+                break                       # wait for blocks to free up
+            self.queue.pop()
+            slot = self._free_slots.pop()
+            table = BlockTable(self.cache_cfg, self.max_blocks_per_seq)
+            table.ensure_room(need + 1, self.allocator)
+            seq = Sequence(req, slot, table)
+            self.running[slot] = seq
+            admitted.append(seq)
+            budget -= need
+        return admitted
+
+    # -- per-step transitions ---------------------------------------------
+    def commit_prefill(self, seq: Sequence):
+        seq.table.length = seq.prompt_len
+        seq.prefilled = True
+
+    def grow_for_decode(self) -> list[Sequence]:
+        """Make room for ONE more token in every running prefilled
+        sequence; a sequence that cannot grow triggers newest-first
+        preemption until the growth fits. Returns the decode batch."""
+        batch = [s for s in self.running.values() if s.prefilled
+                 and not s.done]
+        batch.sort(key=lambda s: s.slot)
+        for seq in list(batch):
+            while True:
+                try:
+                    seq.table.ensure_room(1, self.allocator)
+                    break
+                except OutOfBlocksError:
+                    victim = self._preempt_newest(exclude=seq)
+                    if victim is None:
+                        raise       # nothing left to preempt: misconfig
+                    if victim in batch:
+                        batch.remove(victim)
+        return batch
+
+    def _preempt_newest(self, exclude: Sequence) -> "Sequence | None":
+        cands = [s for s in self.running.values() if s is not exclude]
+        if not cands:
+            return None
+        victim = max(cands, key=lambda s: s.admitted_s)
+        del self.running[victim.slot]
+        self._free_slots.append(victim.slot)
+        self._free_slots.sort(reverse=True)
+        victim.table.release(self.allocator)
+        # generated tokens become prompt suffix: greedy decode replays
+        # them identically on re-admission (deterministic outputs), and
+        # generated_prefix re-attaches them to the completion record
+        req = victim.request
+        new_req = dataclasses.replace(
+            req, tokens=req.tokens + tuple(victim.generated),
+            max_new_tokens=req.max_new_tokens - len(victim.generated),
+            generated_prefix=(req.generated_prefix
+                              + tuple(victim.generated)))
+        self.queue.push_front(new_req)
+        victim.preemptions += 1
+        self.preemptions += 1
+        return victim
+
+    def append_token(self, seq: Sequence, token: int):
+        seq.table.length += 1
+        seq.generated.append(int(token))
+        if seq.first_token_s is None:
+            seq.first_token_s = time.monotonic()
+
+    def finish(self, seq: Sequence):
+        """Retire a finished sequence: blocks back to the pool, slot
+        freed — both available to the NEXT admission immediately."""
+        del self.running[seq.slot]
+        self._free_slots.append(seq.slot)
+        self._free_slots.sort(reverse=True)
+        seq.table.release(self.allocator)
+
+    def finished(self) -> Iterable[Sequence]:
+        return [s for s in self.running.values() if s.done]
+
+    @property
+    def idle(self) -> bool:
+        return not self.running and len(self.queue) == 0

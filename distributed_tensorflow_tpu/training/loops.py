@@ -112,21 +112,32 @@ class StepTelemetry:
 
     With telemetry off (no event log configured) the per-step cost is
     a few instrument updates; the event write is skipped.
+
+    **Inference sharing:** ``event_name``/``metric_prefix`` re-point the
+    same instrument set at another step loop — ``Model.predict`` uses
+    ``StepTelemetry(event_name="predict.step",
+    metric_prefix="inference")`` so batch prediction and the serving
+    engine report into ONE ``inference/`` metric namespace
+    (``inference/step_time`` is the batch-latency histogram; the
+    serving engine's request instruments live alongside it).
     """
 
     def __init__(self, infeed: "InfeedLoop | None" = None,
-                 stall_detector=None, reg=None):
+                 stall_detector=None, reg=None,
+                 event_name: str = "train.step",
+                 metric_prefix: str = "training"):
         reg = reg or telemetry.get_registry()
-        self._timer = reg.histogram("training/step_time",
-                                    "host-observed train step seconds")
-        self._steps = reg.counter("training/steps_completed")
-        self._loss = reg.gauge("training/last_loss")
+        self._event_name = event_name
+        self._timer = reg.histogram(f"{metric_prefix}/step_time",
+                                    "host-observed step seconds")
+        self._steps = reg.counter(f"{metric_prefix}/steps_completed")
+        self._loss = reg.gauge(f"{metric_prefix}/last_loss")
         self._phase_hists = {
-            name: reg.histogram(f"training/phase/{name}_frac",
+            name: reg.histogram(f"{metric_prefix}/phase/{name}_frac",
                                 f"per-step {name} share of step time")
             for name in STEP_PHASES}
         self._overlap = reg.gauge(
-            "training/overlap_eff",
+            f"{metric_prefix}/overlap_eff",
             "fraction of collective time hidden behind backward")
         self._infeed = infeed
         self._stall = stall_detector
@@ -136,7 +147,10 @@ class StepTelemetry:
     def step_completed(self, step=None, loss=None,
                        dur_s: float | None = None,
                        phases: "dict[str, float] | None" = None,
-                       overlap_eff: float | None = None):
+                       overlap_eff: float | None = None,
+                       **extra_fields):
+        """``extra_fields`` land verbatim on the emitted event (e.g.
+        ``batch_size`` on ``predict.step``)."""
         now = time.monotonic()
         if dur_s is None:
             dur_s = now - self._last_t
@@ -175,7 +189,10 @@ class StepTelemetry:
                     fields[f"{name}_s"] = round(float(seconds), 6)
             if overlap_eff is not None:
                 fields["overlap_eff"] = round(float(overlap_eff), 4)
-            telemetry.event("train.step", **fields)
+            for k, v in extra_fields.items():
+                if v is not None:
+                    fields[k] = v
+            telemetry.event(self._event_name, **fields)
         if self._stall is not None:
             self._stall.step_completed(step=step, dur_s=dur_s)
 

@@ -631,15 +631,24 @@ class Model:
         """≙ Model.predict. Accepts an array OR a pre-batched Dataset /
         iterable of input batches (keras predict(dataset) semantics —
         elements may be bare inputs or (x, ...) tuples whose first
-        entry is the input)."""
+        entry is the input).
+
+        Telemetry: each batch emits a ``predict.step`` event and lands
+        in the ``inference/step_time`` batch-latency histogram — the
+        same ``inference/`` namespace the serving engine
+        (serving/engine.py) reports request metrics into, so batch and
+        online inference read off one rollup."""
         if not self._built:
             raise RuntimeError("build the model before predict()")
+        from distributed_tensorflow_tpu.training.loops import StepTelemetry
         predict_fn = self._make_predict_function()
+        step_telemetry = StepTelemetry(event_name="predict.step",
+                                       metric_prefix="inference")
         if isinstance(x, Dataset) or not isinstance(
                 x, (np.ndarray, jnp.ndarray, list, tuple)):
             outs = []
             static = None
-            for el in Dataset.from_iterable(x):
+            for step, el in enumerate(Dataset.from_iterable(x)):
                 bx = el[0] if isinstance(el, (tuple, list)) else el
                 bx = np.asarray(bx)
                 n = len(bx)
@@ -652,10 +661,11 @@ class Model:
                                    self._state.get("model_state", {}),
                                    self._place(bx))
                 outs.append(np.asarray(preds)[:n])
+                step_telemetry.step_completed(step, batch_size=n)
             return np.concatenate(outs, axis=0)
         outs, total = [], 0
         x = np.asarray(x)
-        for start in range(0, len(x), batch_size):
+        for step, start in enumerate(range(0, len(x), batch_size)):
             bx = x[start:start + batch_size]
             n = len(bx)
             if n < batch_size:
@@ -666,6 +676,7 @@ class Model:
                                self._place(bx))
             outs.append(np.asarray(preds)[:n])
             total += n
+            step_telemetry.step_completed(step, batch_size=n)
         return np.concatenate(outs, axis=0)
 
     def __call__(self, x):

@@ -18,7 +18,11 @@ Series:
 - ``bench/<metric>`` — the headline row of each ``BENCH_r*.json``
   (value + mfu/step-time extras when present);
 - ``scaling/<workload>/<metric>/dev<NN>[/sched]`` — every row of each
-  ``SCALING_r*.json`` keyed like tools/scaling_sweep.py's row_key.
+  ``SCALING_r*.json`` keyed like tools/scaling_sweep.py's row_key;
+- ``serving/<metric>`` + ``serving/p50_latency_ms`` /
+  ``serving/p99_latency_ms`` — the ``SERVING_r*.json`` request-level
+  rows (tools/serve_sweep.py); the latency series gate INVERTED
+  (growth past the fraction fails).
 
 ``--check`` fails (exit 1) when the LATEST round of any series drops
 more than ``--regression-frac`` (default 10%) below the best PRIOR
@@ -96,11 +100,39 @@ def load_scaling_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     return series
 
 
+def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from SERVING_r*.json (ISSUE 9): the
+    throughput row plus latency series carrying ``lower_is_better`` so
+    the regression gate inverts (a p99 that GROWS >10% fails)."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "SERVING_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            extra = row.get("extra") or {}
+            series.setdefault(f"serving/{row.get('metric')}", {})[rnd] = {
+                "value": row.get("value"),
+                "unit": row.get("unit"),
+                "qps_achieved": extra.get("qps_achieved"),
+            }
+            for lat in ("p50_latency_ms", "p99_latency_ms"):
+                if isinstance(extra.get(lat), (int, float)):
+                    series.setdefault(f"serving/{lat}", {})[rnd] = {
+                        "value": extra[lat], "lower_is_better": True}
+    return series
+
+
 def check_regressions(series: "dict[str, dict[int, dict]]",
                       regression_frac: float) -> "list[str]":
     """Latest round of each series vs the BEST prior round: a drop past
-    ``regression_frac`` is a failure. One-round series pass (nothing
-    prior to regress from)."""
+    ``regression_frac`` is a failure (for ``lower_is_better`` series —
+    serving latencies — best is the MINIMUM and a growth past the
+    fraction fails). One-round series pass (nothing prior to regress
+    from)."""
     failures = []
     for name, rounds in sorted(series.items()):
         if name == "__skipped__" or len(rounds) < 2:
@@ -111,6 +143,18 @@ def check_regressions(series: "dict[str, dict[int, dict]]",
         prior = {r: rounds[r].get("value") for r in ordered[:-1]
                  if isinstance(rounds[r].get("value"), (int, float))}
         if not prior or not isinstance(latest_v, (int, float)):
+            continue
+        lower_better = any(rounds[r].get("lower_is_better")
+                           for r in ordered)
+        if lower_better:
+            best_r = min(prior, key=lambda r: prior[r])
+            ceiling = prior[best_r] * (1.0 + regression_frac)
+            if latest_v > ceiling:
+                failures.append(
+                    f"{name}: r{latest:02d} = {latest_v} is "
+                    f"{latest_v / prior[best_r] - 1:.1%} above the best "
+                    f"prior round r{best_r:02d} = {prior[best_r]} "
+                    f"(allowed +{regression_frac:.0%})")
             continue
         best_r = max(prior, key=lambda r: prior[r])
         floor = prior[best_r] * (1.0 - regression_frac)
@@ -168,6 +212,7 @@ def main(argv=None) -> int:
 
     series = load_bench_history(args.repo)
     series.update(load_scaling_history(args.repo))
+    series.update(load_serving_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

@@ -20,6 +20,17 @@ seed-chosen machine die permanently: the supervisor must reform at N-1
 workers via a resharded restore (``recovery.reshard`` gated).
 ``--mttr-budget`` additionally bounds each recovery's measured MTTR.
 
+``--serve`` sweeps the SERVING replica axis (ISSUE 9): each seed runs a
+supervised serving job (examples/serve_transformer.py --elastic) whose
+replica is SIGKILLed mid-load on a seed-derived schedule. A seed
+survives only when the job completes, ``obs_report --check --require``
+confirms the recovery timeline (``recovery.restart`` +
+``recovery.run_complete``) AND serving traffic (``serve.step``,
+``serve.request``), and the completion logs prove ZERO dropped
+requests: the union of ``served-*.jsonl`` ids equals the full seeded
+request set, with any cross-generation duplicates having generated
+IDENTICAL tokens (deterministic re-serve).
+
 Usage::
 
     python tools/chaos_sweep.py --seeds 10            # seeds 0..9
@@ -27,6 +38,7 @@ Usage::
     python tools/chaos_sweep.py --seeds 3 -- -k preemption
     python tools/chaos_sweep.py --kill --seeds 3      # SIGKILL sweep
     python tools/chaos_sweep.py --kill --shrink --workers 3 --seeds 3
+    python tools/chaos_sweep.py --serve --seeds 3     # serving sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -170,6 +182,98 @@ def run_kill_seed(seed: int, *, workers: int, steps: int,
     return ok, dt
 
 
+def _served_requests_gate(run_dir: str, n_requests: int,
+                          serve_seed: int) -> "list[str]":
+    """Zero dropped in-flight requests: the union of every replica's
+    ``served-*.jsonl`` must cover the full seeded request set exactly,
+    and any request served by more than one generation (killed after
+    completion, torn log line) must have produced IDENTICAL tokens —
+    greedy decode over fixed weights is deterministic, so divergence
+    means the restarted replica lost cache/weight state."""
+    import glob
+
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.serving.replica import seeded_requests
+    expected = {r.id for r in seeded_requests(serve_seed, n_requests, 256)}
+    seen: dict[str, list] = {}
+    bad = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "served-*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = __import__("json").loads(line)
+                except ValueError:
+                    continue              # torn tail: that id re-served
+                rid, toks = rec.get("id"), rec.get("tokens")
+                if rid in seen and seen[rid] != toks:
+                    bad.append(f"{rid}: generations disagree "
+                               f"({seen[rid]} vs {toks})")
+                seen.setdefault(rid, toks)
+    missing = expected - set(seen)
+    if missing:
+        bad.append(f"{len(missing)} request(s) DROPPED: "
+                   f"{sorted(missing)[:8]}")
+    extra = set(seen) - expected
+    if extra:
+        bad.append(f"unexpected request ids: {sorted(extra)[:8]}")
+    return bad
+
+
+def run_serve_seed(seed: int, *, workers: int, requests: int,
+                   budget: int, keep_dirs: bool) -> tuple[bool, float]:
+    """One supervised serving run with a seed-derived replica SIGKILL;
+    survival = clean exit + recovery & serving telemetry + zero dropped
+    requests (see ``--serve`` in the module docstring)."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_serve_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "serve_transformer.py"),
+           "--elastic", "--workers", str(workers),
+           "--requests", str(requests), "--seed", str(seed),
+           "--kill-seed", str(seed),
+           "--restart-budget", str(budget),
+           "--run-dir", run_dir, "--telemetry-dir", run_dir]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if ok:
+        gate_cmd = [sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    run_dir, "--check",
+                    "--require", "recovery.restart",
+                    "--require", "recovery.run_complete",
+                    "--require", "serve.step",
+                    "--require", "serve.request"]
+        gate = subprocess.run(gate_cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: run finished but telemetry gate "
+                  f"FAILED (rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
+    if ok:
+        violations = _served_requests_gate(run_dir, requests, seed)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: dropped/diverged requests ---")
+            for v in violations:
+                print(f"    {v}")
+    if not ok and proc.returncode != 0:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -181,6 +285,13 @@ def main(argv=None) -> int:
     ap.add_argument("--kill", action="store_true",
                     help="sweep seed-driven worker SIGKILLs through the "
                          "recovery supervisor instead of fault schedules")
+    ap.add_argument("--serve", action="store_true",
+                    help="sweep seed-driven SIGKILLs of SERVING replicas "
+                         "mid-load: supervisor must restart the replica, "
+                         "in-flight requests must be re-served (zero "
+                         "dropped), recovery visible in obs_report")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="--serve: seeded workload size per run")
     ap.add_argument("--shrink", action="store_true",
                     help="with --kill: permanent-loss schedules — the "
                          "seed-chosen machine dies for good and the "
@@ -208,9 +319,16 @@ def main(argv=None) -> int:
         ap.error("--shrink requires --kill")
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
+    if args.serve and args.kill:
+        ap.error("--serve and --kill are separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.kill:
+        if args.serve:
+            ok, dt = run_serve_seed(s, workers=args.workers,
+                                    requests=args.requests,
+                                    budget=args.restart_budget,
+                                    keep_dirs=args.keep_dirs)
+        elif args.kill:
             ok, dt = run_kill_seed(s, workers=args.workers,
                                    steps=args.steps,
                                    save_every=args.save_every,

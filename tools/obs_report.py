@@ -107,6 +107,9 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     recovery: list[dict] = []
     per_pid: dict[int, dict] = {}
     wall_min = wall_max = None
+    serve_latency: list[float] = []
+    serve_steps = 0
+    serve_tokens = 0
 
     # the supervisor writes under pid "supervisor": sort keys as strings
     for pid, events in sorted(events_by_pid.items(), key=lambda kv:
@@ -161,6 +164,15 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 d = ev.get("dur_s")
                 if isinstance(d, (int, float)):
                     ckpt[name].append(d)
+            elif name == "serve.request":
+                d = ev.get("dur_s")
+                if isinstance(d, (int, float)):
+                    serve_latency.append(d)
+                nt = ev.get("new_tokens")
+                if isinstance(nt, (int, float)):
+                    serve_tokens += int(nt)
+            elif name == "serve.step":
+                serve_steps += 1
             elif name == "stall.suspected":
                 stalls.append({k: ev.get(k) for k in
                                ("pid", "stalled_s", "median_step_s",
@@ -228,6 +240,12 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     return {
         "processes": per_pid,
         "step_time": _percentiles(steps),
+        "serving": {
+            "requests": len(serve_latency),
+            "steps": serve_steps,
+            "request_latency": _percentiles(serve_latency),
+            "tokens_generated": serve_tokens,
+        } if (serve_latency or serve_steps) else None,
         "phases": phases_report,
         "bottleneck": bottleneck,
         "steps_table": step_rows,
@@ -406,6 +424,17 @@ def render_text(report: dict, rollup: dict) -> str:
     if report["infeed_wait_fraction"] is not None:
         out.append(f"infeed wait {report['infeed_wait_fraction']:.1%} "
                    f"of step time")
+    if report.get("serving"):
+        sv = report["serving"]
+        lat = sv["request_latency"]
+        out.append(f"serving: {sv['requests']} request(s) over "
+                   f"{sv['steps']} serve step(s), "
+                   f"{sv['tokens_generated']} tokens generated")
+        if lat:
+            out.append(f"request latency  p50 {_fmt_ms(lat['p50'])}  "
+                       f"p95 {_fmt_ms(lat['p95'])}  "
+                       f"p99 {_fmt_ms(lat['p99'])}  "
+                       f"max {_fmt_ms(lat['max'])}")
     _render_phase_table(report, out)
     for pid, info in sorted(report["processes"].items(),
                             key=lambda kv: str(kv[0])):

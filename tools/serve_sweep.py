@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Serving-bench runner + row-shape gate (SERVING_r*.json).
+
+Runs ``bench.py --serving`` in a subprocess (CPU-pinned unless the env
+says otherwise), validates the emitted row against the serving-row
+contract, and optionally persists the checked shape as the round's
+``SERVING_r<NN>.json`` — the file ``tools/bench_trend.py`` trends and
+gates. ``--check FILE`` instead validates an existing file (CI mode:
+the checked-in round must still parse and satisfy the contract).
+
+Row contract (what downstream tooling depends on):
+
+- ``metric`` == ``serving_tokens_per_sec``, ``value`` > 0;
+- ``extra`` carries ``p50_latency_ms`` <= ``p99_latency_ms`` (both
+  > 0), ``qps_target`` > 0, ``qps_achieved`` > 0,
+  ``tokens_generated`` > 0, ``n_requests`` > 0, ``seed``;
+- every benched request completed: ``qps_achieved`` spans exactly
+  ``n_requests`` completions (the bench loop cannot exit otherwise,
+  so this is implied by the row existing — the gate checks the fields
+  that would expose a silent truncation).
+
+Usage::
+
+    python tools/serve_sweep.py                       # run + gate
+    python tools/serve_sweep.py --out SERVING_r01.json
+    python tools/serve_sweep.py --check SERVING_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REQUIRED_EXTRA = ("p50_latency_ms", "p99_latency_ms", "qps_target",
+                  "qps_achieved", "tokens_generated", "n_requests",
+                  "seed")
+
+
+def validate_row(row: dict) -> list[str]:
+    """Violation messages for one serving row (empty = ok)."""
+    bad = []
+    if row.get("metric") != "serving_tokens_per_sec":
+        bad.append(f"metric={row.get('metric')!r} != "
+                   f"'serving_tokens_per_sec'")
+    v = row.get("value")
+    if not isinstance(v, (int, float)) or v <= 0:
+        bad.append(f"value={v!r} not a positive number")
+    extra = row.get("extra")
+    if not isinstance(extra, dict):
+        return bad + ["extra missing"]
+    for k in REQUIRED_EXTRA:
+        if k not in extra:
+            bad.append(f"extra.{k} missing")
+    for k in ("p50_latency_ms", "p99_latency_ms", "qps_target",
+              "qps_achieved", "tokens_generated", "n_requests"):
+        x = extra.get(k)
+        if k in extra and (not isinstance(x, (int, float)) or x <= 0):
+            bad.append(f"extra.{k}={x!r} not positive")
+    p50, p99 = extra.get("p50_latency_ms"), extra.get("p99_latency_ms")
+    if isinstance(p50, (int, float)) and isinstance(p99, (int, float)) \
+            and p50 > p99:
+        bad.append(f"p50 {p50} > p99 {p99}")
+    return bad
+
+
+def validate_file(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable ({e})"]
+    if data.get("bench") != "serving":
+        return [f"{path}: bench={data.get('bench')!r} != 'serving'"]
+    rows = data.get("rows")
+    if not rows:
+        return [f"{path}: no rows"]
+    bad = []
+    for i, row in enumerate(rows):
+        bad += [f"row {i}: {m}" for m in validate_row(row)]
+    return bad
+
+
+def run_bench(out_path: str, qps, requests, seed, telemetry_dir) -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DTX_TELEMETRY_DIR"] = telemetry_dir
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--serving",
+           "--out", out_path, "--seed", str(seed)]
+    if qps is not None:
+        cmd += ["--qps", str(qps)]
+    if requests is not None:
+        cmd += ["--requests", str(requests)]
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT)
+    sys.stdout.write(proc.stdout.decode(errors="replace"))
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", metavar="FILE", default=None,
+                    help="validate an existing SERVING_r*.json instead "
+                         "of running the bench")
+    ap.add_argument("--out", default=None,
+                    help="persist the gated result (e.g. "
+                         "SERVING_r01.json)")
+    ap.add_argument("--qps", type=float, default=None)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.check:
+        bad = validate_file(args.check)
+        if bad:
+            for m in bad:
+                print(f"serve_sweep: GATE FAILED — {m}", file=sys.stderr)
+            return 1
+        print(f"serve_sweep: OK — {args.check} satisfies the "
+              f"serving-row contract")
+        return 0
+
+    tmp = tempfile.mkdtemp(prefix="dtx_serve_sweep_")
+    out_path = args.out or os.path.join(tmp, "serving.json")
+    rc = run_bench(out_path, args.qps, args.requests, args.seed, tmp)
+    if rc != 0:
+        print(f"serve_sweep: bench.py --serving failed (rc={rc})",
+              file=sys.stderr)
+        return 1
+    bad = validate_file(out_path)
+    # the bench must also have emitted its serving.row telemetry event
+    # (the obs pipeline's hook) into the run dir we configured
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    rows_seen = sum(
+        1 for events in read_run(tmp).values()
+        for ev in events if ev.get("ev") == "serving.row")
+    if rows_seen == 0:
+        bad.append("no serving.row telemetry event recorded")
+    if bad:
+        for m in bad:
+            print(f"serve_sweep: GATE FAILED — {m}", file=sys.stderr)
+        return 1
+    print(f"serve_sweep: OK — row gated"
+          + (f", persisted to {args.out}" if args.out else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
