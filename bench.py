@@ -23,6 +23,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import time
 
 import jax
@@ -664,10 +665,18 @@ def run_scaling(out_path: str | None = None, max_devices: int | None = None):
 
 
 def run_serving(out_path: str | None = None, *, qps: float | None = None,
-                n_requests: int | None = None, seed: int = 0):
+                n_requests: int | None = None, seed: int = 0,
+                slo_latency_ms: float | None = None):
     """Request-level serving bench (ISSUE 9): p50/p99 end-to-end latency
     and generated tokens/s at a target QPS through the continuous-
     batching engine (serving/engine.py).
+
+    The row also carries the live-health columns (ISSUE 10): a
+    **p99-latency SLO verdict** with multi-window burn rates
+    (telemetry/slo.py; threshold ``--slo-latency-ms``, windows scaled
+    to the run span) and the **goodput split** of the bench wall clock
+    (engine serve time = goodput, replayed tokens priced as
+    preempt_replay, the rest idle).
 
     Arrival schedule: seeded Poisson process at ``qps`` (exponential
     interarrivals from one ``random.Random`` stream — identical
@@ -725,8 +734,18 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
         t += rng.expovariate(qps)
         arrivals.append(t)
 
-    # warm both compiled programs (prefill + decode) off the clock
+    # warm both compiled programs (prefill + decode) off the clock AND
+    # off the record: a warmup request's latency is compile time, which
+    # would poison the SLO stream a health_report gate evaluates (a
+    # production replica warms up before joining the balancer too)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    tv_dir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tv_dir:
+        tv_events.shutdown()
     engine.generate([[1, 2, 3]], max_new_tokens=2)
+    if tv_dir:
+        tv_events.configure(tv_dir)
+    stats_warm = engine.stats()
 
     done: dict[str, dict] = {}
     pending = list(zip(arrivals, workload))
@@ -762,6 +781,41 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
     new_tokens = sum(len(r["tokens"]) for r in done.values()
                      if r.get("tokens"))
     stats = engine.stats()
+
+    # goodput split of the measured window (warmup excluded): engine
+    # serve-step time is goodput, the replayed-token share of it is
+    # preempt_replay badput, the remainder of wall is idle
+    from distributed_tensorflow_tpu.telemetry import slo as slo_lib
+    serve_s = stats["serve_time_s"] - stats_warm["serve_time_s"]
+    fresh = stats["tokens_generated"] - stats_warm["tokens_generated"]
+    replayed = stats["tokens_replayed"] - stats_warm["tokens_replayed"]
+    replay_frac = replayed / (fresh + replayed) if fresh + replayed \
+        else 0.0
+    goodput_frac = min(1.0, serve_s * (1.0 - replay_frac) / span)
+
+    # p99-latency SLO with burn-rate windows over the completion stream
+    # (record walls are relative to the bench clock; windows scale to
+    # the observed span)
+    if slo_latency_ms is None:
+        slo_latency_ms = 1000.0 if on_tpu else 100.0
+    records = [{"wall": arrival_wall[rid] + rec["latency_s"],
+                "latency_s": rec["latency_s"],
+                "ttft_s": rec.get("ttft_s"), "ok": True}
+               for rid, rec in done.items()]
+    slos = slo_lib.default_serving_slos(
+        latency_s=slo_latency_ms / 1e3,
+        windows=slo_lib.windows_for_span(span))
+    slo_verdict = slo_lib.evaluate_records(records, slos, now=span)
+    slo_extra = {
+        name: {"objective": res["objective"],
+               "threshold_ms": (round(res["threshold_s"] * 1e3, 3)
+                                if res["threshold_s"] else None),
+               "error_rate": res["error_rate"],
+               "budget_consumed": res["budget_consumed"],
+               "burn_rates": [w["burn_long"] for w in res["windows"]],
+               "firing": res["firing"]}
+        for name, res in slo_verdict.items()}
+
     row = {
         "metric": "serving_tokens_per_sec",
         "value": round(new_tokens / span, 1),
@@ -783,8 +837,21 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
             "num_blocks": engine.cache_cfg.num_blocks,
             "block_size": engine.cache_cfg.block_size,
             "seed": seed,
+            "goodput_frac": round(goodput_frac, 4),
+            "badput_replay_frac": round(
+                min(1.0, serve_s * replay_frac / span), 4),
+            "badput_idle_frac": round(
+                max(0.0, 1.0 - min(1.0, serve_s / span)), 4),
+            "slo": slo_extra,
         },
     }
+    firing = sorted(n for n, r in slo_extra.items() if r["firing"])
+    print(f"serving SLOs: "
+          + ("; ".join(f"{n} FIRING" for n in firing)
+             if firing else "all within budget")
+          + f"  (p99_latency budget consumed "
+          f"{slo_extra['p99_latency']['budget_consumed']:.2f}x of "
+          f"{slo_latency_ms:g}ms objective)", file=sys.stderr)
     telemetry.event("serving.row", metric=row["metric"],
                     value=row["value"],
                     **{k: v for k, v in row["extra"].items()
@@ -937,6 +1004,9 @@ if __name__ == "__main__":
                         help="with --serving: workload size")
     parser.add_argument("--seed", type=int, default=0,
                         help="with --serving: arrival-schedule seed")
+    parser.add_argument("--slo-latency-ms", type=float, default=None,
+                        help="with --serving: p99-latency SLO threshold "
+                             "(default 100 on cpu, 1000 on tpu)")
     parser.add_argument("--out", default=None,
                         help="with --scaling/--serving: also write the "
                              "full JSON (e.g. SCALING_r06.json / "
@@ -948,7 +1018,8 @@ if __name__ == "__main__":
         run_scaling(out_path=args.out, max_devices=args.max_devices)
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
-                    n_requests=args.requests, seed=args.seed)
+                    n_requests=args.requests, seed=args.seed,
+                    slo_latency_ms=args.slo_latency_ms)
     elif args.workload == "resnet50":
         run_resnet50()
     elif args.workload == "bert":

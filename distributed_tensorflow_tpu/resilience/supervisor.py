@@ -241,6 +241,49 @@ class RecoverySupervisor:
         self.generation = 0
         self.restarts_used = 0
         self._runner: mpr.MultiProcessRunner | None = None
+        self._exporter = None
+
+    # -- live health export -----------------------------------------------
+    def _health_lines(self) -> "list[str]":
+        """Exporter extra lines: the fleet goodput/badput ledger (and,
+        for serving jobs, SLO burn) recomputed from the run's event
+        files on every export tick — the workers' logs are
+        line-buffered, so this is the live fleet surface one scrape
+        (or ``metrics-live.prom`` read) sees."""
+        from distributed_tensorflow_tpu.telemetry import (
+            events as tv_events, goodput, slo as tv_slo)
+        events_by_pid = tv_events.read_run(self._telemetry_dir)
+        ledger = goodput.ledger_from_events(events_by_pid)
+        lines = goodput.prometheus_lines(ledger)
+        records = tv_slo.records_from_events(events_by_pid)
+        if records:
+            span = ((records[-1]["wall"] - records[0]["wall"])
+                    if len(records) > 1 else 1.0)
+            slos = tv_slo.default_serving_slos(
+                windows=tv_slo.windows_for_span(max(span, 1e-3)))
+            mon = tv_slo.SLOMonitor(slos)
+            for r in records:
+                mon.observe(r)
+            lines += mon.prometheus_lines()
+        return lines
+
+    def _start_exporter(self):
+        if self._telemetry_dir is None:
+            return
+        from distributed_tensorflow_tpu.telemetry import exporter
+        try:
+            self._exporter = exporter.MetricsExporter(
+                dir=self._telemetry_dir, interval_s=1.0,
+                extra_fn=self._health_lines,
+                labels={"job": "supervisor"})
+        except OSError:
+            self._exporter = None       # port taken: file export only
+                                        # would also have failed — skip
+
+    def _stop_exporter(self):
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
 
     @property
     def num_workers(self) -> int:
@@ -320,6 +363,7 @@ class RecoverySupervisor:
         self._event("recovery.run_start", num_workers=self._num_workers,
                     max_restarts=self.max_restarts,
                     chaos_kills=len(self._kills))
+        self._start_exporter()
         self._clear_heartbeats()
         self._runner.start()
         self._event("recovery.generation_start", generation=0)
@@ -341,6 +385,7 @@ class RecoverySupervisor:
                 self._recover(failures, backoff)
         finally:
             self._runner.terminate_all()
+            self._stop_exporter()
 
     def _result_failures(self, result) -> list[WorkerFailure]:
         return [WorkerFailure(generation=self.generation, task=k,

@@ -23,6 +23,16 @@ One :class:`InferenceEngine` is one serving replica's model runtime:
   spans in tools/trace_report.py). Instruments live under the shared
   ``inference/`` namespace (the one ``Model.predict`` also reports
   into) plus ``serving/`` for engine-specific gauges.
+- **Per-request tracing** — every request's lifecycle events
+  (``serve.admit`` → ``serve.prefill`` → per-token ``serve.token`` →
+  ``serve.request``) share a deterministic ``request_span_id`` derived
+  from the request id, so the trace assembler links them with flow
+  arrows — ACROSS preemption replays and replica restarts (a restarted
+  incarnation re-serving the same id emits the same span id, so one
+  request's whole story threads through both generations' tracks).
+  Serving steps also feed the live goodput ledger
+  (telemetry/goodput.py) when one is active, with replayed tokens
+  priced as ``preempt_replay`` badput.
 - **Chaos** — each step fires the ``serve.step`` injection site
   (resilience/faults.py) BEFORE mutating any scheduler state, so an
   injected failure is retryable: the replica runtime catches it and
@@ -38,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_tpu import telemetry
+from distributed_tensorflow_tpu.telemetry import goodput as _goodput
 from distributed_tensorflow_tpu.models.transformer import (
     TransformerConfig, TransformerLM)
 from distributed_tensorflow_tpu.resilience import faults
@@ -48,6 +59,14 @@ from distributed_tensorflow_tpu.serving.scheduler import (
     AdmissionQueue, ContinuousBatchingScheduler, Request, Sequence)
 from distributed_tensorflow_tpu.utils.jax_compat import (
     safe_donate_argnums)
+
+
+def request_span_id(request_id: str) -> str:
+    """Deterministic per-request trace span id. Derived from the
+    request id alone so every lifecycle event of one request — across
+    preemption replays, across replica generations — carries the SAME
+    id and the trace assembler threads them with flow arrows."""
+    return f"req/{request_id}"
 
 
 class InferenceEngine:
@@ -141,6 +160,9 @@ class InferenceEngine:
             "admission -> first generated token seconds")
         self._m_completed = reg.counter("inference/requests_completed")
         self._m_tokens = reg.counter("inference/tokens_generated")
+        self._m_replayed = reg.counter(
+            "inference/tokens_replayed",
+            "tokens re-generated after preemption/restart (badput)")
         self._m_step = reg.histogram("serving/step_time",
                                      "one continuous-batching iteration")
         self._m_running = reg.gauge("serving/sequences_running")
@@ -150,6 +172,7 @@ class InferenceEngine:
 
         self._step_idx = 0
         self._submitted: dict[str, float] = {}      # id -> wall arrival
+        self._submit_mono: dict[str, float] = {}    # id -> mono arrival
 
     # -- weights -----------------------------------------------------------
     @classmethod
@@ -208,9 +231,15 @@ class InferenceEngine:
                 f"exceeds max_seq_len {self.max_seq_len}")
         evicted = self.scheduler.queue.submit(request)
         self._submitted[request.id] = time.time()
+        self._submit_mono[request.id] = time.monotonic()
         if evicted is not None:
             self._submitted.pop(evicted.id, None)
+            self._submit_mono.pop(evicted.id, None)
         self._m_queued.set(len(self.scheduler.queue))
+        telemetry.event("serve.admit", id=request.id,
+                        span_id=request_span_id(request.id),
+                        prompt_tokens=len(request.tokens),
+                        queued=len(self.scheduler.queue))
         return evicted
 
     def _prefill_one(self, seq: Sequence):
@@ -219,16 +248,27 @@ class InferenceEngine:
         max_prompt_len so a PREEMPTED sequence's replayed prompt, which
         includes its already-generated tokens, always fits) and bank its
         first greedy token."""
-        P = self.max_seq_len
-        toks = np.zeros((1, P), np.int32)
-        toks[0, :seq.prompt_len] = seq.request.tokens
-        rows = seq.table.rows(np.arange(P))[None]       # (1, P)
-        lengths = np.asarray([seq.prompt_len], np.int32)
-        last, self.pool["k"], self.pool["v"] = self._prefill(
-            self.params, self.pool["k"], self.pool["v"],
-            jnp.asarray(toks), jnp.asarray(lengths), jnp.asarray(rows))
-        self.scheduler.commit_prefill(seq)
-        first = int(np.asarray(jnp.argmax(last[0])))
+        rid = seq.request.id
+        submit_mono = self._submit_mono.get(rid)
+        queue_wait = (seq.admitted_s - submit_mono
+                      if submit_mono is not None else None)
+        with telemetry.span(
+                "serve.prefill", id=rid, span_id=request_span_id(rid),
+                prompt_tokens=seq.prompt_len,
+                queue_wait_s=(round(queue_wait, 6)
+                              if queue_wait is not None else None),
+                replayed=len(seq.request.generated_prefix) or None):
+            P = self.max_seq_len
+            toks = np.zeros((1, P), np.int32)
+            toks[0, :seq.prompt_len] = seq.request.tokens
+            rows = seq.table.rows(np.arange(P))[None]       # (1, P)
+            lengths = np.asarray([seq.prompt_len], np.int32)
+            last, self.pool["k"], self.pool["v"] = self._prefill(
+                self.params, self.pool["k"], self.pool["v"],
+                jnp.asarray(toks), jnp.asarray(lengths),
+                jnp.asarray(rows))
+            self.scheduler.commit_prefill(seq)
+            first = int(np.asarray(jnp.argmax(last[0])))
         if seq.request.max_new_tokens > 0:
             self.scheduler.append_token(seq, first)
         else:
@@ -260,8 +300,21 @@ class InferenceEngine:
             jnp.asarray(lengths), jnp.asarray(write_rows),
             jnp.asarray(window_rows))
         nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        emit = telemetry.enabled()
         for seq in batch:
             self.scheduler.append_token(seq, int(nxt[seq.slot]))
+            if emit:
+                # per-token decode breadcrumb on the request's span:
+                # index counts generated tokens ACROSS preemptions (the
+                # replayed prefix included), so a re-served request's
+                # token trail lines up generation-to-generation
+                rid = seq.request.id
+                telemetry.event(
+                    "serve.token", id=rid,
+                    span_id=request_span_id(rid),
+                    index=(len(seq.request.generated_prefix)
+                           + len(seq.generated)),
+                    step=self._step_idx)
 
     def step(self) -> list[dict]:
         """One continuous-batching iteration; returns completion records
@@ -291,7 +344,11 @@ class InferenceEngine:
             sp["queued"] = len(sched.queue)
             sp["blocks_free"] = sched.allocator.num_free
         self._step_idx += 1
-        self._m_step.record(time.monotonic() - t0)
+        step_s = time.monotonic() - t0
+        self._m_step.record(step_s)
+        ledger = _goodput.active_ledger()
+        if ledger is not None:
+            ledger.serve_step(step_s)
         self._m_running.set(len(sched.running))
         self._m_queued.set(len(sched.queue))
         self._m_blocks_free.set(sched.allocator.num_free)
@@ -305,6 +362,7 @@ class InferenceEngine:
         req = seq.request
         now = time.time()
         arrival = self._submitted.pop(req.id, now)
+        self._submit_mono.pop(req.id, None)
         latency = max(0.0, now - arrival)
         ttft = ((seq.first_token_s - seq.admitted_s)
                 if seq.first_token_s is not None else None)
@@ -313,19 +371,28 @@ class InferenceEngine:
                                 or req.generated_prefix)
                   else [getattr(seq, "score_token", -1)])
         prompt_tokens = len(req.tokens) - len(req.generated_prefix)
+        replayed = len(req.generated_prefix)
         self._m_req_latency.record(latency)
         if ttft is not None:
             self._m_ttft.record(ttft)
         self._m_completed.increment()
         self._m_tokens.increment(len(seq.generated))
+        if replayed:
+            self._m_replayed.increment(replayed)
+        ledger = _goodput.active_ledger()
+        if ledger is not None:
+            ledger.tokens(fresh=len(seq.generated), replayed=replayed)
         telemetry.event(
             "serve.request", id=req.id, dur_s=round(latency, 6),
+            span_id=request_span_id(req.id),
             prompt_tokens=prompt_tokens, new_tokens=len(generated),
+            replayed_tokens=replayed,
             ttft_s=round(ttft, 6) if ttft is not None else None,
             preemptions=seq.preemptions)
         return {"id": req.id, "tokens": tokens,
                 "prompt_tokens": prompt_tokens,
                 "latency_s": latency, "ttft_s": ttft,
+                "replayed_tokens": replayed,
                 "preemptions": seq.preemptions}
 
     # -- convenience -------------------------------------------------------
@@ -374,4 +441,6 @@ class InferenceEngine:
             "queue_evicted": sched.queue.evicted,
             "requests_completed": self._m_completed.value,
             "tokens_generated": self._m_tokens.value,
+            "tokens_replayed": self._m_replayed.value,
+            "serve_time_s": self._m_step.export().get("sum", 0.0),
         }

@@ -114,6 +114,13 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
     if tdir:
         tv_events.configure(tdir, process_id=task)
+    # live goodput ledger: serve steps are goodput, replayed tokens are
+    # preempt_replay badput; everything before the first step is
+    # startup. Exported through the registry (goodput/* gauges) and, in
+    # the event files, re-derivable fleet-wide by the supervisor's
+    # export tick.
+    from distributed_tensorflow_tpu.telemetry import goodput
+    goodput.activate(goodput.GoodputLedger())
 
     cfg = TransformerConfig.tiny(max_seq_len=64)
     kwargs = dict(num_blocks=48, block_size=8, max_slots=4,
@@ -174,6 +181,7 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     print(f"[gen {gen} serve-{task}] served {served} "
           f"({len(done) + served}/{len(mine)} of this replica's shard), "
           f"{retries} injected-fault retries", flush=True)
+    goodput.activate(None)
     if tdir:
         tv_events.shutdown()
     bootstrap.shutdown()

@@ -24,6 +24,17 @@ coordinator monitored_timer metrics + tf.summary event files, SURVEY.md
   via ``span_id`` flow arrows, and a bottleneck classifier (input- /
   comm- / compute- / checkpoint- / recovery-bound) with explicit
   thresholds; rendered by ``tools/trace_report.py``.
+- :mod:`exporter`  — LIVE export: bounded ring-buffer time-series per
+  instrument, Prometheus text endpoint (``DTX_METRICS_PORT``) with a
+  ``metrics-live.prom`` file fallback, fleet merge over KV rollups.
+- :mod:`goodput`   — goodput/badput ledger pricing every wall-clock
+  second into productive step time vs named waste buckets (startup,
+  infeed wait, checkpoint block, recovery, preempt replay, idle) with
+  ``wall == goodput + Σ badput`` enforced; rendered/gated by
+  ``tools/health_report.py``.
+- :mod:`slo`       — declarative serving SLOs (p99 latency, TTFT,
+  availability) evaluated over multi-window burn rates, live and as CI
+  gates.
 
 Quick start::
 
@@ -87,6 +98,29 @@ from distributed_tensorflow_tpu.telemetry.trace import (
     trace_completeness,
     write_trace,
 )
+from distributed_tensorflow_tpu.telemetry.exporter import (
+    ENV_METRICS_PORT,
+    LIVE_METRICS_FILE,
+    MetricsExporter,
+    SeriesHistory,
+    render_prometheus,
+    render_rollup,
+)
+from distributed_tensorflow_tpu.telemetry.goodput import (
+    BADPUT_BUCKETS,
+    GoodputLedger,
+    ledger_from_events,
+    ledger_from_run,
+)
+from distributed_tensorflow_tpu.telemetry.slo import (
+    DEFAULT_BURN_WINDOWS,
+    SLO,
+    SLOMonitor,
+    default_serving_slos,
+    evaluate_records,
+    records_from_events,
+    windows_for_span,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Timer",
@@ -101,4 +135,10 @@ __all__ = [
     "BOTTLENECK_THRESHOLDS", "assemble_run", "assemble_trace",
     "classify_run", "estimate_clock_offsets", "overlap_efficiency",
     "trace_completeness", "write_trace",
+    "ENV_METRICS_PORT", "LIVE_METRICS_FILE", "MetricsExporter",
+    "SeriesHistory", "render_prometheus", "render_rollup",
+    "BADPUT_BUCKETS", "GoodputLedger", "ledger_from_events",
+    "ledger_from_run",
+    "DEFAULT_BURN_WINDOWS", "SLO", "SLOMonitor", "default_serving_slos",
+    "evaluate_records", "records_from_events", "windows_for_span",
 ]

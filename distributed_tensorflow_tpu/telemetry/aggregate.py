@@ -261,6 +261,15 @@ class FleetAggregator:
         with self._rollup_lock:
             return self._last_rollup
 
+    def export_live(self, **exporter_kwargs):
+        """Start a :class:`telemetry.exporter.MetricsExporter` whose
+        scrape merges this aggregator's latest fleet rollup (per-worker
+        ``worker="<pid>"`` labels) — ONE scrape of the coordinator sees
+        every worker. Caller owns ``.stop()``."""
+        from distributed_tensorflow_tpu.telemetry import exporter
+        return exporter.MetricsExporter(
+            rollup_fn=lambda: self.last_rollup, **exporter_kwargs)
+
     def collect_once(self) -> dict:
         rollup = collect_rollup(self.agent, self.worker_ids)
         with self._rollup_lock:

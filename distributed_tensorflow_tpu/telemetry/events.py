@@ -51,19 +51,45 @@ class EventLogCorruptError(ValueError):
     are expected from crashed writers; mid-file damage is not)."""
 
 
+#: Env var: rotate a process's events.jsonl once it exceeds this many
+#: bytes (``events.jsonl`` -> ``events.jsonl.1``, older segments shift
+#: up). Unset/0 = never rotate (the pre-rotation behavior).
+ENV_ROTATE_BYTES = "DTX_TELEMETRY_ROTATE_BYTES"
+
+
 class EventLog:
     """Append-only JSONL event writer for one process.
 
     One file handle per process, all writes serialized under a lock and
     written as complete lines (a reader can never observe a half
     record except the final line of a crashed writer).
+
+    **Rotation:** with ``max_bytes`` set (arg, or the
+    ``DTX_TELEMETRY_ROTATE_BYTES`` env var spawned children inherit),
+    the file rotates to ``<path>.1`` when a write pushes it past the
+    cap (``.1`` -> ``.2`` and so on shift up first), so a long-lived
+    serving replica's log stays size-capped per segment.
+    :func:`read_events` transparently chains the rotated segments back
+    in chronological order — trace/obs reports are unchanged. Rotation
+    happens at a line boundary, so rotated segments are always whole.
     """
 
     def __init__(self, path: str, process_id: "int | str | None" = None,
-                 run_id: str | None = None):
+                 run_id: str | None = None,
+                 max_bytes: "int | None" = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.process_id = process_id if process_id is not None else 0
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(ENV_ROTATE_BYTES, "0"))
+            except ValueError:
+                max_bytes = 0
+        self.max_bytes = max_bytes or 0
+        try:
+            self._size = os.path.getsize(path)
+        except OSError:
+            self._size = 0
         self._lock = threading.Lock()
         # line-buffered: every complete event line reaches the OS as it
         # is written, so a process that dies hard (SIGKILL, os._exit —
@@ -106,8 +132,27 @@ class EventLog:
             if self._gen:
                 rec["gen"] = self._gen
             rec.update(fields)
-            self._f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+            self._f.write(line)
+            self._size += len(line)
+            if self.max_bytes and self._size > self.max_bytes:
+                self._rotate_locked()
         return rec
+
+    def _rotate_locked(self):
+        """Shift rotated segments up and start a fresh file (caller
+        holds the lock; the write that crossed the cap is complete, so
+        every segment ends at a line boundary)."""
+        self._f.flush()
+        self._f.close()
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        for i in range(n, 1, -1):
+            os.replace(f"{self.path}.{i - 1}", f"{self.path}.{i}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", buffering=1, encoding="utf-8")
+        self._size = 0
 
     @contextlib.contextmanager
     def span(self, name: str, **fields):
@@ -251,14 +296,21 @@ del _env
 # Reading back
 # ---------------------------------------------------------------------------
 
-def read_events(path: str, *, tolerate_torn_tail: bool = True) -> list[dict]:
-    """Parse one JSONL event file.
+def rotated_segments(path: str) -> list[str]:
+    """Rotated siblings of an event file in CHRONOLOGICAL order
+    (``path.N`` is older than ``path.N-1``; the live ``path`` itself is
+    newest and not included)."""
+    import glob
+    import re
+    segs = []
+    for p in glob.glob(glob.escape(path) + ".*"):
+        m = re.match(re.escape(path) + r"\.(\d+)$", p)
+        if m:
+            segs.append((int(m.group(1)), p))
+    return [p for _, p in sorted(segs, reverse=True)]
 
-    A torn FINAL line (crashed writer) is dropped when
-    ``tolerate_torn_tail`` (the default); malformed content anywhere
-    before the final line raises :class:`EventLogCorruptError` —
-    mid-file corruption means the file cannot be trusted at all.
-    """
+
+def _read_one(path: str, *, tolerate_torn_tail: bool) -> list[dict]:
     out: list[dict] = []
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         lines = f.read().split("\n")
@@ -275,6 +327,29 @@ def read_events(path: str, *, tolerate_torn_tail: bool = True) -> list[dict]:
             raise EventLogCorruptError(
                 f"{path}:{i + 1}: malformed event line: {e}") from e
         out.append(rec)
+    return out
+
+
+def read_events(path: str, *, tolerate_torn_tail: bool = True,
+                include_rotated: bool = True) -> list[dict]:
+    """Parse one JSONL event file (chaining any rotated segments).
+
+    A torn FINAL line (crashed writer) is dropped when
+    ``tolerate_torn_tail`` (the default); malformed content anywhere
+    before the final line raises :class:`EventLogCorruptError` —
+    mid-file corruption means the file cannot be trusted at all.
+
+    When the writer rotated (``<path>.N`` siblings exist), the rotated
+    segments are read first in chronological order — transparently, so
+    every consumer of the base file sees the full history. Rotation
+    happens at line boundaries, so only the LIVE file may have a torn
+    tail; a malformed line inside a rotated segment is corruption.
+    """
+    out: list[dict] = []
+    if include_rotated:
+        for seg in rotated_segments(path):
+            out.extend(_read_one(seg, tolerate_torn_tail=False))
+    out.extend(_read_one(path, tolerate_torn_tail=tolerate_torn_tail))
     return out
 
 

@@ -164,12 +164,18 @@ class StallDetector:
             except Exception:
                 rollup = None
         wid, reason = suspect_worker(rollup, self.reg)
+        # the badput class the blocked time is accruing to (the live
+        # goodput ledger's current bucket; "idle" when no ledger is
+        # active) — a stall names both the blocked lane AND the bucket
+        # it is pricing into
+        from distributed_tensorflow_tpu.telemetry import goodput
         info = {"last_step": last_step,
                 "stalled_s": round(stalled_s, 3) if stalled_s else None,
                 "median_step_s": (round(median, 6)
                                   if median is not None else None),
                 "factor": self.factor,
-                "suspect_worker": wid, "suspect_reason": reason}
+                "suspect_worker": wid, "suspect_reason": reason,
+                "badput_bucket": goodput.accruing_bucket()}
         self._stall_counter.increment()
         _events.event("stall.suspected", **info)
         if self.on_stall is not None:

@@ -163,9 +163,9 @@ def estimate_clock_offsets(events_by_pid: dict,
 
 #: Dotted-namespace prefix -> named track (Chrome-trace tid). Everything
 #: else lands on a track named after its first namespace component.
-_TRACK_ORDER = ["train", "checkpoint", "recovery", "dispatch", "worker",
-                "pipeline", "input", "fault", "stall", "scaling",
-                "profiler", "clock", "run"]
+_TRACK_ORDER = ["train", "serve", "checkpoint", "recovery", "dispatch",
+                "worker", "pipeline", "input", "fault", "stall",
+                "scaling", "profiler", "clock", "run"]
 
 _SKIP_ARG_FIELDS = frozenset({"ev", "t", "wall", "pid", "dur_s"})
 

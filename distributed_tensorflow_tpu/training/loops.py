@@ -176,6 +176,16 @@ class StepTelemetry:
                 loss = None
         if loss is not None:
             self._loss.set(loss)
+        if self._event_name == "train.step":
+            # feed the live goodput ledger (if one is active): step time
+            # minus the blocked shares is goodput, the blocked shares
+            # are named badput
+            from distributed_tensorflow_tpu.telemetry import goodput
+            ledger = goodput.active_ledger()
+            if ledger is not None:
+                ledger.step_completed(
+                    dur_s, infeed_s=wait_s or 0.0,
+                    ckpt_s=(phases or {}).get("ckpt_block", 0.0))
         if telemetry.enabled():
             fields = {"dur_s": round(dur_s, 6)}
             if step is not None:

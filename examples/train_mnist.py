@@ -60,6 +60,12 @@ def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
     tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
     if tdir:
         tv_events.configure(tdir, process_id=runtime.process_id)
+    # live goodput ledger: per-step feeding below prices infeed/ckpt
+    # blocking; enter("ckpt_block") names the bucket a stall during a
+    # blocking save would accrue to
+    from distributed_tensorflow_tpu.telemetry import goodput
+    ledger = goodput.GoodputLedger()
+    goodput.activate(ledger)
 
     state, model, tx = create_train_state(jax.random.PRNGKey(0),
                                           learning_rate=lr)
@@ -131,19 +137,25 @@ def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
         ckpt_s = 0.0
         if (step + 1) % save_every == 0:
             refresh_tracked()
+            ledger.enter("ckpt_block")
             mgr.save(checkpoint_number=step + 1)
+            ledger.enter("idle")
             ckpt_s = _time.perf_counter() - t3
         elif (store is not None and snapshot_every
               and (step + 1) % snapshot_every == 0):
             refresh_tracked()
+            ledger.enter("ckpt_block")
             mgr.snapshot(step + 1)   # memory-only: the cheap hot tier
+            ledger.enter("idle")
             ckpt_s = _time.perf_counter() - t3
+        dur_s = _time.perf_counter() - t0
         tv_events.event(
             "train.step", step=step, loss=loss,
-            dur_s=round(_time.perf_counter() - t0, 6),
+            dur_s=round(dur_s, 6),
             compute_s=round((t1 - t0) + (t3 - t2), 6),
             collective_s=round(t2 - t1, 6),
             ckpt_block_s=round(ckpt_s, 6))
+        ledger.step_completed(dur_s, ckpt_s=ckpt_s)
         if step % 10 == 0 and pid == 0:
             print(f"[gen {runtime.generation}] step {step}: "
                   f"loss={float(loss):.4f}")
@@ -261,8 +273,12 @@ def main():
         create_train_state, make_train_step, synthetic_data)
     from distributed_tensorflow_tpu.parallel.mirrored import MirroredStrategy
 
+    exporter = None
     if args.telemetry_dir:
         telemetry.configure(args.telemetry_dir)
+        # live scrape: metrics-live.prom in the run dir (plus /metrics
+        # when DTX_METRICS_PORT is set)
+        exporter = telemetry.MetricsExporter(dir=args.telemetry_dir)
 
     strategy = MirroredStrategy()
     print(f"devices: {strategy.num_replicas_in_sync} replicas on "
@@ -292,6 +308,8 @@ def main():
             print(f"step {step}: loss={float(metrics['loss']):.4f} "
                   f"acc={float(metrics['accuracy']):.3f}")
     print("done")
+    if exporter is not None:
+        exporter.stop()
     telemetry.shutdown()
 
 

@@ -1,0 +1,482 @@
+"""Live fleet health tests (ISSUE 10): goodput/badput ledger accounting
+identity, SLO burn-rate window math against hand-computed fixtures,
+streaming Prometheus export, and the health_report CI gates.
+
+The ledger identity ``wall == goodput + Σ badput`` is the load-bearing
+contract: it is asserted exact (1e-6) for the live ledger and the
+event-walk under overlapping spans, SIGKILL-torn writer tails, and
+generation bumps — the conditions chaos_sweep gates at ±1%.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from distributed_tensorflow_tpu import telemetry
+from distributed_tensorflow_tpu.telemetry import goodput
+from distributed_tensorflow_tpu.telemetry import slo as slo_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _identity_err(led: dict) -> float:
+    return abs(led["wall_s"]
+               - (led["goodput_s"] + sum(led["badput_s"].values())))
+
+
+# ---------------------------------------------------------------------------
+# live ledger
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_live_ledger_identity_and_buckets():
+    clk = FakeClock()
+    led = goodput.GoodputLedger(clock=clk, register=False)
+    assert led.current_bucket == "startup"
+    clk.advance(2.0)                       # spawn + compile
+    clk.advance(0.5)
+    led.step_completed(0.5, infeed_s=0.1, ckpt_s=0.05)
+    assert led.current_bucket == "idle"
+    clk.advance(0.5)
+    led.step_completed(0.5)
+    clk.advance(0.25)                      # trailing drain
+    snap = led.snapshot()
+    b = snap["badput_s"]
+    assert abs(snap["wall_s"] - 3.25) < 1e-9
+    assert abs(b["startup"] - 2.0) < 1e-9
+    assert abs(b["infeed_wait"] - 0.1) < 1e-9
+    assert abs(b["ckpt_block"] - 0.05) < 1e-9
+    assert abs(b["idle"] - 0.25) < 1e-9
+    assert abs(snap["goodput_s"] - (0.35 + 0.5)) < 1e-9
+    assert _identity_err(snap) < 1e-9
+
+
+def test_live_ledger_serving_replay_split():
+    clk = FakeClock()
+    led = goodput.GoodputLedger(clock=clk, register=False)
+    clk.advance(1.0)
+    led.serve_step(1.0)
+    clk.advance(1.0)
+    led.serve_step(1.0)
+    led.tokens(fresh=6, replayed=2)        # 25% of decode work replayed
+    snap = led.snapshot()
+    assert abs(snap["goodput_s"] - 1.5) < 1e-9
+    assert abs(snap["badput_s"]["preempt_replay"] - 0.5) < 1e-9
+    assert _identity_err(snap) < 1e-9
+
+
+def test_live_ledger_overclaim_clamped():
+    """Attribution can never exceed elapsed wall (overlapping timers,
+    double-counted spans): claims are clamped, identity still exact."""
+    clk = FakeClock()
+    led = goodput.GoodputLedger(clock=clk, register=False)
+    clk.advance(1.0)
+    led.step_completed(5.0)                # claims only the 1s there is
+    snap = led.snapshot()
+    assert abs(snap["goodput_s"] - 1.0) < 1e-9
+    assert snap["badput_s"]["idle"] == 0.0
+    assert _identity_err(snap) < 1e-9
+
+
+def test_live_ledger_explicit_record_and_collector():
+    clk = FakeClock()
+    reg = telemetry.MetricsRegistry()
+    led = goodput.GoodputLedger(reg=reg, clock=clk)
+    clk.advance(1.0)
+    led.record("recovery", 0.4)
+    with pytest.raises(ValueError):
+        led.record("not-a-bucket", 1.0)
+    snap = reg.snapshot()
+    assert snap["goodput/badput/recovery_s"]["value"] == 0.4
+    assert abs(snap["goodput/wall_s"]["value"] - 1.0) < 1e-9
+    led.close()
+    assert "goodput/wall_s" not in reg.snapshot()
+
+
+def test_accruing_bucket_follows_active_ledger():
+    assert goodput.accruing_bucket() == "idle"      # no ledger: honest
+    led = goodput.GoodputLedger(register=False)
+    prev = goodput.activate(led)
+    try:
+        assert goodput.accruing_bucket() == "startup"
+        led.step_completed(0.001)
+        led.enter("ckpt_block")
+        assert goodput.accruing_bucket() == "ckpt_block"
+        with pytest.raises(ValueError):
+            led.enter("nope")
+    finally:
+        goodput.activate(prev)
+
+
+# ---------------------------------------------------------------------------
+# event-walk ledger
+# ---------------------------------------------------------------------------
+
+def _ev(name, wall, **kw):
+    return {"ev": name, "wall": wall, "pid": 0, **kw}
+
+
+def test_event_ledger_partitions_training_run():
+    events = {0: [
+        _ev("run.start", 100.0),
+        _ev("train.step", 102.0, dur_s=0.5,
+            infeed_wait_s=0.1, ckpt_block_s=0.05),   # startup 1.5
+        _ev("train.step", 103.0, dur_s=0.5),          # idle 0.5
+        _ev("checkpoint.save", 103.4, dur_s=0.2),     # idle 0.4
+    ]}
+    led = goodput.ledger_from_events(events)
+    b = led["badput_s"]
+    assert abs(led["wall_s"] - 3.4) < 1e-9
+    assert abs(b["startup"] - 1.5) < 1e-9
+    assert abs(b["infeed_wait"] - 0.1) < 1e-9
+    assert abs(b["ckpt_block"] - 0.05) < 1e-9
+    assert abs(b["idle"] - 0.9) < 1e-9
+    assert abs(led["goodput_s"] - (0.35 + 0.5)) < 1e-9
+    assert _identity_err(led) < 1e-9
+    assert abs(led["identity_error_s"]) < 1e-9
+
+
+def test_event_ledger_overlapping_spans_clip_not_doublecount():
+    """A step whose dur_s overlaps the previous event (overlapping
+    spans / rounding) is clipped to the uncovered interval — the
+    identity survives arbitrarily pathological durations."""
+    events = {0: [
+        _ev("train.step", 100.0, dur_s=0.5),
+        _ev("train.step", 100.2, dur_s=9.0,            # claims > gap
+            infeed_wait_s=5.0),                        # > clipped span
+        _ev("train.step", 100.4, dur_s=0.1),
+    ]}
+    led = goodput.ledger_from_events(events)
+    assert abs(led["wall_s"] - 0.9) < 1e-9    # opens at 100.0 - 0.5
+    assert _identity_err(led) < 1e-9
+    # the 9s-claiming step got exactly the 0.2s that existed, all of it
+    # infeed-blocked after clipping
+    assert led["badput_s"]["infeed_wait"] <= 0.2 + 1e-9
+
+
+def test_event_ledger_generation_bump_prices_recovery():
+    """gen-stamped events after a SIGKILL: the dead gap between the old
+    incarnation's last event and the new generation's first is recovery
+    badput, and the new incarnation's pre-step time is startup again."""
+    events = {0: [
+        _ev("train.step", 100.0, dur_s=0.2),
+        _ev("train.step", 100.5, dur_s=0.2),
+        # --- SIGKILL; supervisor reforms; gen 1 appends to same file
+        _ev("run.start", 103.0, gen=1),
+        _ev("train.step", 104.0, dur_s=0.2, gen=1),
+        _ev("train.step", 104.5, dur_s=0.2, gen=1),
+    ]}
+    led = goodput.ledger_from_events(events)
+    b = led["badput_s"]
+    assert abs(b["recovery"] - 2.5) < 1e-9            # 100.5 -> 103.0
+    assert abs(b["startup"] - 0.8) < 1e-9             # 103.0 -> 104.0-0.2
+    assert abs(led["goodput_s"] - 0.8) < 1e-9
+    assert _identity_err(led) < 1e-9
+
+
+def test_event_ledger_sigkilled_writer_torn_tail(tmp_path):
+    """A SIGKILL'd writer's torn tail must not break the identity: the
+    torn line is dropped by the reader and the ledger prices what the
+    intact records cover."""
+    path = tmp_path / "events-0.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_ev("train.step", 10.0, dur_s=0.1)) + "\n")
+        f.write(json.dumps(_ev("train.step", 10.5, dur_s=0.1)) + "\n")
+        f.write('{"ev": "train.step", "wall": 11.0, "du')    # torn
+    led = goodput.ledger_from_run(str(tmp_path))
+    assert abs(led["wall_s"] - 0.6) < 1e-9    # opens at 10.0 - 0.1
+    assert _identity_err(led) < 1e-9
+
+
+def test_event_ledger_serving_replay_bucket():
+    """serve.step time splits goodput vs preempt_replay by the replayed
+    token share reported on serve.request completions."""
+    events = {0: [
+        _ev("serve.step", 100.0, dur_s=0.5),
+        _ev("serve.step", 100.5, dur_s=0.5),
+        _ev("serve.request", 100.5, dur_s=0.9, new_tokens=8,
+            replayed_tokens=2),
+    ]}
+    led = goodput.ledger_from_events(events)
+    assert abs(led["goodput_s"] - 0.75) < 1e-9        # 1.0 * 6/8
+    assert abs(led["badput_s"]["preempt_replay"] - 0.25) < 1e-9
+    assert _identity_err(led) < 1e-9
+
+
+def test_event_ledger_supervisor_not_hardware():
+    events = {
+        0: [_ev("train.step", 100.0, dur_s=0.1),
+            _ev("train.step", 101.0, dur_s=0.1)],
+        "supervisor": [_ev("recovery.run_start", 90.0),
+                       _ev("recovery.run_complete", 200.0)],
+    }
+    led = goodput.ledger_from_events(events)
+    assert abs(led["wall_s"] - 1.1) < 1e-9    # opens at 100.0 - 0.1
+    assert list(led["per_worker"]) == [0]
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math (hand-computed fixtures)
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_hand_computed():
+    # objective 0.9 -> budget 0.1; 10 requests, 3 bad -> error rate 0.3
+    # -> burn 3.0
+    slo = slo_lib.SLO("p", "latency", objective=0.9, threshold_s=0.1,
+                      windows=((100.0, 10.0, 2.0),))
+    recs = [{"wall": float(i), "latency_s": 0.2 if i < 3 else 0.01}
+            for i in range(10)]
+    assert slo_lib.burn_rate(recs, slo, window_s=100.0, now=9.0) \
+        == pytest.approx(3.0)
+    # short window (9-10]: only wall=9 (good) in window -> burn 0
+    assert slo_lib.burn_rate(recs, slo, window_s=1.0, now=9.0) \
+        == pytest.approx(0.0)
+    # empty window: None, not 0 (no evidence)
+    assert slo_lib.burn_rate(recs, slo, window_s=1.0, now=50.0) is None
+
+
+def test_multi_window_firing_requires_both():
+    slo = slo_lib.SLO("p", "latency", objective=0.9, threshold_s=0.1,
+                      windows=((100.0, 10.0, 2.0),))
+    # bad requests ONLY early: long burn high, short burn 0 -> no fire
+    early_bad = [{"wall": float(i), "latency_s": 0.2} for i in range(5)]
+    early_bad += [{"wall": float(i), "latency_s": 0.01}
+                  for i in range(5, 100)]
+    res = slo_lib.evaluate_records(early_bad, [slo], now=99.0)["p"]
+    assert not res["firing"]
+    # bad requests throughout: both windows over 2.0 -> fires
+    all_bad = [{"wall": float(i), "latency_s": 0.2} for i in range(100)]
+    res = slo_lib.evaluate_records(all_bad, [slo], now=99.0)["p"]
+    assert res["windows"][0]["burn_long"] == pytest.approx(10.0)
+    assert res["windows"][0]["burn_short"] == pytest.approx(10.0)
+    assert res["firing"]
+    # budget: 100% error rate / 10% budget = 10x consumed
+    assert res["budget_consumed"] == pytest.approx(10.0)
+
+
+def test_availability_and_ttft_metrics():
+    av = slo_lib.SLO("a", "availability", objective=0.99)
+    tt = slo_lib.SLO("t", "ttft", objective=0.5, threshold_s=0.05)
+    recs = [{"wall": 1.0, "latency_s": 0.01, "ttft_s": 0.1, "ok": False},
+            {"wall": 2.0, "latency_s": 0.01, "ttft_s": 0.01, "ok": True}]
+    out = slo_lib.evaluate_records(recs, [av, tt], now=2.0)
+    assert out["a"]["bad"] == 1 and out["a"]["error_rate"] == 0.5
+    assert out["t"]["bad"] == 1                 # one ttft over 50ms
+    # missing ttft is not an error for the ttft SLO
+    out2 = slo_lib.evaluate_records(
+        [{"wall": 1.0, "latency_s": 0.01, "ttft_s": None}], [tt])
+    assert out2["t"]["bad"] == 0
+
+
+def test_windows_scale_to_span_and_validation():
+    ws = slo_lib.windows_for_span(21.6)
+    # longest preset window (6h) -> 21.6s; shapes and burns preserved
+    assert ws[-1][0] == pytest.approx(21.6)
+    assert ws[0][0] == pytest.approx(3.6)
+    assert ws[0][2] == 14.4 and ws[-1][2] == 6.0
+    with pytest.raises(ValueError):
+        slo_lib.SLO("x", "latency", objective=0.99)   # no threshold
+    with pytest.raises(ValueError):
+        slo_lib.SLO("x", "nope", objective=0.99, threshold_s=1.0)
+    with pytest.raises(ValueError):
+        slo_lib.SLO("x", "latency", objective=1.5, threshold_s=1.0)
+
+
+def test_slo_monitor_live_and_prom_lines():
+    slo = slo_lib.SLO("p99", "latency", objective=0.9, threshold_s=0.1,
+                      windows=((100.0, 10.0, 2.0),))
+    mon = slo_lib.SLOMonitor([slo], max_records=4)
+    for i in range(8):                          # ring keeps newest 4
+        mon.observe({"wall": float(i), "latency_s": 0.2})
+    res = mon.evaluate(now=7.0)["p99"]
+    assert res["requests"] == 4
+    lines = mon.prometheus_lines(now=7.0)
+    assert any(l.startswith('dtx_slo_firing{slo="p99"} 1')
+               for l in lines), lines
+
+
+# ---------------------------------------------------------------------------
+# exporter
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_kinds_and_sanitization():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("training/steps_completed").increment(7)
+    reg.gauge("serving/blocks_free").set(12)
+    reg.gauge("serving/label").set("text-not-exported")
+    h = reg.histogram("training/step_time")
+    h.record(0.01)
+    lines = telemetry.render_prometheus(reg.snapshot())
+    text = "\n".join(lines)
+    assert "dtx_training_steps_completed 7" in text
+    assert "dtx_serving_blocks_free 12" in text
+    assert 'dtx_training_step_time{quantile="0.50"} 0.01' in text
+    assert "dtx_training_step_time_count 1" in text
+    assert "text-not-exported" not in text
+
+
+def test_render_rollup_worker_labels():
+    from distributed_tensorflow_tpu.telemetry.aggregate import (
+        merge_rollup)
+    snaps = {p: {"pid": p, "seq": 1, "wall": 1.0,
+                 "metrics": {"training/steps_completed":
+                             {"type": "counter", "value": 10 * (p + 1)}}}
+             for p in (0, 1)}
+    lines = telemetry.render_rollup(merge_rollup(snaps))
+    text = "\n".join(lines)
+    assert 'dtx_fleet_training_steps_completed{stat="sum"} 30' in text
+    assert 'dtx_fleet_training_steps_completed{worker="0"} 10' in text
+    assert 'dtx_fleet_training_steps_completed{worker="1"} 20' in text
+
+
+def test_series_history_delta_and_rate():
+    hist = telemetry.SeriesHistory(points=16)
+    for t in range(5):
+        hist.record({"c": {"type": "counter", "value": 10 * t}},
+                    wall=100.0 + t)
+    # unchanged snapshot adds no point
+    hist.record({"c": {"type": "counter", "value": 40}}, wall=110.0)
+    assert len(hist.series("c")) == 5
+    assert hist.rate("c", window_s=10.0, now=104.0) \
+        == pytest.approx(10.0)
+    assert hist.rate("c", window_s=0.5, now=104.0) is None
+
+
+def test_metrics_exporter_file_http_and_extra(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("x").increment(3)
+    ex = telemetry.MetricsExporter(
+        reg, dir=str(tmp_path), port=0, interval_s=30.0,
+        extra_fn=lambda: ["# extra", "dtx_custom 1"])
+    try:
+        ex.tick()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=5).read()
+        assert b"dtx_x 3" in body and b"dtx_custom 1" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ex.port}/nope", timeout=5)
+        prom = tmp_path / "metrics-live.prom"
+        assert prom.exists()
+        assert "dtx_x 3" in prom.read_text()
+    finally:
+        ex.stop()
+
+
+def test_goodput_prometheus_lines_roundtrip():
+    led = goodput.ledger_from_events({0: [
+        _ev("train.step", 100.0, dur_s=0.1),
+        _ev("train.step", 101.0, dur_s=0.1),
+    ]})
+    text = "\n".join(goodput.prometheus_lines(led))
+    assert "dtx_goodput_seconds 0.2" in text
+    assert 'dtx_badput_seconds{bucket="idle"} 0.9' in text
+    assert "dtx_goodput_frac 0.18" in text    # 0.2 of 1.1s
+
+
+# ---------------------------------------------------------------------------
+# health_report gates
+# ---------------------------------------------------------------------------
+
+def _write_health_run(tmp_path, *, degrade=False):
+    """A 1-worker run: 10 clean steps, a gen bump, 10 more steps, and a
+    serving completion stream (degraded -> every latency violates the
+    default 500ms objective)."""
+    with open(tmp_path / "events-0.jsonl", "w") as f:
+        for i in range(10):
+            f.write(json.dumps(_ev("train.step", 100.0 + 0.1 * i,
+                                   dur_s=0.1)) + "\n")
+        for i in range(10):
+            f.write(json.dumps(_ev("train.step", 102.0 + 0.1 * i,
+                                   dur_s=0.1, gen=1)) + "\n")
+        lat = 2.0 if degrade else 0.01
+        for i in range(20):
+            f.write(json.dumps(_ev(
+                "serve.request", 103.0 + 0.05 * i, dur_s=lat,
+                new_tokens=4, replayed_tokens=0,
+                ttft_s=lat / 2)) + "\n")
+
+
+def _health(args):
+    import tools.health_report as hr
+    return hr.main(args)
+
+
+def test_health_report_renders_and_gates(tmp_path, capsys):
+    _write_health_run(tmp_path)
+    assert _health([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "goodput" in out and "recovery" in out and "SLO" in out
+    # clean run: identity + floor + budget all pass
+    assert _health([str(tmp_path), "--check", "--goodput-floor", "0.3",
+                    "--slo-budget", "1.0"]) == 0
+    # unreachable floor fails
+    assert _health([str(tmp_path), "--check",
+                    "--goodput-floor", "0.99"]) == 1
+
+
+def test_health_report_fails_on_degraded_slo(tmp_path, capsys):
+    _write_health_run(tmp_path, degrade=True)
+    assert _health([str(tmp_path), "--check", "--slo-budget", "1.0"]) \
+        == 1
+    err = capsys.readouterr().err
+    assert "SLO" in err
+    # goodput floor alone still passes (latency badness is an SLO
+    # problem, not a goodput problem)
+    assert _health([str(tmp_path), "--check",
+                    "--goodput-floor", "0.3"]) == 0
+
+
+def test_health_report_json_and_empty(tmp_path, capsys):
+    _write_health_run(tmp_path)
+    assert _health([str(tmp_path), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ledger"]["badput_s"]["recovery"] > 0
+    assert "p99_latency" in rep["slo"]
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _health([str(empty), "--check"]) == 2
+
+
+def test_health_report_cli_subprocess(tmp_path):
+    """The tool works as a standalone process (the chaos-sweep path)."""
+    _write_health_run(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "health_report.py"),
+         str(tmp_path), "--check", "--goodput-floor", "0.3",
+         "--slo-budget", "1.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout.decode()
+
+
+# ---------------------------------------------------------------------------
+# obs_report goodput column
+# ---------------------------------------------------------------------------
+
+def test_obs_report_carries_goodput(tmp_path, capsys):
+    import tools.obs_report as obs
+    _write_health_run(tmp_path)
+    assert obs.main([str(tmp_path), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)["report"]
+    gp = rep["goodput"]
+    assert gp["goodput_frac"] > 0
+    assert gp["badput_s"]["recovery"] > 0
+    total = gp["goodput_s"] + sum(gp["badput_s"].values())
+    assert abs(gp["wall_s"] - total) <= 0.01 * gp["wall_s"] + 1e-6
+    assert obs.main([str(tmp_path)]) == 0
+    assert "goodput" in capsys.readouterr().out

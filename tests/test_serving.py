@@ -343,6 +343,66 @@ def test_serving_telemetry_events(tiny, tmp_path):
     assert "request latency" in text
 
 
+def test_request_span_id_threads_lifecycle_and_replay(tiny, tmp_path):
+    """Per-request tracing (ISSUE 10): admission -> prefill -> per-token
+    decode -> completion all share a deterministic request_span_id; a
+    PREEMPTED request's second prefill reuses it (same id -> same span
+    across replays/restarts), its completion prices replayed tokens,
+    and the live goodput ledger moves that work into preempt_replay —
+    with the identity intact."""
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.telemetry import goodput
+
+    cfg, params = tiny
+    telemetry.configure(str(tmp_path), process_id=0)
+    prev = goodput.activate(goodput.GoodputLedger(register=False))
+    try:
+        # pool too small for the concurrency: forces preemption
+        engine = InferenceEngine(cfg, params, num_blocks=6, block_size=4,
+                                 max_slots=4, max_prompt_len=16)
+        engine.generate([[7, 7, 7], [8, 8, 8, 8], [9, 9]],
+                        max_new_tokens=8)
+        assert engine.scheduler.preemptions > 0
+        led = goodput.active_ledger().snapshot()
+    finally:
+        goodput.activate(prev)
+        telemetry.shutdown()
+    events = telemetry.read_events(
+        telemetry.event_log_path(str(tmp_path), 0))
+
+    by_id: dict = {}
+    for e in events:
+        if e.get("ev", "").startswith("serve.") and "id" in e:
+            by_id.setdefault(e["id"], []).append(e)
+    assert set(by_id) == {"g0", "g1", "g2"}
+    for rid, evs in by_id.items():
+        names = [e["ev"] for e in evs]
+        assert names[0] == "serve.admit"
+        assert names[-1] == "serve.request"
+        assert "serve.prefill" in names
+        assert "serve.token" in names
+        sids = {e.get("span_id") for e in evs}
+        assert sids == {f"req/{rid}"}, sids
+    # the preempted request replayed tokens through a SECOND prefill on
+    # the same span, and its completion prices them
+    replayed = [rid for rid, evs in by_id.items()
+                if any(e["ev"] == "serve.request"
+                       and e.get("replayed_tokens", 0) > 0
+                       for e in evs)]
+    assert replayed, "no request recorded replayed tokens"
+    assert any(sum(1 for e in by_id[rid] if e["ev"] == "serve.prefill")
+               >= 2 for rid in replayed)
+    # ledger: replay priced as badput, identity exact
+    assert led["badput_s"]["preempt_replay"] > 0
+    total = led["goodput_s"] + sum(led["badput_s"].values())
+    assert abs(led["wall_s"] - total) < 1e-6
+
+    # trace assembly links the lifecycle with flow arrows per request
+    trace = telemetry.assemble_run(str(tmp_path))
+    assert trace["otherData"]["flow_links"] >= sum(
+        len(v) - 1 for v in by_id.values())
+
+
 def test_predict_emits_inference_telemetry(tmp_path):
     """Model.predict batches report predict.step events + the
     inference/ batch-latency histogram (satellite: batch and online

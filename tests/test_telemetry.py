@@ -188,6 +188,91 @@ def test_module_level_api_off_by_default_then_configured(tmp_path):
     assert not telemetry.enabled()
 
 
+def test_event_log_rotation_chains_segments(tmp_path):
+    """Size-capped rotation (ISSUE 10): a long-lived writer rolls
+    events.jsonl -> .1 -> .2 ... at line boundaries; the reader chains
+    the segments back transparently, in order, so trace/obs consumers
+    are unchanged."""
+    path = str(tmp_path / "events-0.jsonl")
+    log = telemetry.EventLog(path, process_id=0, max_bytes=400)
+    n = 60
+    for i in range(n):
+        log.event("serve.step", step=i)
+    log.close()
+    import glob
+    segs = sorted(glob.glob(path + ".*"))
+    assert len(segs) >= 2, "cap never triggered rotation"
+    assert os.path.getsize(path) <= 400
+    for seg in segs:
+        assert os.path.getsize(seg) <= 400 + 120    # one line overshoot
+    evs = telemetry.read_events(path)
+    assert [e["step"] for e in evs] == list(range(n))
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts), "monotonic t broken across segments"
+    # per-file read still works (no rotated siblings consulted; the
+    # live file may be freshly rotated and empty)
+    live_only = telemetry.read_events(path, include_rotated=False)
+    assert len(live_only) < n
+    # run-level reader sees the full chained history too
+    run = telemetry.read_run(str(tmp_path))
+    assert len(run[0]) == n
+
+
+def test_event_log_rotation_torn_live_tail_tolerated(tmp_path):
+    path = str(tmp_path / "events-0.jsonl")
+    log = telemetry.EventLog(path, process_id=0, max_bytes=300)
+    for i in range(30):
+        log.event("train.step", step=i)
+    log.close()
+    with open(path, "a") as f:
+        f.write('{"ev": "torn-tai')          # SIGKILL mid-write
+    evs = telemetry.read_events(path)
+    assert len(evs) == 30
+    # ... but corruption inside a ROTATED segment is never tolerated
+    seg = telemetry.events.rotated_segments(path)[0]
+    with open(seg, "r+") as f:
+        lines = f.readlines()
+        lines[0] = "damaged\n"
+        f.seek(0)
+        f.writelines(lines)
+        f.truncate()
+    with pytest.raises(telemetry.EventLogCorruptError):
+        telemetry.read_events(path)
+
+
+def test_stall_event_names_accruing_badput_bucket(tmp_path):
+    """Satellite (ISSUE 10): stall.suspected carries the badput bucket
+    the blocked time is accruing to — the live ledger's current bucket,
+    'idle' when no ledger is active."""
+    from distributed_tensorflow_tpu.telemetry import goodput
+
+    def fire_and_read(subdir):
+        d = tmp_path / subdir
+        telemetry.configure(str(d), process_id=0)
+        try:
+            det = telemetry.StallDetector(warmup_timeout_s=300.0,
+                                          output=io.StringIO())
+            try:
+                det._triggered()
+            finally:
+                det.stop()
+        finally:
+            telemetry.shutdown()
+        (ev,) = telemetry.read_events(str(d / "events-0.jsonl"))
+        assert ev["ev"] == "stall.suspected"
+        return ev
+
+    assert fire_and_read("no_ledger")["badput_bucket"] == "idle"
+    led = goodput.GoodputLedger(register=False)
+    prev = goodput.activate(led)
+    try:
+        led.step_completed(0.001)
+        led.enter("ckpt_block")
+        assert fire_and_read("ckpt")["badput_bucket"] == "ckpt_block"
+    finally:
+        goodput.activate(prev)
+
+
 # ---------------------------------------------------------------------------
 # rollup merge (math on synthetic snapshots; the KV transport is covered
 # by the multi-process test below)

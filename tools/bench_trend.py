@@ -22,7 +22,13 @@ Series:
 - ``serving/<metric>`` + ``serving/p50_latency_ms`` /
   ``serving/p99_latency_ms`` — the ``SERVING_r*.json`` request-level
   rows (tools/serve_sweep.py); the latency series gate INVERTED
-  (growth past the fraction fails).
+  (growth past the fraction fails);
+- goodput/badput columns (``bench/goodput_frac``,
+  ``serving/goodput_frac``, ``serving/badput_replay_frac``,
+  ``serving/slo_p99_budget_consumed`` — the last two inverted): present
+  only on rows new enough to carry them; historical r01–r06 files
+  without the fields simply don't extend the series (no KeyError, no
+  fake zeros).
 
 ``--check`` fails (exit 1) when the LATEST round of any series drops
 more than ``--regression-frac`` (default 10%) below the best PRIOR
@@ -74,6 +80,11 @@ def load_bench_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
             "mfu": extra.get("mfu"),
             "step_time_ms": extra.get("step_time_ms"),
         }
+        # goodput column (ISSUE 10): present on new rows only —
+        # historical rounds just don't extend the series
+        if isinstance(extra.get("goodput_frac"), (int, float)):
+            series.setdefault("bench/goodput_frac", {})[rnd] = {
+                "value": extra["goodput_frac"]}
     return series
 
 
@@ -123,6 +134,23 @@ def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
                 if isinstance(extra.get(lat), (int, float)):
                     series.setdefault(f"serving/{lat}", {})[rnd] = {
                         "value": extra[lat], "lower_is_better": True}
+            # goodput/badput columns (ISSUE 10) — new rows carry them,
+            # historical r01-era files simply don't grow the series
+            if isinstance(extra.get("goodput_frac"), (int, float)):
+                series.setdefault("serving/goodput_frac", {})[rnd] = {
+                    "value": extra["goodput_frac"]}
+            if isinstance(extra.get("badput_replay_frac"), (int, float)):
+                series.setdefault("serving/badput_replay_frac",
+                                  {})[rnd] = {
+                    "value": extra["badput_replay_frac"],
+                    "lower_is_better": True}
+            slo = extra.get("slo")
+            p99 = (slo or {}).get("p99_latency") or {}
+            if isinstance(p99.get("budget_consumed"), (int, float)):
+                series.setdefault("serving/slo_p99_budget_consumed",
+                                  {})[rnd] = {
+                    "value": p99["budget_consumed"],
+                    "lower_is_better": True}
     return series
 
 

@@ -19,6 +19,12 @@ peer replica was available fails the seed). ``--shrink`` makes the
 seed-chosen machine die permanently: the supervisor must reform at N-1
 workers via a resharded restore (``recovery.reshard`` gated).
 ``--mttr-budget`` additionally bounds each recovery's measured MTTR.
+Every ``--kill``/``--serve`` seed also walks the goodput/badput ledger
+(telemetry/goodput.py): the accounting identity ``wall == goodput +
+Σ badput`` must hold within 1% across all generations (torn tails
+included), the recovery must be priced into the ``recovery`` bucket,
+and ``--goodput-floor`` requires the recovered run to still clear a
+seeded goodput fraction.
 
 ``--serve`` sweeps the SERVING replica axis (ISSUE 9): each seed runs a
 supervised serving job (examples/serve_transformer.py --elastic) whose
@@ -75,6 +81,35 @@ def run_seed(seed: int, include_slow: bool, extra: list[str]) -> tuple[bool, flo
     return ok, dt
 
 
+def _goodput_gate(run_dir: str, floor: "float | None", *,
+                  expect_recovery: bool) -> "list[str]":
+    """Goodput-ledger gate (ISSUE 10): the accounting identity
+    ``wall == goodput + Σ badput`` must hold (±1% of wall) across every
+    generation of the run — torn tails, SIGKILL'd writers and all —
+    the recovery must be visibly priced in the ``recovery`` bucket when
+    one happened, and (with a floor) the recovered run must still clear
+    the seeded goodput floor. Returns violation messages (empty = ok)."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry import goodput
+    ledger = goodput.ledger_from_run(run_dir)
+    bad = []
+    wall = ledger["wall_s"]
+    if wall <= 0:
+        return [f"no worker wall clock observed under {run_dir}"]
+    err = abs(ledger["identity_error_s"]) / wall
+    if err > 0.01:
+        bad.append(f"ledger identity violated: wall {wall:.3f}s vs "
+                   f"goodput+badput off by "
+                   f"{ledger['identity_error_s']:+.3f}s ({err:.2%})")
+    if expect_recovery and ledger["badput_s"]["recovery"] <= 0:
+        bad.append("a recovery ran but the ledger priced 0s into the "
+                   "recovery bucket")
+    if floor is not None and (ledger["goodput_frac"] or 0.0) < floor:
+        bad.append(f"goodput {ledger['goodput_frac']:.1%} below the "
+                   f"floor {floor:.1%}")
+    return bad
+
+
 def _restore_tier_gate(run_dir: str) -> "list[str]":
     """A recovery must restore from the WARMEST tier that held the
     freshest state: any ``recovery.restore_tier`` event whose chosen
@@ -104,7 +139,9 @@ def _restore_tier_gate(run_dir: str) -> "list[str]":
 def run_kill_seed(seed: int, *, workers: int, steps: int,
                   save_every: int, budget: int,
                   keep_dirs: bool, shrink: bool = False,
-                  mttr_budget: "float | None" = None) -> tuple[bool, float]:
+                  mttr_budget: "float | None" = None,
+                  goodput_floor: "float | None" = None) \
+        -> tuple[bool, float]:
     """One supervised elastic run with a seed-derived SIGKILL schedule;
     survival requires a clean exit AND telemetry proof (via ``obs_report
     --check --require``) that a recovery actually ran, restored from
@@ -151,6 +188,14 @@ def run_kill_seed(seed: int, *, workers: int, steps: int,
             ok = False
             print(f"--- seed {seed}: recovery restored from a COLDER "
                   f"tier than available ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _goodput_gate(run_dir, goodput_floor,
+                                   expect_recovery=True)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: goodput-ledger gate FAILED ---")
             for v in violations:
                 print(f"    {v}")
     if ok:
@@ -220,7 +265,9 @@ def _served_requests_gate(run_dir: str, n_requests: int,
 
 
 def run_serve_seed(seed: int, *, workers: int, requests: int,
-                   budget: int, keep_dirs: bool) -> tuple[bool, float]:
+                   budget: int, keep_dirs: bool,
+                   goodput_floor: "float | None" = None) \
+        -> tuple[bool, float]:
     """One supervised serving run with a seed-derived replica SIGKILL;
     survival = clean exit + recovery & serving telemetry + zero dropped
     requests (see ``--serve`` in the module docstring)."""
@@ -259,6 +306,14 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
         if violations:
             ok = False
             print(f"--- seed {seed}: dropped/diverged requests ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _goodput_gate(run_dir, goodput_floor,
+                                   expect_recovery=True)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: goodput-ledger gate FAILED ---")
             for v in violations:
                 print(f"    {v}")
     if not ok and proc.returncode != 0:
@@ -301,6 +356,13 @@ def main(argv=None) -> int:
                     help="--kill: fail a seed whose recovery MTTR "
                          "exceeds this many seconds "
                          "(obs_report --mttr-budget)")
+    ap.add_argument("--goodput-floor", type=float, default=None,
+                    metavar="FRAC",
+                    help="--kill/--serve: fail a seed whose recovered "
+                         "run's goodput fraction lands below this; the "
+                         "ledger identity (wall == goodput + badput "
+                         "±1%%) and a non-empty recovery bucket are "
+                         "gated unconditionally")
     ap.add_argument("--workers", type=int, default=2,
                     help="--kill: workers per supervised run")
     ap.add_argument("--steps", type=int, default=20,
@@ -327,7 +389,8 @@ def main(argv=None) -> int:
             ok, dt = run_serve_seed(s, workers=args.workers,
                                     requests=args.requests,
                                     budget=args.restart_budget,
-                                    keep_dirs=args.keep_dirs)
+                                    keep_dirs=args.keep_dirs,
+                                    goodput_floor=args.goodput_floor)
         elif args.kill:
             ok, dt = run_kill_seed(s, workers=args.workers,
                                    steps=args.steps,
@@ -335,7 +398,8 @@ def main(argv=None) -> int:
                                    budget=args.restart_budget,
                                    keep_dirs=args.keep_dirs,
                                    shrink=args.shrink,
-                                   mttr_budget=args.mttr_budget)
+                                   mttr_budget=args.mttr_budget,
+                                   goodput_floor=args.goodput_floor)
         else:
             ok, dt = run_seed(s, args.slow, args.pytest_args)
         results.append((s, ok, dt))

@@ -176,7 +176,8 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
             elif name == "stall.suspected":
                 stalls.append({k: ev.get(k) for k in
                                ("pid", "stalled_s", "median_step_s",
-                                "suspect_worker", "suspect_reason")})
+                                "suspect_worker", "suspect_reason",
+                                "badput_bucket")})
             elif isinstance(name, str) and name.startswith("recovery."):
                 recovery.append(ev)
         steps.extend(pid_steps)
@@ -237,6 +238,20 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
         }
     bottleneck = classify_run(fractions) if fractions else None
 
+    # -- goodput/badput ledger (ISSUE 10) --------------------------------
+    from distributed_tensorflow_tpu.telemetry import goodput as _goodput
+    ledger = _goodput.ledger_from_events(events_by_pid)
+    goodput_report = None
+    if ledger["wall_s"] > 0:
+        goodput_report = {
+            "wall_s": round(ledger["wall_s"], 6),
+            "goodput_s": round(ledger["goodput_s"], 6),
+            "goodput_frac": round(ledger["goodput_frac"], 4),
+            "badput_s": {b: round(v, 6)
+                         for b, v in ledger["badput_s"].items()},
+            "identity_error_s": round(ledger["identity_error_s"], 6),
+        }
+
     return {
         "processes": per_pid,
         "step_time": _percentiles(steps),
@@ -247,6 +262,7 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
             "tokens_generated": serve_tokens,
         } if (serve_latency or serve_steps) else None,
         "phases": phases_report,
+        "goodput": goodput_report,
         "bottleneck": bottleneck,
         "steps_table": step_rows,
         "infeed_wait_fraction": (round(infeed_wait / step_time_total, 4)
@@ -436,6 +452,14 @@ def render_text(report: dict, rollup: dict) -> str:
                        f"p99 {_fmt_ms(lat['p99'])}  "
                        f"max {_fmt_ms(lat['max'])}")
     _render_phase_table(report, out)
+    gp = report.get("goodput")
+    if gp:
+        bad = "  ".join(f"{b} {v / gp['wall_s']:.1%}"
+                        for b, v in gp["badput_s"].items() if v > 0)
+        out.append(f"goodput {gp['goodput_frac']:.1%} of "
+                   f"{gp['wall_s']:.1f}s hardware time"
+                   + (f"  (badput: {bad})" if bad else "")
+                   + "  — details: tools/health_report.py")
     for pid, info in sorted(report["processes"].items(),
                             key=lambda kv: str(kv[0])):
         p = info["step_time"]
@@ -462,7 +486,9 @@ def render_text(report: dict, rollup: dict) -> str:
                    f"{s.get('stalled_s')}s without a step "
                    f"(median {s.get('median_step_s')}s) — suspect "
                    f"worker {s.get('suspect_worker')}: "
-                   f"{s.get('suspect_reason')}")
+                   f"{s.get('suspect_reason')}"
+                   + (f" [accruing to {s['badput_bucket']}]"
+                      if s.get("badput_bucket") else ""))
     if report.get("recovery_timeline"):
         rec = report["recovery"]
         status = ("job completed" if rec["completed"]
