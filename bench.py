@@ -866,6 +866,138 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
     return row
 
 
+def run_fleet(out_path: str | None = None, *,
+              worker_counts=(8, 64, 256, 1000), seed: int = 0):
+    """Fleet-scale control-plane bench (ISSUE 11): N simulated workers
+    (testing/fleet_sim.py — threads driving the real coordination /
+    tree-rollup / sharded-heartbeat / supervisor code against an
+    in-memory KV) at N = {8, 64, 256, 1000}, two phases per N:
+
+    - **steady state** (no faults, one full-fleet barrier): control-
+      plane KV ops/s, per-worker ops per step (the sub-linearity
+      claim: must stay ~flat in N), the busiest single agent's ops per
+      step (tree fan-in: O(fanout·log N), vs the flat scheme's O(N)
+      coordinator), rollup latency (worker-snapshot age at the root
+      when collected) and the barrier's first-arrival→last-release
+      span;
+    - **detect**: a seeded stall (worker sleeps past the staleness
+      budget) plus a seeded crash; supervisor detect latency (stall
+      overage past budget — the pure scan cost) and death→reformed
+      MTTR, both vs N.
+
+    Honest caveat: one core, one GIL — threads serialize, so ops/s is
+    a lower bound and wall-clock latencies carry scheduler noise; the
+    SHAPES vs N (per-worker ops, fan-in, detect) are the product.
+    Emits one JSON row per N; ``--out`` writes the FLEET_r*.json that
+    tools/fleet_sweep.py --check gates and tools/bench_trend.py trends
+    (MTTR/detect inverted).
+    """
+    import random as _random
+
+    from distributed_tensorflow_tpu.resilience import faults as _faults
+    from distributed_tensorflow_tpu.testing import fleet_sim
+
+    rows = []
+    for n in worker_counts:
+        rng = _random.Random(f"dtx-fleet-bench:{seed}:{n}")
+        steady = fleet_sim.FleetSim(
+            n, steps=10, step_s=0.02, publish_every=2,
+            barrier_at_step=6, fanout=16, hb_shard_size=32,
+            stall_timeout_s=None, seed=seed)
+        rep = steady.run()
+        if not rep.completed:
+            print(f"fleet: steady phase FAILED at n={n}: {rep.error}",
+                  file=sys.stderr)
+
+        # two isolated fault phases (cumulative hit counters make a
+        # combined schedule racy across reforms at large N): a crash
+        # (instant exit-code detect, measures death->reformed MTTR)
+        # and a stall (heartbeat-staleness detect through the shard
+        # summaries — the N-dependent scan this bench exists to curve)
+        def _fault_phase(rule, stall_timeout):
+            sim = fleet_sim.FleetSim(
+                n, steps=10, step_s=0.02, publish_every=2, fanout=16,
+                hb_shard_size=32, stall_timeout_s=stall_timeout,
+                heartbeat_grace_s=30.0,
+                fault_schedule=_faults.FaultSchedule(rules=(rule,),
+                                                     seed=seed),
+                seed=seed)
+            rep = sim.run()
+            if not rep.completed:
+                print(f"fleet: fault phase FAILED at n={n}: "
+                      f"{rep.error}", file=sys.stderr)
+            return rep
+
+        rep_crash = _fault_phase(
+            _faults.FaultRule(site="fleet.step", action="raise",
+                              tag=str(rng.randrange(n)), hits=(3,)),
+            None)
+        rep_stall = _fault_phase(
+            _faults.FaultRule(site="fleet.step", action="delay",
+                              delay_s=4.0, tag=str(rng.randrange(n)),
+                              hits=(4,)),
+            0.5)
+        stall_det = [d for d in rep_stall.detections
+                     if d["kind"] == "stall"]
+        detect_ms = (round(stall_det[0]["detect_s"] * 1e3, 2)
+                     if stall_det and stall_det[0]["detect_s"] is not None
+                     else None)
+        mttrs = [d["mttr_s"]
+                 for d in (rep_crash.detections + rep_stall.detections)
+                 if d.get("mttr_s") is not None]
+        row = {
+            "metric": "fleet_control_plane_ops_per_sec",
+            "value": rep.ops_per_sec,
+            "unit": "ops/s",
+            "vs_baseline": None,
+            "extra": {
+                "n_workers": n,
+                "steps": rep.steps,
+                "wall_s": rep.wall_s,
+                "ops_per_worker_per_step": rep.ops_per_worker_per_step,
+                "max_agent_ops_per_step": rep.max_agent_ops_per_step,
+                "supervisor_ops_total": rep.supervisor_ops_total,
+                "rollup_latency_ms_mean": (
+                    round(rep.rollup_latency_s_mean * 1e3, 2)
+                    if rep.rollup_latency_s_mean is not None else None),
+                "rollup_latency_ms_max": (
+                    round(rep.rollup_latency_s_max * 1e3, 2)
+                    if rep.rollup_latency_s_max is not None else None),
+                "rollup_workers_seen": rep.rollup_workers_seen,
+                "barrier_span_ms": (
+                    round(rep.barrier_span_s * 1e3, 2)
+                    if rep.barrier_span_s is not None else None),
+                "detect_ms": detect_ms,
+                "mttr_ms": (round(max(mttrs) * 1e3, 2)
+                            if mttrs else None),
+                "recoveries": (len(rep_crash.detections)
+                               + len(rep_stall.detections)),
+                "generations_faulted": (rep_crash.generations
+                                        + rep_stall.generations),
+                "kv_keys_final": rep.kv_keys_final,
+                "steady_completed": rep.completed,
+                "fault_completed": (rep_crash.completed
+                                    and rep_stall.completed),
+                "seed": seed,
+            },
+        }
+        rows.append(row)
+        print(json.dumps(row))
+        from distributed_tensorflow_tpu import telemetry
+        telemetry.event("fleet.row", n_workers=n,
+                        ops_per_sec=rep.ops_per_sec,
+                        ops_per_worker_per_step=rep.ops_per_worker_per_step,
+                        max_agent_ops_per_step=rep.max_agent_ops_per_step,
+                        detect_ms=detect_ms,
+                        mttr_ms=row["extra"]["mttr_ms"])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "fleet", "host_cpus": os.cpu_count(),
+                       "seed": seed, "rows": rows}, f, indent=1)
+            f.write("\n")
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -985,7 +1117,8 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
-                                 "input_pipeline", "scaling", "serving"],
+                                 "input_pipeline", "scaling", "serving",
+                                 "fleet"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -998,6 +1131,13 @@ if __name__ == "__main__":
                         help="run the request-level serving bench "
                              "(p50/p99 latency + tokens/s at --qps "
                              "through the continuous-batching engine)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the simulated-fleet control-plane "
+                             "bench (ops/s, rollup latency, detect/"
+                             "MTTR vs N={8,64,256,1000} workers)")
+    parser.add_argument("--fleet-sizes", default=None,
+                        help="with --fleet: comma-separated worker "
+                             "counts (default 8,64,256,1000)")
     parser.add_argument("--qps", type=float, default=None,
                         help="with --serving: target arrival rate")
     parser.add_argument("--requests", type=int, default=None,
@@ -1016,6 +1156,11 @@ if __name__ == "__main__":
     args = parser.parse_args()
     if args.scaling or args.workload == "scaling":
         run_scaling(out_path=args.out, max_devices=args.max_devices)
+    elif args.fleet or args.workload == "fleet":
+        counts = (tuple(int(x) for x in args.fleet_sizes.split(","))
+                  if args.fleet_sizes else (8, 64, 256, 1000))
+        run_fleet(out_path=args.out, worker_counts=counts,
+                  seed=args.seed)
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
                     n_requests=args.requests, seed=args.seed,

@@ -18,6 +18,7 @@ Multi-process: operations delegate to the TSL coordination service that
 
 from __future__ import annotations
 
+import collections
 import re
 import threading
 import time
@@ -56,60 +57,146 @@ class BarrierTimeoutError(CoordinationError):
 
 
 class _LocalService:
-    """In-process KV/barrier service with TSL-equivalent semantics."""
+    """In-process KV/barrier service with TSL-equivalent semantics.
+
+    Also the backend of the simulated-fleet harness
+    (testing/fleet_sim.py), where hundreds of worker THREADS share one
+    instance — which is why blocked readers wait on **per-key**
+    conditions: the original single shared condition made every ``set``
+    wake every blocked reader of every key (O(writers × waiters)
+    spurious wakeups per round — at N=1000 simulated workers the reform
+    storm, where every worker blocks on the new generation's config key
+    while heartbeats keep streaming in, was the worst scaling offender
+    the harness exposed). ``stats["waiters_woken"]`` counts real
+    wakeups so the fix is testable.
+    """
 
     def __init__(self):
         self._kv: dict[str, bytes] = {}
-        self._cv = threading.Condition()
-        self._barriers: dict[str, int] = {}
+        self._lock = threading.Lock()
+        # key -> [Condition, waiter_count]; entries exist only while a
+        # reader is blocked on that key
+        self._waiters: dict[str, list] = {}
+        self._barriers: dict[str, dict] = {}
+        #: coarse service-side counters (ops, wakeups); reads/updates
+        #: are lock-protected where it matters for tests
+        self.stats = collections.Counter()
+
+    def _notify_key(self, key: str):
+        """Wake only the readers blocked on ``key`` (caller holds
+        ``_lock``)."""
+        w = self._waiters.get(key)
+        if w is not None:
+            self.stats["waiters_woken"] += w[1]
+            w[0].notify_all()
 
     def set(self, key: str, value: bytes, *, allow_overwrite: bool = True):
-        with self._cv:
+        with self._lock:
             if not allow_overwrite and key in self._kv:
                 raise CoordinationError(f"key {key!r} already exists")
             self._kv[key] = value
-            self._cv.notify_all()
+            self.stats["set"] += 1
+            self._notify_key(key)
 
     def get(self, key: str, timeout_s: float) -> bytes:
         deadline = time.monotonic() + timeout_s
-        with self._cv:
-            while key not in self._kv:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or not self._cv.wait(remaining):
-                    raise CoordinationError(
-                        f"timed out waiting for key {key!r}")
-            return self._kv[key]
+        with self._lock:
+            self.stats["get"] += 1
+            v = self._kv.get(key)
+            if v is not None:               # fast path: no condition
+                return v
+            w = self._waiters.get(key)
+            if w is None:
+                w = self._waiters[key] = [
+                    threading.Condition(self._lock), 0]
+            w[1] += 1
+            try:
+                while key not in self._kv:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not w[0].wait(remaining):
+                        raise CoordinationError(
+                            f"timed out waiting for key {key!r}")
+                return self._kv[key]
+            finally:
+                w[1] -= 1
+                if w[1] <= 0 and self._waiters.get(key) is w:
+                    del self._waiters[key]
 
     def try_get(self, key: str) -> bytes | None:
-        with self._cv:
+        with self._lock:
+            self.stats["try_get"] += 1
             return self._kv.get(key)
 
     def dir_get(self, prefix: str) -> list[tuple[str, bytes]]:
-        with self._cv:
+        with self._lock:
+            self.stats["dir_get"] += 1
             return sorted((k, v) for k, v in self._kv.items()
                           if k.startswith(prefix))
 
     def delete(self, key: str):
         """Delete ``key`` and (directory-style, matching TSL) any keys
         under ``key/``."""
-        with self._cv:
+        with self._lock:
+            self.stats["delete"] += 1
             self._kv.pop(key, None)
             for k in [k for k in self._kv if k.startswith(key + "/")]:
                 del self._kv[k]
 
     def increment(self, key: str, amount: int) -> int:
-        with self._cv:
+        with self._lock:
+            self.stats["increment"] += 1
             cur = int(self._kv.get(key, b"0"))
             cur += amount
             self._kv[key] = str(cur).encode()
-            self._cv.notify_all()
+            self._notify_key(key)
             return cur
 
-    def barrier(self, name: str, timeout_s: float, n: int):
-        # Single participant: trivially passes (n == 1 always here).
-        del timeout_s, n
-        with self._cv:
-            self._barriers[name] = self._barriers.get(name, 0) + 1
+    def num_keys(self) -> int:
+        """Live key count — the KV-size observable the lifecycle-GC
+        tests bound across reforms (cluster/kv_gc.py)."""
+        with self._lock:
+            return len(self._kv)
+
+    def barrier(self, name: str, timeout_s: float, n: int,
+                participant: int = 0):
+        """Block until ``n`` distinct participants reach ``name``.
+
+        ``n <= 1`` passes trivially (the single-process fallback of the
+        production agent). A timed-out barrier raises
+        :class:`BarrierTimeoutError` NAMING the missing participant ids
+        — the supervisor-facing detail the TSL barrier cannot give you,
+        and the first thing an operator of an N-worker fleet needs. A
+        released barrier name stays released (one-shot, matching TSL);
+        use per-round names for repeated synchronization.
+        """
+        with self._lock:
+            st = self._barriers.get(name)
+            if st is None:
+                st = self._barriers[name] = {
+                    "cv": threading.Condition(self._lock),
+                    "arrived": set(), "n": n, "done": n <= 1}
+            if st["done"]:
+                st["arrived"].add(participant)
+                return
+            st["arrived"].add(participant)
+            if len(st["arrived"]) >= st["n"]:
+                st["done"] = True
+                st["cv"].notify_all()
+                return
+            deadline = time.monotonic() + timeout_s
+            while not st["done"]:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not st["cv"].wait(remaining):
+                    if st["done"]:      # released while timing out
+                        return
+                    missing = sorted(set(range(st["n"])) - st["arrived"])
+                    shown = ", ".join(map(str, missing[:8]))
+                    if len(missing) > 8:
+                        shown += f", ... ({len(missing)} total)"
+                    raise BarrierTimeoutError(
+                        f"barrier {name!r} timed out after {timeout_s}s: "
+                        f"{len(st['arrived'])}/{st['n']} arrived; "
+                        f"missing participant(s): [{shown}]")
 
 
 _LOCAL = _LocalService()
@@ -127,6 +214,13 @@ class CoordinationServiceAgent:
         self._local = _LOCAL
         self._legacy: bool | None = None
         self._inc_hint: dict[str, int] = {}
+        #: per-agent KV/barrier op counts ({op_name: n}) — the raw
+        #: material of the fleet-scale control-plane cost curves
+        #: (bench.py --fleet). Incremented without a lock: each agent
+        #: belongs to one worker (exact there); the process-wide
+        #: singleton's counts are approximate under thread races, which
+        #: is fine for a cost profile.
+        self.op_counts = collections.Counter()
 
     # -- legacy-client compatibility --------------------------------------
     # jaxlib builds whose DistributedRuntimeClient lacks
@@ -194,6 +288,7 @@ class CoordinationServiceAgent:
 
     def key_value_set(self, key: str, value: bytes | str, *,
                       allow_overwrite: bool = True):
+        self.op_counts["set"] += 1
         key = elastic.namespace(key)
         data = value.encode() if isinstance(value, str) else bytes(value)
         c = self._client
@@ -204,6 +299,7 @@ class CoordinationServiceAgent:
 
     def key_value_get(self, key: str, timeout_s: float = 60.0) -> bytes:
         """Blocking get: waits until some process sets ``key``."""
+        self.op_counts["get"] += 1
         faults.fire("coord.kv_get", tag=key, exc=CoordinationError,
                     msg=f"injected fault: key_value_get({key!r})")
         key = elastic.namespace(key)
@@ -227,6 +323,7 @@ class CoordinationServiceAgent:
                 f"key_value_get({key!r}) failed: {e}") from e
 
     def key_value_try_get(self, key: str) -> bytes | None:
+        self.op_counts["try_get"] += 1
         key = elastic.namespace(key)
         c = self._client
         if c is None:
@@ -245,6 +342,7 @@ class CoordinationServiceAgent:
             return None
 
     def key_value_dir_get(self, prefix: str) -> list[tuple[str, bytes]]:
+        self.op_counts["dir_get"] += 1
         prefix = elastic.namespace(prefix)
         c = self._client
         if c is None:
@@ -255,6 +353,7 @@ class CoordinationServiceAgent:
             return []
 
     def key_value_delete(self, key: str):
+        self.op_counts["delete"] += 1
         key = elastic.namespace(key)
         c = self._client
         if c is None:
@@ -264,6 +363,7 @@ class CoordinationServiceAgent:
 
     def key_value_increment(self, key: str, amount: int = 1) -> int:
         """Atomic fetch-add; returns the post-increment value."""
+        self.op_counts["increment"] += 1
         key = elastic.namespace(key)
         c = self._client
         if c is None:
@@ -280,6 +380,22 @@ class CoordinationServiceAgent:
         # under ``key`` for plain readers; slot keys live under
         # ``key/`` so a directory delete of ``key`` GCs them.
         i = self._inc_hint.get(key, 0)
+        if i == 0:
+            # Cold start: seed the probe hint from the published value
+            # key (one safe string read). Without this, the p-th
+            # process to ever increment probed ~p already-taken slots —
+            # N processes touching one counter cost O(N^2) RPCs total,
+            # the worst per-op scaling offender the fleet harness's
+            # cost curves flagged. Seeded, each process pays one read
+            # plus O(amount) probes: O(N) total. The hint may lag the
+            # true tail (the value key is best-effort); probing forward
+            # absorbs the slack.
+            v = self._legacy_get_once(c, key, 50)
+            if v is not None:
+                try:
+                    i = max(i, int(v))
+                except ValueError:
+                    pass
         claimed = 0
         limit = i + 100_000
         while claimed < amount:
@@ -318,13 +434,19 @@ class CoordinationServiceAgent:
         assembler (telemetry/trace.py) uses the per-process walls
         recorded here to estimate per-host clock offsets.
         """
+        self.op_counts["barrier"] += 1
         faults.fire("coord.barrier", tag=name, exc=BarrierTimeoutError,
                     msg=f"injected barrier timeout at {name!r}")
         raw_name = name
         name = elastic.namespace(name)
         c = self._client
         if c is None:
-            self._local.barrier(name, timeout_s, 1)
+            # n/participant come from the agent's identity: 1 for the
+            # production single-process fallback (trivially passes,
+            # byte-identical behavior), N for the simulated-fleet
+            # agents that share one _LocalService across threads.
+            self._local.barrier(name, timeout_s, self.num_processes,
+                                participant=self.process_id)
         else:
             try:
                 c.wait_at_barrier(name, int(timeout_s * 1000))

@@ -30,7 +30,9 @@ the reference failure-handling module's restart counter):
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 #: Cluster generation id, injected by the recovery supervisor.
 ENV_GENERATION = "DTX_CLUSTER_GENERATION"
@@ -39,20 +41,44 @@ ENV_GENERATION = "DTX_CLUSTER_GENERATION"
 ENV_SUPERVISOR_DIR = "DTX_SUPERVISOR_DIR"
 
 _GENERATION: int | None = None
+_TLS = threading.local()
 
 
 def generation() -> int:
     """The current cluster generation (0 for a never-reformed job).
 
-    An explicit :func:`set_generation` wins; otherwise the value comes
-    from the environment on every call (no caching — pooled test
-    processes swap env between runs)."""
+    A thread-local :func:`generation_override` wins over everything (the
+    simulated-fleet harness runs hundreds of "workers" as threads of one
+    process, each possibly in a different generation — see
+    testing/fleet_sim.py); an explicit :func:`set_generation` wins next;
+    otherwise the value comes from the environment on every call (no
+    caching — pooled test processes swap env between runs)."""
+    g = getattr(_TLS, "gen", None)
+    if g is not None:
+        return g
     if _GENERATION is not None:
         return _GENERATION
     try:
         return int(os.environ.get(ENV_GENERATION, "0"))
     except ValueError:
         return 0
+
+
+@contextlib.contextmanager
+def generation_override(gen: int):
+    """Pin the generation for the CURRENT THREAD only.
+
+    The in-process fleet simulator gives every simulated worker thread
+    its own generation: a straggler thread of a dead generation keeps
+    namespacing its keys with the OLD id (exactly like a straggler
+    process would) while reformed workers already live in the new one.
+    Nestable; restores the previous override on exit."""
+    prev = getattr(_TLS, "gen", None)
+    _TLS.gen = int(gen)
+    try:
+        yield
+    finally:
+        _TLS.gen = prev
 
 
 def set_generation(gen: int | None):

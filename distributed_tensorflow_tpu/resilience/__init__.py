@@ -9,12 +9,20 @@ Four parts (see each module's docstring):
   jitter, deadline, retryable classification) behind every retry loop;
 - :mod:`.health` — per-worker failure tracking and quarantine feeding
   the coordinator's closure re-scheduling;
+- :mod:`.heartbeats` — the supervisor's pluggable liveness transport:
+  per-task files (default) or fleet-scale sharded KV summaries
+  (supervisor polls O(N/shard) keys per tick);
 - :mod:`.supervisor` — the recovery supervisor closing the loop: it
   restarts dead workers, reforms the cluster under a fresh generation
   (cluster/elastic.py), and resumes from the last intact checkpoint.
 """
 
-from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience import faults, heartbeats
+from distributed_tensorflow_tpu.resilience.heartbeats import (
+    FileHeartbeatSource,
+    ShardedHeartbeatPublisher,
+    ShardedKVHeartbeats,
+)
 from distributed_tensorflow_tpu.resilience.faults import (
     FaultDecision,
     FaultInjected,

@@ -45,6 +45,15 @@ Instrumented sites:
                           mutation, so a ``raise`` models a transient
                           serving-step failure the replica retries
                           without losing or double-serving a request
+``fleet.step``            testing/fleet_sim.py simulated-worker step
+                          (tag=worker pid; per-tag hit counter == the
+                          worker's step number) — ``raise`` crashes the
+                          worker, ``delay`` stalls it past the
+                          supervisor's staleness budget, ``signal``
+                          partitions it (KV ops and heartbeats
+                          suppressed for a window); the seeded
+                          fault plans of bench.py --fleet and
+                          tools/fleet_sweep.py are rules on this site
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

@@ -31,6 +31,17 @@ class RetryPolicy:
       ``max_backoff_s``, slept between attempts (0 = no sleep);
     - ``jitter``: fraction j in [0, 1] — each backoff is scaled by a
       uniform draw from [1-j, 1+j] (decorrelates retry storms);
+    - ``decorrelated``: full decorrelated jitter (the AWS
+      exponential-backoff-and-jitter scheme): each backoff is a fresh
+      uniform draw from ``[initial_backoff_s, 3 * previous_backoff]``,
+      capped at ``max_backoff_s``. Where multiplicative ``jitter``
+      spreads N simultaneous retriers over a ±j band around the SAME
+      schedule — after a coordinator blip they still arrive in loose
+      waves — decorrelated draws spread them over the whole
+      [initial, cap] range within a couple of attempts, which is what
+      keeps an N-worker fleet's retry storm off the KV (the
+      thundering-herd case the fleet harness sweeps). Deterministic
+      per retrier under ``seed`` (give each worker its own seed);
     - ``deadline_s``: overall budget from the first attempt; when
       exceeded the last exception is re-raised instead of retrying;
     - ``retryable``: default exception classes ``call`` retries on;
@@ -42,6 +53,7 @@ class RetryPolicy:
     backoff_multiplier: float = 2.0
     max_backoff_s: float = 30.0
     jitter: float = 0.0
+    decorrelated: bool = False
     deadline_s: float | None = None
     retryable: tuple = (Exception,)
     seed: int | None = None
@@ -49,11 +61,21 @@ class RetryPolicy:
     def is_retryable(self, exc: BaseException, retryable=None) -> bool:
         return isinstance(exc, tuple(retryable or self.retryable))
 
+    def _needs_rng(self) -> bool:
+        return bool(self.jitter) or self.decorrelated
+
     def backoff_s(self, attempt: int,
-                  rng: random.Random | None = None) -> float:
-        """Backoff after the ``attempt``-th failure (1-based)."""
+                  rng: random.Random | None = None,
+                  prev_s: float = 0.0) -> float:
+        """Backoff after the ``attempt``-th failure (1-based).
+        ``prev_s`` is the previous backoff actually used — the state
+        decorrelated jitter chains on (0.0 for the first)."""
         if self.initial_backoff_s <= 0:
             return 0.0
+        if self.decorrelated and rng is not None:
+            lo = self.initial_backoff_s
+            hi = max(3.0 * (prev_s if prev_s > 0 else lo), lo)
+            return min(rng.uniform(lo, hi), self.max_backoff_s)
         d = min(self.initial_backoff_s
                 * self.backoff_multiplier ** (attempt - 1),
                 self.max_backoff_s)
@@ -72,10 +94,11 @@ class RetryPolicy:
         summary error catch and wrap it.
         """
         retry_on = tuple(retryable or self.retryable)
-        rng = random.Random(self.seed) if self.jitter else None
+        rng = random.Random(self.seed) if self._needs_rng() else None
         deadline = (time.monotonic() + self.deadline_s
                     if self.deadline_s is not None else None)
         attempt = 0
+        prev_d = 0.0
         while True:
             attempt += 1
             try:
@@ -89,7 +112,8 @@ class RetryPolicy:
                     raise
                 if on_retry is not None:
                     on_retry(e, attempt)
-                d = self.backoff_s(attempt, rng)
+                d = self.backoff_s(attempt, rng, prev_s=prev_d)
+                prev_d = d
                 if deadline is not None:
                     d = min(d, max(deadline - time.monotonic(), 0.0))
                 if d > 0:
@@ -108,12 +132,16 @@ class Backoff:
     def __init__(self, policy: RetryPolicy, seed: int | None = None):
         self.policy = policy
         self._rng = (random.Random(policy.seed if seed is None else seed)
-                     if policy.jitter else None)
+                     if policy._needs_rng() else None)
         self._attempt = 0
+        self._prev = 0.0
 
     def next_s(self) -> float:
         self._attempt += 1
-        return self.policy.backoff_s(self._attempt, self._rng)
+        d = self.policy.backoff_s(self._attempt, self._rng,
+                                  prev_s=self._prev)
+        self._prev = d
+        return d
 
     def sleep(self, max_s: float | None = None) -> float:
         d = self.next_s()
@@ -125,3 +153,4 @@ class Backoff:
 
     def reset(self):
         self._attempt = 0
+        self._prev = 0.0

@@ -69,6 +69,7 @@ from distributed_tensorflow_tpu.checkpoint.failure_handling import (
     EXIT_PREEMPTED,
 )
 from distributed_tensorflow_tpu.cluster import elastic
+from distributed_tensorflow_tpu.resilience import heartbeats as _hb
 from distributed_tensorflow_tpu.resilience.health import WorkerHealthTracker
 from distributed_tensorflow_tpu.resilience.retry import Backoff, RetryPolicy
 from distributed_tensorflow_tpu.telemetry import events as _events
@@ -180,7 +181,11 @@ class RecoverySupervisor:
                  shrink_after: int | None = None,
                  min_workers: int = 1,
                  telemetry_dir: str | None = None,
-                 work_dir: str | None = None):
+                 work_dir: str | None = None,
+                 heartbeats=None,
+                 runner_factory=None,
+                 cluster_spec_fn=None,
+                 kv_gc=None):
         """Knobs beyond the obvious:
 
         - ``stall_timeout_s`` — heartbeat *staleness* budget: a worker
@@ -201,6 +206,25 @@ class RecoverySupervisor:
           below ``min_workers``) and let the topology-elastic restore
           reshard the checkpoint onto the smaller cluster. ``None``
           disables shrinking (restart budget semantics unchanged).
+        - ``heartbeats`` — the liveness transport, a
+          :class:`resilience.heartbeats.HeartbeatSource`-shaped object
+          (``read_all``/``clear``/``generation``). Default: the
+          per-task heartbeat FILES under the supervisor scratch dir.
+          ``ShardedKVHeartbeats`` swaps in per-shard summary keys over
+          the coordination KV so the watch loop polls O(N/shard)
+          keys instead of O(N) files — the fleet-scale detect path
+          (bench.py --fleet measures detect latency vs N through it).
+        - ``runner_factory`` / ``cluster_spec_fn`` — how generations
+          are spawned: default the real spawn-process
+          ``MultiProcessRunner`` + fresh-port cluster specs; the
+          simulated-fleet harness (testing/fleet_sim.py) injects an
+          in-process thread runner and a portless spec so hundreds of
+          workers drive THIS loop unchanged.
+        - ``kv_gc`` — a :class:`cluster.kv_gc.GenerationGC`: at every
+          reform the supervisor notes the outgoing generation's last
+          heartbeat (the GC's grace anchor) and the watch loop sweeps
+          dead generations' KV namespaces once their grace window
+          elapses (``recovery.kv_gc`` event per sweep).
         """
         self._fn = worker_fn
         self._num_workers = num_workers
@@ -231,6 +255,11 @@ class RecoverySupervisor:
         self._telemetry_dir = telemetry_dir
         self._dir = work_dir or tempfile.mkdtemp(prefix="dtx_supervisor_")
         os.makedirs(self._dir, exist_ok=True)
+        self._hb = heartbeats or _hb.FileHeartbeatSource(self._dir)
+        self._runner_factory = runner_factory or mpr.MultiProcessRunner
+        self._spec_fn = (cluster_spec_fn or
+                         (lambda n: mpr.create_cluster_spec(num_workers=n)))
+        self.kv_gc = kv_gc
         self._log: _events.EventLog | None = None
         if telemetry_dir:
             self._log = _events.EventLog(
@@ -313,29 +342,8 @@ class RecoverySupervisor:
 
     def _clear_heartbeats(self):
         self._hb_seen: dict[int, int | None] = {}
-        for i in range(self._num_workers):
-            try:
-                os.unlink(elastic.heartbeat_path(self._dir, i))
-            except OSError:
-                pass
-
-    def _heartbeat(self, worker: int) \
-            -> "tuple[float, int | None, float | None] | None":
-        """(mtime, step, worker_wall) of a worker's heartbeat file, None
-        if absent. ``worker_wall`` is the worker's own wall-clock reading
-        at write time (see cluster/elastic.heartbeat); older single-token
-        files parse with wall None."""
-        path = elastic.heartbeat_path(self._dir, worker)
-        try:
-            mtime = os.path.getmtime(path)
-            with open(path) as f:
-                parts = f.read().split()
-            step = int(parts[0]) if parts and parts[0].isdigit() else None
-            wall = (float(parts[-1])
-                    if parts and "." in parts[-1] else None)
-            return mtime, step, wall
-        except (OSError, ValueError):
-            return None
+        self._hb.generation = self.generation
+        self._hb.clear(self._num_workers)
 
     @staticmethod
     def _classify(exitcode: int | None) -> str:
@@ -355,8 +363,8 @@ class RecoverySupervisor:
         """Run the job to completion, recovering from failures within
         the restart budget. Returns the final generation's result;
         raises :class:`RecoveryFailedError` on budget exhaustion."""
-        spec = mpr.create_cluster_spec(num_workers=self._num_workers)
-        self._runner = mpr.MultiProcessRunner(
+        spec = self._spec_fn(self._num_workers)
+        self._runner = self._runner_factory(
             self._fn, spec, args=self._args, kwargs=self._kwargs,
             env=self._child_env(0), devices_per_process=self._devices,
             timeout=self._generation_timeout_s)
@@ -397,7 +405,12 @@ class RecoverySupervisor:
 
     def _watch(self) -> list[WorkerFailure] | None:
         """Watch the current generation. Returns failures needing
-        recovery, or None when every task exited cleanly."""
+        recovery, or None when every task exited cleanly.
+
+        Heartbeats are read from the source ONCE per tick (``read_all``
+        — for the sharded KV source that is O(N/shard) key reads) and
+        the one batch feeds clock-sync telemetry, chaos-kill targeting
+        and stall detection alike."""
         runner = self._runner
         t0 = time.monotonic()
         while True:
@@ -410,11 +423,17 @@ class RecoverySupervisor:
                     for k, c in sorted(bad.items())]
             if len(exits) == runner.num_tasks:
                 return None
-            self._observe_heartbeats()
-            self._fire_due_kills(exits)
-            stalled = self._check_stall(exits, t0)
+            hbs = self._hb.read_all(self._num_workers)
+            self._observe_heartbeats(hbs)
+            self._fire_due_kills(exits, hbs)
+            stalled = self._check_stall(exits, t0, hbs)
             if stalled is not None:
                 return [stalled]
+            if self.kv_gc is not None:
+                swept = self.kv_gc.maybe_sweep(current_gen=self.generation)
+                if swept:
+                    self._event("recovery.kv_gc",
+                                generation=self.generation, swept=swept)
             if time.monotonic() - t0 > self._generation_timeout_s:
                 return [WorkerFailure(
                     generation=self.generation, task=("worker", -1),
@@ -423,27 +442,26 @@ class RecoverySupervisor:
                            f"{self._generation_timeout_s}s")]
             time.sleep(self._poll_s)
 
-    def _observe_heartbeats(self):
+    def _observe_heartbeats(self, hbs):
         """Telemetry-only: record one ``clock.hb`` event per fresh
         worker heartbeat, pairing the worker's self-reported wall clock
-        with the heartbeat file's mtime (this process's clock domain).
-        These pairs are how the trace assembler
+        with the heartbeat's observation time (this process's clock
+        domain — the file mtime for file heartbeats). These pairs are
+        how the trace assembler
         (telemetry/trace.estimate_clock_offsets) aligns the
         supervisor's recovery timeline with the workers' step
         timelines. No-op without a telemetry log."""
         if self._log is None:
             return
-        for i in range(self._num_workers):
-            hb = self._heartbeat(i)
-            if (hb is not None and hb[1] is not None
-                    and hb[2] is not None
+        for i, hb in hbs.items():
+            if (hb[1] is not None and hb[2] is not None
                     and hb[1] != self._hb_seen.get(i)):
                 self._hb_seen[i] = hb[1]
                 self._event("clock.hb", generation=self.generation,
                             worker=i, step=hb[1],
                             worker_wall=hb[2], mtime=hb[0])
 
-    def _fire_due_kills(self, exits):
+    def _fire_due_kills(self, exits, hbs):
         for rec in list(self._kills):
             spec = rec["spec"]
             if rec["fired_gen"] is not None and (
@@ -455,7 +473,7 @@ class RecoverySupervisor:
                 continue
             if ("worker", spec.worker) in exits:
                 continue                    # already down — keep waiting
-            hb = self._heartbeat(spec.worker)
+            hb = hbs.get(spec.worker)
             if hb is None or hb[1] is None or hb[1] < spec.after_step:
                 continue
             self._event("recovery.chaos_kill", generation=self.generation,
@@ -466,7 +484,7 @@ class RecoverySupervisor:
             if not spec.permanent:
                 self._kills.remove(rec)
 
-    def _check_stall(self, exits, t0: float) -> WorkerFailure | None:
+    def _check_stall(self, exits, t0: float, hbs) -> WorkerFailure | None:
         if self._stall_timeout_s is None:
             return None
         now = time.time()
@@ -475,7 +493,7 @@ class RecoverySupervisor:
         for i in range(self._num_workers):
             if ("worker", i) in exits:
                 continue                          # finished: not stalled
-            hb = self._heartbeat(i)
+            hb = hbs.get(i)
             # before the first heartbeat, age from generation start
             # against the (typically larger) heartbeat_grace_s budget —
             # spawn + jax import + first compile are not a stall
@@ -491,7 +509,7 @@ class RecoverySupervisor:
             return WorkerFailure(
                 generation=self.generation, task=("worker", worst[3]),
                 kind="stall", wall=now,
-                detail=f"no heartbeat for {worst[1]:.1f}s "
+                detail=f"no heartbeat for {worst[1]:.3f}s "
                        f"(budget {worst[2]}s)")
         return None
 
@@ -590,6 +608,14 @@ class RecoverySupervisor:
                 self.history)
         self.restarts_used += 1
         delay = backoff.next_s()
+        if self.kv_gc is not None:
+            # anchor the dying generation's GC grace window on the last
+            # heartbeat anyone in it produced (stragglers get the full
+            # grace past this instant before their keys are swept)
+            hbs = self._hb.read_all(self._num_workers)
+            last = max((h[0] for h in hbs.values()),
+                       default=time.time())
+            self.kv_gc.note_generation_end(self.generation, last)
         self.generation += 1
         removed = self._maybe_shrink()
         if removed is not None:
@@ -611,7 +637,7 @@ class RecoverySupervisor:
                         backoff_s=round(delay, 3),
                         num_workers=self._num_workers)
             self._runner.reform(
-                mpr.create_cluster_spec(num_workers=self._num_workers),
+                self._spec_fn(self._num_workers),
                 env=self._child_env(self.generation),
                 allow_resize=removed is not None)
             for f in failures:

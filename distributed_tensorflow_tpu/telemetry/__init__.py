@@ -78,11 +78,14 @@ from distributed_tensorflow_tpu.telemetry.events import (
 from distributed_tensorflow_tpu.telemetry.aggregate import (
     FleetAggregator,
     MetricsPublisher,
+    RollupTopology,
     collect_rollup,
+    collect_rollup_tree,
     merge_rollup,
     publish_snapshot,
     read_snapshots,
     rollup_scalars,
+    run_duties,
 )
 from distributed_tensorflow_tpu.telemetry.stall import (
     StallDetector,
@@ -128,9 +131,10 @@ __all__ = [
     "ENV_TELEMETRY_DIR", "EventLog", "EventLogCorruptError", "configure",
     "enabled", "event", "event_log_path", "get_event_log", "read_events",
     "read_run", "shutdown", "span",
-    "FleetAggregator", "MetricsPublisher", "collect_rollup",
-    "merge_rollup", "publish_snapshot", "read_snapshots",
-    "rollup_scalars",
+    "FleetAggregator", "MetricsPublisher", "RollupTopology",
+    "collect_rollup", "collect_rollup_tree", "merge_rollup",
+    "publish_snapshot", "read_snapshots", "rollup_scalars",
+    "run_duties",
     "StallDetector", "suspect_worker",
     "BOTTLENECK_THRESHOLDS", "assemble_run", "assemble_trace",
     "classify_run", "estimate_clock_offsets", "overlap_efficiency",

@@ -37,10 +37,15 @@ import time
 from distributed_tensorflow_tpu.telemetry import registry as _registry
 
 _SNAP_PREFIX = "dtx_telemetry/snap"
+_TREE_PREFIX = "dtx_telemetry/tree"
 
 
 def _snap_key(process_id: int) -> str:
     return f"{_SNAP_PREFIX}/{process_id}"
+
+
+def _tree_key(level: int, node: int) -> str:
+    return f"{_TREE_PREFIX}/{level}/{node}"
 
 
 def publish_snapshot(agent=None, reg=None,
@@ -150,6 +155,150 @@ def collect_rollup(agent=None, worker_ids=None) -> dict:
     return merge_rollup(read_snapshots(agent, worker_ids))
 
 
+# ---------------------------------------------------------------------------
+# Tree-structured rollups (fleet scale)
+# ---------------------------------------------------------------------------
+# The flat scheme above has the coordinator point-read every worker's
+# snapshot key — O(N) KV ops on ONE node per rollup tick, the
+# control-plane bottleneck the fleet harness (testing/fleet_sim.py)
+# exposes first. The tree scheme spreads that fan-in over reducer
+# workers: leaves keep publishing their own snapshot key exactly as
+# before, but designated reducers (the lowest pid of each fanout-sized
+# group) union their group's snapshots into one *partial* key per tree
+# node, level by level, and the coordinator reads only the ROOT key.
+# No single node ever touches more than ``fanout`` keys per tick
+# (the root reducer pays fanout ops per level: O(fanout·log_F N)), and
+# the merged output is BIT-IDENTICAL to the flat path at every depth —
+# partials carry the union of leaf payloads, so the final merge is the
+# same ``merge_rollup`` over the same per-worker entries, just routed
+# through fewer reads at the top. (The trade is payload size, not op
+# count: a root partial aggregates every worker's snapshot. KV ops —
+# RPC count — are what bound the control plane at small-snapshot
+# sizes; see README "Fleet scale".)
+#
+# Freshness: a value reaches the root after every level between has
+# republished — rollup latency is O(depth × publish interval), which
+# bench.py --fleet measures as snapshot age at collect time.
+#
+# Legacy discipline unchanged: partials are JSON strings, written in
+# place, read with enumerated point reads; a dead reducer's partial
+# simply goes stale (its subtree's freshness degrades until the
+# supervisor reforms the cluster — the same failure surface sharded
+# heartbeats have, see resilience/heartbeats.py).
+
+
+class RollupTopology:
+    """The fanout-F reduction tree over worker ids.
+
+    Level 0 groups ``fanout`` consecutive leaves per node; each higher
+    level groups ``fanout`` nodes of the level below, up to a single
+    root. The reducer of a node is the lowest pid under it — so pid 0
+    is the root reducer, and a reducer's duties nest (it reduces its
+    group at every level it anchors).
+    """
+
+    def __init__(self, num_workers: int, fanout: int = 16):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if fanout < 2:
+            raise ValueError("fanout must be >= 2")
+        self.num_workers = num_workers
+        self.fanout = fanout
+        #: nodes per level, leaves upward: levels[0] = ceil(N/F), ...
+        self.level_sizes: list[int] = []
+        n = num_workers
+        while True:
+            n = -(-n // fanout)           # ceil division
+            self.level_sizes.append(n)
+            if n == 1:
+                break
+
+    @property
+    def depth(self) -> int:
+        return len(self.level_sizes)
+
+    @property
+    def root(self) -> "tuple[int, int]":
+        return (self.depth - 1, 0)
+
+    def leaf_children(self, node: int) -> range:
+        """Worker pids under level-0 node ``node``."""
+        lo = node * self.fanout
+        return range(lo, min(lo + self.fanout, self.num_workers))
+
+    def node_children(self, level: int, node: int) -> range:
+        """Child node indices (at ``level - 1``) of a level>=1 node."""
+        lo = node * self.fanout
+        return range(lo, min(lo + self.fanout,
+                             self.level_sizes[level - 1]))
+
+    def reducer_of(self, level: int, node: int) -> int:
+        """The pid responsible for publishing this node's partial."""
+        return node * self.fanout ** (level + 1)
+
+    def duties(self, pid: int) -> "list[tuple[int, int]]":
+        """The (level, node) partials ``pid`` publishes, leaves upward
+        (ascending level — a reducer folds its own lower partial into
+        the next level's on the same tick)."""
+        out = []
+        for level, size in enumerate(self.level_sizes):
+            step = self.fanout ** (level + 1)
+            if pid % step != 0:
+                break                     # not a reducer above this level
+            node = pid // step
+            if node < size:
+                out.append((level, node))
+        return out
+
+
+def publish_tree_partial(agent, level: int, node: int,
+                         snapshots: "dict[int, dict]"):
+    """Publish the union-of-leaf-snapshots partial for one tree node."""
+    agent.key_value_set(
+        _tree_key(level, node),
+        json.dumps({"wall": time.time(),
+                    "snapshots": {str(p): s
+                                  for p, s in snapshots.items()}}))
+
+
+def read_tree_partial(agent, level: int, node: int) -> "dict[int, dict]":
+    """The leaf snapshots accumulated under one tree node ({} when the
+    partial is absent or torn)."""
+    raw = agent.key_value_try_get(_tree_key(level, node))
+    if raw is None:
+        return {}
+    try:
+        payload = json.loads(raw.decode())
+        return {int(p): s
+                for p, s in (payload.get("snapshots") or {}).items()}
+    except (ValueError, UnicodeDecodeError):
+        return {}                         # torn publish: next tick heals
+
+
+def run_duties(agent, topology: RollupTopology, pid: int):
+    """Execute ``pid``'s reducer duties for one tick: for each anchored
+    node (leaves upward), union the children's payloads and republish
+    the partial. Missing children (dead or not-yet-published workers)
+    are skipped — their last partial simply stays stale."""
+    for level, node in topology.duties(pid):
+        if level == 0:
+            snaps = read_snapshots(agent, topology.leaf_children(node))
+        else:
+            snaps = {}
+            for child in topology.node_children(level, node):
+                snaps.update(read_tree_partial(agent, level - 1, child))
+        if snaps:
+            publish_tree_partial(agent, level, node, snaps)
+
+
+def collect_rollup_tree(agent, topology: RollupTopology) -> dict:
+    """Coordinator-side collect: ONE root read instead of N leaf reads;
+    the merge itself is the exact flat-path ``merge_rollup`` over the
+    union the tree accumulated (bit-identical output at any depth)."""
+    level, node = topology.root
+    return merge_rollup(read_tree_partial(agent, level, node))
+
+
 def phase_summary(rollup: dict) -> dict:
     """Fleet-wide step-phase view of a rollup: the per-step phase
     fractions StepTelemetry publishes (``training/phase/<name>_frac``
@@ -189,10 +338,15 @@ def rollup_scalars(rollup: dict) -> dict:
 class MetricsPublisher:
     """Worker-side background thread publishing registry snapshots on a
     period. ``stop()`` publishes one final snapshot so short runs are
-    never invisible to the coordinator."""
+    never invisible to the coordinator.
+
+    With ``tree`` set (a :class:`RollupTopology`), the publisher also
+    executes this process's reducer duties each tick — the worker-side
+    half of the tree-structured rollup path."""
 
     def __init__(self, agent=None, reg=None,
-                 interval_s: float = 2.0, process_id: int | None = None):
+                 interval_s: float = 2.0, process_id: int | None = None,
+                 tree: "RollupTopology | None" = None):
         from distributed_tensorflow_tpu.cluster.coordination import (
             coordination_service)
         self.agent = agent or coordination_service()
@@ -200,6 +354,7 @@ class MetricsPublisher:
         self.interval_s = interval_s
         self.process_id = (process_id if process_id is not None
                            else self.agent.process_id)
+        self.tree = tree
         self._seq = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -211,6 +366,8 @@ class MetricsPublisher:
         try:
             publish_snapshot(self.agent, self.reg,
                              process_id=self.process_id, seq=self._seq)
+            if self.tree is not None:
+                run_duties(self.agent, self.tree, self.process_id)
         except Exception:
             pass                        # service going down mid-run
 
@@ -240,11 +397,13 @@ class FleetAggregator:
 
     def __init__(self, worker_ids, agent=None, interval_s: float = 2.0,
                  summary_writer=None, step_metric: str =
-                 "training/steps_completed"):
+                 "training/steps_completed",
+                 tree: "RollupTopology | None" = None):
         from distributed_tensorflow_tpu.cluster.coordination import (
             coordination_service)
         self.agent = agent or coordination_service()
         self.worker_ids = list(worker_ids)
+        self.tree = tree
         self.interval_s = interval_s
         self.writer = summary_writer
         self.step_metric = step_metric
@@ -271,7 +430,9 @@ class FleetAggregator:
             rollup_fn=lambda: self.last_rollup, **exporter_kwargs)
 
     def collect_once(self) -> dict:
-        rollup = collect_rollup(self.agent, self.worker_ids)
+        rollup = (collect_rollup_tree(self.agent, self.tree)
+                  if self.tree is not None
+                  else collect_rollup(self.agent, self.worker_ids))
         with self._rollup_lock:
             self._last_rollup = rollup
             self._n += 1

@@ -103,11 +103,36 @@ def render_prometheus(snapshot: dict, *, prefix: str = "dtx_",
     return lines
 
 
-def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_") \
-        -> "list[str]":
+def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_",
+                  stale_after_s: "float | None" = None,
+                  now: "float | None" = None) -> "list[str]":
     """Fleet rollup (``aggregate.merge_rollup``) → per-worker labelled
     samples plus the merged stats — the one-scrape-sees-all-workers
-    path."""
+    path.
+
+    ``stale_after_s`` drops the ``worker="<pid>"`` label series of
+    workers whose last snapshot (``rollup["workers"][pid]["wall"]``)
+    is older than that many seconds before ``now`` (default: the
+    NEWEST snapshot wall in the rollup, so the filter needs no clock
+    agreement with the workers). A worker that died before a reform
+    leaves its final snapshot in the KV forever; without the filter a
+    post-recovery scrape keeps reporting that ghost as a live series.
+    The merged ``stat=`` samples are untouched — they answer "what did
+    the fleet do", the per-worker labels answer "who is alive doing
+    it"."""
+    stale: set = set()
+    workers = rollup.get("workers") or {}
+    if stale_after_s is not None and workers:
+        walls = {pid: w.get("wall") for pid, w in workers.items()
+                 if isinstance(w, dict)
+                 and isinstance(w.get("wall"), (int, float))}
+        if walls:
+            ref = now if now is not None else max(walls.values())
+            stale = {pid for pid, wall in walls.items()
+                     if ref - wall > stale_after_s}
+            # snapshot payloads key workers by int, JSON round-trips
+            # may key them by str: treat both spellings as the pid
+            stale |= {str(p) for p in stale}
     lines: list[str] = []
     for name, entry in sorted((rollup.get("metrics") or {}).items()):
         pname = _prom_name(name, prefix)
@@ -120,6 +145,8 @@ def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_") \
             or entry.get("per_worker_count") or {}
         for pid, v in sorted(per_worker.items(), key=lambda kv:
                              str(kv[0])):
+            if pid in stale or str(pid) in stale:
+                continue
             v = _num(v)
             if v is not None:
                 lines.append(f'{pname}{{worker="{pid}"}} {v:g}')
@@ -238,13 +265,18 @@ class MetricsExporter:
     def __init__(self, reg=None, *, interval_s: float = 2.0,
                  dir: "str | None" = None, port: "int | None" = None,
                  rollup_fn=None, extra_fn=None, history_points: int = 512,
-                 labels: "dict | None" = None):
+                 labels: "dict | None" = None,
+                 stale_workers_after_s: "float | None" = 30.0):
         self.reg = reg or _registry.get_registry()
         self.interval_s = interval_s
         self.dir = dir
         self.history = SeriesHistory(history_points)
         self._rollup_fn = rollup_fn
         self._extra_fn = extra_fn
+        #: drop ghost ``worker=`` series whose snapshot is this much
+        #: older than the fleet's newest (None keeps every label —
+        #: see render_rollup)
+        self.stale_workers_after_s = stale_workers_after_s
         self._labels = labels
         self._text = "# dtx exporter: no tick yet\n"
         self._text_lock = threading.Lock()
@@ -277,7 +309,9 @@ class MetricsExporter:
             try:
                 rollup = self._rollup_fn()
                 if rollup:
-                    lines += render_rollup(rollup)
+                    lines += render_rollup(
+                        rollup,
+                        stale_after_s=self.stale_workers_after_s)
             except Exception:
                 lines.append("# rollup_fn failed")
         if self._extra_fn is not None:

@@ -173,3 +173,73 @@ def test_generation_from_environment(monkeypatch):
         assert elastic.generation() == 2
     finally:
         elastic.set_generation(None)
+
+
+def test_generation_override_is_thread_local():
+    from distributed_tensorflow_tpu.cluster import elastic
+
+    seen = {}
+
+    def worker(gen):
+        with elastic.generation_override(gen):
+            time.sleep(0.02)               # overlap the two overrides
+            seen[gen] = elastic.namespace("k")
+
+    ts = [threading.Thread(target=worker, args=(g,)) for g in (0, 3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert seen == {0: "k", 3: "gen3/k"}
+    assert elastic.namespace("k") == "k"   # override fully unwound
+
+
+class _FakeLegacyClient:
+    """A jaxlib<0.5 DistributedRuntimeClient double: string get +
+    write-once set only, no try_get/increment, counts every RPC."""
+
+    def __init__(self):
+        self.kv: dict[str, bytes] = {}
+        self.rpcs = 0
+
+    def blocking_key_value_get(self, key, wait_ms):
+        self.rpcs += 1
+        if key not in self.kv:
+            raise RuntimeError("DEADLINE_EXCEEDED")
+        return self.kv[key].decode()
+
+    def blocking_key_value_get_bytes(self, key, wait_ms):
+        self.rpcs += 1
+        if key not in self.kv:
+            raise RuntimeError("DEADLINE_EXCEEDED")
+        return self.kv[key]
+
+    def key_value_set_bytes(self, key, data, allow_overwrite=True):
+        self.rpcs += 1
+        if not allow_overwrite and key in self.kv:
+            raise RuntimeError(f"ALREADY_EXISTS: {key}")
+        self.kv[key] = data
+
+
+def test_legacy_increment_cold_start_seeds_probe_hint(monkeypatch):
+    """ISSUE 11: the slot-ladder increment fallback must not probe the
+    whole ladder on cold start — the p-th process seeding its hint from
+    the published value key pays O(1) RPCs, not O(p) (O(N^2) total
+    across a fleet)."""
+    fake = _FakeLegacyClient()
+    # 200 increments already claimed by earlier processes
+    for i in range(1, 201):
+        fake.kv[f"ctr/__c__/{i}"] = b"1"
+    fake.kv["ctr"] = b"200"
+
+    agent = CoordinationServiceAgent()
+    monkeypatch.setattr(type(agent), "_client", property(lambda s: fake))
+    assert agent._is_legacy(fake)
+    fake.rpcs = 0
+    assert agent.key_value_increment("ctr") == 201
+    # 1 hint read + 1 successful claim + 1 value publish — NOT ~200 probes
+    assert fake.rpcs <= 4, fake.rpcs
+    # warm path: the hint advances, still O(1)
+    fake.rpcs = 0
+    assert agent.key_value_increment("ctr") == 202
+    assert fake.rpcs <= 3, fake.rpcs

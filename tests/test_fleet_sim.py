@@ -1,0 +1,426 @@
+"""Fleet-scale control plane under the simulated-fleet harness (ISSUE 11).
+
+Everything here is fast and in-process: N simulated workers are threads
+driving the REAL coordination / aggregation / supervisor code paths
+against a shared in-memory KV (testing/fleet_sim.py). Covered:
+
+- tree-structured rollups merge BIT-IDENTICALLY to the flat path while
+  the coordinator reads one root key instead of N;
+- N=64 barriers: a dead participant times out (never hangs) and the
+  error NAMES the missing worker;
+- sharded heartbeat fan-in: the supervisor detects a stalled worker at
+  N=64 through per-shard summary keys, and a dead shard REDUCER only
+  degrades that shard's read path, not detection;
+- seeded crash/stall/partition schedules recover deterministically
+  under the real RecoverySupervisor;
+- KV lifecycle GC: dead generations' namespaces are swept after the
+  grace window (straggler-safe), keeping KV size bounded across >=3
+  reforms.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster import coordination, elastic, kv_gc
+from distributed_tensorflow_tpu.cluster.coordination import (
+    BarrierTimeoutError,
+)
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience import heartbeats as hb
+from distributed_tensorflow_tpu.telemetry import aggregate
+from distributed_tensorflow_tpu.telemetry import registry as _registry
+from distributed_tensorflow_tpu.testing import fleet_sim
+
+
+# ---------------------------------------------------------------------------
+# Rollup topology + tree/flat bit-identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,fanout", [(1, 16), (5, 2), (16, 16),
+                                      (64, 4), (100, 16)])
+def test_topology_partitions_every_level(n, fanout):
+    topo = aggregate.RollupTopology(n, fanout=fanout)
+    # level 0 covers every pid exactly once
+    seen = []
+    for node in range(topo.level_sizes[0]):
+        seen.extend(topo.leaf_children(node))
+    assert seen == list(range(n))
+    # each level's nodes cover the level below exactly once, and every
+    # node's reducer is a pid that anchors it in its own duty list
+    for level in range(1, topo.depth):
+        covered = []
+        for node in range(topo.level_sizes[level]):
+            covered.extend(topo.node_children(level, node))
+            red = topo.reducer_of(level, node)
+            assert (level, node) in topo.duties(red)
+        assert covered == list(range(topo.level_sizes[level - 1]))
+    assert topo.level_sizes[-1] == 1            # single root
+    assert topo.reducer_of(*topo.root) == 0     # owned by pid 0
+
+
+def _publish_fleet(agents, tree, values):
+    """Per-worker registries -> leaf snapshots -> full duty sweep."""
+    for agent in agents:
+        reg = _registry.MetricsRegistry()
+        reg.counter("fleet/work_done", "t").increment(
+            values[agent.process_id])
+        reg.histogram("fleet/step_time", "t").observe(
+            0.01 * (1 + agent.process_id))
+        aggregate.publish_snapshot(agent, reg,
+                                   process_id=agent.process_id, seq=1)
+    # one duty sweep propagates values one level up; depth sweeps
+    # reach the root (the live harness amortizes this over ticks)
+    for _ in range(tree.depth):
+        for agent in agents:
+            aggregate.run_duties(agent, tree, agent.process_id)
+
+
+def test_tree_rollup_bit_identical_to_flat():
+    n = 40
+    agents = fleet_sim.make_sim_cluster(n)
+    tree = aggregate.RollupTopology(n, fanout=4)   # depth 3: a real tree
+    values = [7 * p + 1 for p in range(n)]
+    _publish_fleet(agents, tree, values)
+
+    flat = aggregate.merge_rollup(aggregate.read_snapshots(
+        agents[0], range(n)))
+    via_tree = aggregate.collect_rollup_tree(agents[0], tree)
+    assert via_tree == flat                       # bit-identical merge
+    assert via_tree["metrics"]["fleet/work_done"]["sum"] == sum(values)
+    assert len(via_tree["workers"]) == n
+
+
+def test_tree_collect_is_one_read_and_fan_in_bounded():
+    n = 64
+    fanout = 4
+    agents = fleet_sim.make_sim_cluster(n)
+    tree = aggregate.RollupTopology(n, fanout=fanout)
+    _publish_fleet(agents, tree, [1] * n)
+    collector = fleet_sim.SimAgent(agents[0]._local, n, n)
+    aggregate.collect_rollup_tree(collector, tree)
+    # the coordinator's collect is ONE try_get (vs n for the flat path)
+    assert collector.op_counts["try_get"] == 1
+    # a SINGLE duty sweep never fans any worker into more than
+    # fanout * depth reads (the flat coordinator paid n per tick)
+    for a in agents:
+        a.op_counts.clear()
+    for a in agents:
+        aggregate.run_duties(a, tree, a.process_id)
+    per_agent_reads = max(a.op_counts["try_get"] for a in agents)
+    assert per_agent_reads <= fanout * tree.depth < n
+
+
+def test_tree_tolerates_missing_workers():
+    n = 12
+    agents = fleet_sim.make_sim_cluster(n)
+    tree = aggregate.RollupTopology(n, fanout=4)
+    alive = [a for a in agents if a.process_id not in (3, 7)]
+    _publish_fleet(alive, tree, [1] * n)
+    rollup = aggregate.collect_rollup_tree(agents[0], tree)
+    assert sorted(rollup["workers"]) == sorted(
+        a.process_id for a in alive)
+
+
+# ---------------------------------------------------------------------------
+# Barriers at fleet size
+# ---------------------------------------------------------------------------
+
+def test_barrier_n64_with_dead_participant_names_it():
+    """ISSUE 11 satellite: a 64-worker barrier with one dead
+    participant must TIME OUT (not hang) and name the missing worker."""
+    n, dead = 64, 41
+    agents = fleet_sim.make_sim_cluster(n)
+    errors: "list[str]" = []
+    done = []
+    lock = threading.Lock()
+
+    def arrive(agent):
+        try:
+            agent.barrier("fleet/sync", timeout_s=0.8)
+            with lock:
+                done.append(agent.process_id)
+        except BarrierTimeoutError as e:
+            with lock:
+                errors.append(str(e))
+
+    threads = [threading.Thread(target=arrive, args=(a,))
+               for a in agents if a.process_id != dead]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert time.monotonic() - t0 < 15          # timed out, did not hang
+    assert not done
+    assert len(errors) == n - 1
+    assert all(f"missing participant(s): [{dead}]" in e for e in errors)
+    assert all("63/64 arrived" in e for e in errors)
+
+
+def test_barrier_n64_all_present_releases():
+    n = 64
+    agents = fleet_sim.make_sim_cluster(n)
+    released = []
+    lock = threading.Lock()
+
+    def arrive(agent):
+        agent.barrier("fleet/sync-ok", timeout_s=20.0)
+        with lock:
+            released.append(agent.process_id)
+
+    threads = [threading.Thread(target=arrive, args=(a,)) for a in agents]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(released) == list(range(n))
+
+
+def test_per_key_wakeups_do_not_wake_unrelated_getters():
+    """The reform-storm fix: a reader blocked on key A must not be
+    woken by writes to other keys (the old single-condition service
+    woke every waiter on every set)."""
+    svc = coordination._LocalService()
+    got = {}
+
+    def reader():
+        got["v"] = svc.get("a", timeout_s=5.0)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while "a" not in svc._waiters and time.monotonic() < deadline:
+        time.sleep(0.005)
+    for i in range(50):                        # unrelated write traffic
+        svc.set(f"hb/{i}", b"x")
+    assert svc.stats["waiters_woken"] == 0     # nobody woken spuriously
+    svc.set("a", b"v")
+    t.join(timeout=5)
+    assert got["v"] == b"v"
+    assert svc.stats["waiters_woken"] == 1
+
+
+def test_agent_op_counts_instrumented():
+    (agent,) = fleet_sim.make_sim_cluster(1)
+    agent.key_value_set("k", "v")
+    agent.key_value_try_get("k")
+    agent.key_value_get("k", timeout_s=1.0)
+    agent.key_value_increment("ctr")
+    agent.key_value_delete("k")
+    agent.barrier("b", timeout_s=1.0)
+    assert agent.op_counts == {"set": 1, "try_get": 1, "get": 1,
+                               "increment": 1, "delete": 1, "barrier": 1}
+
+
+# ---------------------------------------------------------------------------
+# Sharded heartbeats
+# ---------------------------------------------------------------------------
+
+def test_sharded_heartbeats_summary_reads_are_sublinear():
+    n, shard = 64, 16
+    agents = fleet_sim.make_sim_cluster(n)
+    pubs = [hb.ShardedHeartbeatPublisher(
+        a, pid=a.process_id, num_workers=n, shard_size=shard)
+        for a in agents]
+    for p in pubs:
+        p.beat(3)
+    for p in pubs:                 # reducers fold the now-complete shard
+        if p.is_reducer:           # (live loops re-summarize every beat)
+            p.summarize()
+    reader_agent = fleet_sim.SimAgent(agents[0]._local, n, n)
+    source = hb.ShardedKVHeartbeats(reader_agent, shard_size=shard)
+    hbs = source.read_all(n)
+    assert sorted(hbs) == list(range(n))
+    assert all(h[1] == 3 for h in hbs.values())
+    # steady state: n/shard summary reads, zero per-member fallbacks
+    assert reader_agent.op_counts["try_get"] == n // shard
+    assert source.reads_fallback == 0
+
+
+def test_sharded_heartbeats_dead_reducer_falls_back_per_member():
+    n, shard = 32, 8
+    agents = fleet_sim.make_sim_cluster(n)
+    # shard 1's reducer (pid 8) never beats: no summary for that shard
+    for a in agents:
+        if a.process_id == 8:
+            continue
+        hb.ShardedHeartbeatPublisher(
+            a, pid=a.process_id, num_workers=n, shard_size=shard).beat(5)
+    source = hb.ShardedKVHeartbeats(
+        fleet_sim.SimAgent(agents[0]._local, n, n), shard_size=shard)
+    hbs = source.read_all(n)
+    # every live member of the reducer-less shard is still visible
+    assert {9, 10, 11, 12, 13, 14, 15} <= set(hbs)
+    assert 8 not in hbs
+    assert source.reads_fallback == shard       # only THAT shard enumerated
+
+
+def test_fleet_stall_detected_and_named_at_n64():
+    """Supervisor-side scalable detect: at N=64 a stalled worker is
+    found through the per-shard summaries and the failure names it."""
+    sched = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="fleet.step", action="delay", delay_s=3.0,
+                         tag="37", hits=(3,)),), seed=7)
+    sim = fleet_sim.FleetSim(64, steps=10, step_s=0.02,
+                             fault_schedule=sched, stall_timeout_s=0.5,
+                             hb_shard_size=16)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    stalls = [d for d in rep.detections if d["kind"] == "stall"]
+    assert stalls and stalls[0]["task_id"] == 37, rep.detections
+    assert rep.generations == 2
+    assert any("worker:37 stall" in f for f in rep.failures)
+
+
+# ---------------------------------------------------------------------------
+# Seeded fault schedules through the real supervisor
+# ---------------------------------------------------------------------------
+
+def test_seeded_schedule_is_deterministic():
+    s1 = fleet_sim.seeded_fleet_schedule(3, 100)
+    s2 = fleet_sim.seeded_fleet_schedule(3, 100)
+    assert s1.to_json() == s2.to_json()
+    assert s1.to_json() != fleet_sim.seeded_fleet_schedule(4, 100).to_json()
+
+
+def test_seeded_crash_recovers_and_fires_identically_across_runs():
+    def run_once():
+        sim = fleet_sim.FleetSim(
+            24, steps=12, step_s=0.01,
+            fault_schedule=fleet_sim.seeded_fleet_schedule(
+                0, 24, stall_s=2.0),
+            stall_timeout_s=0.7)
+        rep = sim.run()
+        assert rep.completed, rep.error
+        return rep
+
+    r1, r2 = run_once(), run_once()
+    assert r1.faults_fired == r2.faults_fired   # same sites/tags/hits
+    assert r1.generations >= 2                  # the crash forced a reform
+    assert r1.generations == r2.generations
+
+
+def test_partition_rejoins_without_recovery_when_short():
+    sched = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="fleet.step", action="signal",
+                         tag="4", hits=(3,)),))
+    sim = fleet_sim.FleetSim(8, steps=10, step_s=0.02,
+                             fault_schedule=sched, partition_steps=2,
+                             stall_timeout_s=5.0)   # budget >> partition
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.generations == 1                 # rode it out: no reform
+    assert any(f["action"] == "signal" for f in rep.faults_fired)
+
+
+# ---------------------------------------------------------------------------
+# KV lifecycle GC
+# ---------------------------------------------------------------------------
+
+def test_gc_sweeps_only_dead_generations():
+    (agent,) = fleet_sim.make_sim_cluster(1)
+    for gen in range(4):                        # gens 0..3 write a key
+        with elastic.generation_override(gen):
+            agent.key_value_set("fleet/hb/0/0", f"{gen}")
+    gc = kv_gc.GenerationGC(agent, grace_s=0.0)
+    gc.note_generation_end(1, time.time() - 1)
+    gc.note_generation_end(2, time.time() - 1)
+    assert gc.maybe_sweep(current_gen=3) == [1, 2]
+    kv = agent._local
+    assert kv.try_get("fleet/hb/0/0") is not None      # gen 0: never swept
+    assert kv.try_get("gen1/fleet/hb/0/0") is None
+    assert kv.try_get("gen2/fleet/hb/0/0") is None
+    assert kv.try_get("gen3/fleet/hb/0/0") is not None  # live: untouched
+
+
+def test_gc_grace_window_protects_stragglers():
+    """Regression (ISSUE 11 satellite): gen-N keys must survive while a
+    gen-N straggler is mid-read — the sweep waits a full grace window
+    past the outgoing generation's last heartbeat."""
+    (agent,) = fleet_sim.make_sim_cluster(1)
+    with elastic.generation_override(1):
+        agent.key_value_set("state", "precious")
+    gc = kv_gc.GenerationGC(agent, grace_s=10.0)
+    now = time.time()
+    gc.note_generation_end(1, now)              # straggler just heartbeat
+
+    got = {}
+
+    def straggler():
+        with elastic.generation_override(1):    # still living in gen 1
+            got["v"] = agent.key_value_get("state", timeout_s=5.0)
+
+    t = threading.Thread(target=straggler)
+    t.start()
+    # inside the grace window: nothing may be swept
+    assert gc.maybe_sweep(current_gen=2, now=now + 5.0) == []
+    t.join(timeout=10)
+    assert got["v"] == b"precious"              # straggler read intact
+    # past the window: swept exactly once
+    assert gc.maybe_sweep(current_gen=2, now=now + 11.0) == [1]
+    assert agent._local.try_get("gen1/state") is None
+    assert gc.pending() == []
+
+
+def test_gc_bounds_kv_size_across_three_reforms():
+    """Acceptance: >=3 simulated reforms with GC keep the KV bounded —
+    only gen 0 (unprefixed by design) and the live generation remain."""
+    rules = tuple(faults.FaultRule(site="fleet.step", action="raise",
+                                   tag=str(w), hits=(h,))
+                  for w, h in ((1, 3), (2, 9), (3, 15)))
+    sim = fleet_sim.FleetSim(
+        12, steps=7, step_s=0.02, stall_timeout_s=None,
+        fault_schedule=faults.FaultSchedule(rules=rules), gc_grace_s=0.1)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.generations == 4
+    assert rep.swept_generations == [1, 2]      # 0 exempt, 3 live
+    kv = sim.kv
+    with kv._lock:
+        keys = list(kv._kv)
+    assert not [k for k in keys if k.startswith(("gen1/", "gen2/"))]
+    live = [k for k in keys if k.startswith("gen3/")]
+    gen0 = [k for k in keys if not k.startswith("gen")]
+    # bounded: every key is either the live generation's or gen 0's
+    assert len(keys) == len(live) + len(gen0)
+
+
+def test_supervisor_emits_kv_gc_event():
+    rules = (faults.FaultRule(site="fleet.step", action="raise",
+                              tag="1", hits=(2,)),
+             faults.FaultRule(site="fleet.step", action="raise",
+                              tag="2", hits=(8,)))
+    import tempfile
+    tdir = tempfile.mkdtemp(prefix="fleet_gc_ev_")
+    sim = fleet_sim.FleetSim(
+        8, steps=8, step_s=0.02, stall_timeout_s=None,
+        fault_schedule=faults.FaultSchedule(rules=rules),
+        gc_grace_s=0.05, telemetry_dir=tdir)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    events = []
+    with open(f"{tdir}/events-supervisor.jsonl") as f:
+        for line in f:
+            events.append(json.loads(line))
+    gc_events = [e for e in events if e.get("ev") == "recovery.kv_gc"]
+    assert gc_events and gc_events[0]["swept"] == [1]
+
+
+# ---------------------------------------------------------------------------
+# The harness itself at a real fleet size (kept fast: tiny steps)
+# ---------------------------------------------------------------------------
+
+def test_fleet_n256_clean_run_curve_observables():
+    sim = fleet_sim.FleetSim(256, steps=6, step_s=0.01,
+                             publish_every=2, hb_shard_size=32)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.rollup_workers_seen == 256
+    # tree rollups: the busiest agent's per-step ops stay far below the
+    # flat coordinator's N reads per tick
+    assert rep.max_agent_ops_per_step < 256 / 2
+    # every worker pays a few KV ops per step, independent of N
+    assert rep.ops_per_worker_per_step < 12

@@ -343,6 +343,30 @@ def test_render_rollup_worker_labels():
     assert 'dtx_fleet_training_steps_completed{worker="1"} 20' in text
 
 
+def test_render_rollup_drops_ghost_workers():
+    """ISSUE 11 satellite: a worker that died before reform leaves its
+    final snapshot in the KV forever; with ``stale_after_s`` its
+    ``worker=`` series disappears from the scrape instead of posing as
+    a live worker (merged stats stay — they describe the fleet's
+    history, not its roster)."""
+    from distributed_tensorflow_tpu.telemetry.aggregate import (
+        merge_rollup)
+    snaps = {p: {"pid": p, "seq": 9, "wall": 1000.0 + p * 100,
+                 "metrics": {"training/steps_completed":
+                             {"type": "counter", "value": 10 * (p + 1)}}}
+             for p in (0, 1, 2)}                 # walls 1000/1100/1200
+    rollup = merge_rollup(snaps)
+    text = "\n".join(telemetry.render_rollup(rollup, stale_after_s=150))
+    # worker 0 is 200s behind the newest snapshot: a ghost
+    assert 'worker="0"' not in text
+    assert 'dtx_fleet_training_steps_completed{worker="1"} 20' in text
+    assert 'dtx_fleet_training_steps_completed{worker="2"} 30' in text
+    assert 'dtx_fleet_training_steps_completed{stat="sum"} 60' in text
+    # default (no staleness filter) keeps every label — old behavior
+    full = "\n".join(telemetry.render_rollup(rollup))
+    assert 'worker="0"' in full
+
+
 def test_series_history_delta_and_rate():
     hist = telemetry.SeriesHistory(points=16)
     for t in range(5):

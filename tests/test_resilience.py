@@ -230,6 +230,50 @@ def test_backoff_jitter_bounded_and_seeded():
         assert 0.05 <= d <= 0.15
 
 
+def test_decorrelated_jitter_bounded_and_seeded():
+    """ISSUE 11 satellite: decorrelated jitter — each backoff a fresh
+    uniform draw from [base, 3*prev] capped at max — deterministic per
+    seed, bounded, and actually decorrelated across seeds."""
+    policy = RetryPolicy(initial_backoff_s=0.1, max_backoff_s=2.0,
+                         decorrelated=True, seed=3)
+    a = [Backoff(policy).next_s() for _ in range(1)]
+    pacer1, pacer2 = Backoff(policy), Backoff(policy)
+    seq1 = [pacer1.next_s() for _ in range(8)]
+    seq2 = [pacer2.next_s() for _ in range(8)]
+    assert seq1 == seq2                         # same seed, same schedule
+    assert a[0] == seq1[0]
+    prev = 0.0
+    for d in seq1:
+        lo, hi = 0.1, max(3.0 * (prev if prev > 0 else 0.1), 0.1)
+        assert lo <= d <= min(hi, 2.0) + 1e-12  # bounded by [base, 3*prev]
+        prev = d
+    # N workers with distinct seeds spread out instead of marching in
+    # lockstep waves (the thundering-herd property)
+    firsts = {RetryPolicy(initial_backoff_s=0.1, max_backoff_s=2.0,
+                          decorrelated=True, seed=s)
+              .backoff_s(1, __import__("random").Random(s))
+              for s in range(16)}
+    assert len(firsts) == 16
+    # reset restarts the chain at the base range
+    pacer1.reset()
+    assert 0.1 <= pacer1.next_s() <= 0.3
+
+
+def test_decorrelated_jitter_through_call_path():
+    policy = RetryPolicy(max_attempts=4, initial_backoff_s=0.001,
+                         max_backoff_s=0.01, decorrelated=True, seed=5)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert policy.call(flaky, retryable=(ValueError,)) == "ok"
+    assert len(attempts) == 3
+
+
 def test_backoff_pacer_clamps_and_resets():
     pacer = Backoff(RetryPolicy(initial_backoff_s=0.2,
                                 backoff_multiplier=2.0, max_backoff_s=1.0))

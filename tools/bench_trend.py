@@ -23,6 +23,11 @@ Series:
   ``serving/p99_latency_ms`` — the ``SERVING_r*.json`` request-level
   rows (tools/serve_sweep.py); the latency series gate INVERTED
   (growth past the fraction fails);
+- ``fleet/ops_per_sec/nNNNN`` + ``fleet/detect_ms/nNNNN`` /
+  ``fleet/mttr_ms/nNNNN`` — the ``FLEET_r*.json`` simulated-fleet
+  control-plane rows per worker count (bench.py --fleet /
+  tools/fleet_sweep.py); detect/MTTR gate INVERTED (>10% growth in
+  supervisor detect latency or recovery MTTR fails);
 - goodput/badput columns (``bench/goodput_frac``,
   ``serving/goodput_frac``, ``serving/badput_replay_frac``,
   ``serving/slo_p99_budget_consumed`` — the last two inverted): present
@@ -154,6 +159,38 @@ def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     return series
 
 
+def load_fleet_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from FLEET_r*.json (ISSUE 11): per
+    worker count, the control-plane ops/s series plus detect-latency
+    and MTTR series carrying ``lower_is_better`` so the regression
+    gate inverts (a detect or MTTR that GROWS >10% fails)."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "FLEET_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            extra = row.get("extra") or {}
+            n = extra.get("n_workers")
+            if not isinstance(n, int):
+                continue
+            key = f"n{n:04d}"
+            series.setdefault(f"fleet/ops_per_sec/{key}", {})[rnd] = {
+                "value": row.get("value"),
+                "unit": row.get("unit"),
+                "ops_per_worker_per_step":
+                    extra.get("ops_per_worker_per_step"),
+            }
+            for lat in ("detect_ms", "mttr_ms"):
+                if isinstance(extra.get(lat), (int, float)):
+                    series.setdefault(f"fleet/{lat}/{key}", {})[rnd] = {
+                        "value": extra[lat], "lower_is_better": True}
+    return series
+
+
 def check_regressions(series: "dict[str, dict[int, dict]]",
                       regression_frac: float) -> "list[str]":
     """Latest round of each series vs the BEST prior round: a drop past
@@ -241,6 +278,7 @@ def main(argv=None) -> int:
     series = load_bench_history(args.repo)
     series.update(load_scaling_history(args.repo))
     series.update(load_serving_history(args.repo))
+    series.update(load_fleet_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

@@ -37,6 +37,12 @@ requests: the union of ``served-*.jsonl`` ids equals the full seeded
 request set, with any cross-generation duplicates having generated
 IDENTICAL tokens (deterministic re-serve).
 
+The simulated-fleet axis of this family lives in
+``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
+through hundreds of in-process workers (testing/fleet_sim.py) plus the
+FLEET_r*.json control-plane scaling-curve gates — run it alongside the
+sweeps here.
+
 Usage::
 
     python tools/chaos_sweep.py --seeds 10            # seeds 0..9
