@@ -998,6 +998,135 @@ def run_fleet(out_path: str | None = None, *,
     return rows
 
 
+def run_data_service(out_path: str | None = None, *,
+                     worker_counts=(1, 2, 4), seed: int = 0):
+    """Disaggregated data-service bench (ISSUE 12): the in-process
+    input pipeline vs N input workers feeding one trainer over the
+    coordination KV (testing/fleet_sim.DataServiceSim — real
+    dispatcher/worker/client code, thread workers), on a deliberately
+    HOST-BOUND config: per-split production costs ``work_s`` of
+    GIL-releasing latency (the remote-storage/decode time
+    disaggregation exists to offload) while the trainer's compute per
+    batch is small. Two phases per N:
+
+    - **steady state**: elements/s vs the in-process baseline
+      (identical splits + trainer pacing, production inline), and the
+      trainer's infeed-wait fraction (fetch_wait / wall) — the number
+      that must DROP as workers are added;
+    - **churn**: the same run with one seeded input-worker kill
+      (``data.worker_step``) — splits reassigned per kill, and the
+      exactly-once check (zero lost / zero duplicated elements) that
+      makes the throughput claim honest under failure.
+
+    Honest caveat: thread workers + one GIL — overlap is real only for
+    the GIL-releasing share (sleep/IO/decode), which is exactly the
+    share a real input fleet offloads; the SHAPES (wait-frac vs N,
+    reassignment cost) are the product. Emits one JSON row per N;
+    ``--out`` writes DATA_r*.json for tools/bench_trend.py (wait-frac
+    and reassigned-per-kill gated inverted) and tools/fleet_sweep.py
+    --check.
+    """
+    from distributed_tensorflow_tpu.testing import fleet_sim
+
+    splits, eps, work_s = 24, 8, 0.02
+    batch, step_s, epochs = 8, 0.004, 1
+
+    # in-process baseline: same splits, same per-split cost, same
+    # trainer pacing — production is inline with the step loop
+    t0 = time.perf_counter()
+    wait_s = 0.0
+    n_elements = 0
+    in_batch = 0
+    for s in range(splits):
+        tw = time.perf_counter()
+        time.sleep(work_s)                  # the inline production
+        elements = [s * 1_000_000 + j for j in range(eps)]
+        wait_s += time.perf_counter() - tw
+        for _ in elements:
+            n_elements += 1
+            in_batch += 1
+            if in_batch >= batch:
+                time.sleep(step_s)          # the "train step"
+                in_batch = 0
+    base_wall = time.perf_counter() - t0
+    base_eps = n_elements / base_wall
+    base_wait_frac = wait_s / base_wall
+
+    rows = []
+    for n in worker_counts:
+        steady = fleet_sim.DataServiceSim(
+            n, splits, epochs=epochs, elements_per_split=eps,
+            work_s=work_s, consumer_batch=batch,
+            consumer_step_s=step_s, lease_timeout_s=1.0, seed=seed)
+        rep = steady.run()
+        if not rep.completed:
+            print(f"data-service: steady phase FAILED at n={n}: "
+                  f"{rep.error}", file=sys.stderr)
+        repk = None
+        if n >= 2:                  # churn needs a survivor to lease to
+            schedule = fleet_sim.seeded_data_kill_schedule(
+                seed, n, kills=1, attempt_range=(1, 3))
+            chaos = fleet_sim.DataServiceSim(
+                n, splits, epochs=epochs, elements_per_split=eps,
+                work_s=work_s, consumer_batch=batch,
+                consumer_step_s=step_s, lease_timeout_s=0.5,
+                fault_schedule=schedule, seed=seed)
+            repk = chaos.run()
+            if not repk.completed:
+                print(f"data-service: churn phase FAILED at n={n}: "
+                      f"{repk.error}", file=sys.stderr)
+        wait_frac = (rep.fetch_wait_s / rep.wall_s
+                     if rep.wall_s > 0 else None)
+        row = {
+            "metric": "data_service_elements_per_sec",
+            "value": rep.elements_per_sec,
+            "unit": "elements/s",
+            "vs_baseline": (round(rep.elements_per_sec / base_eps, 3)
+                            if base_eps > 0 else None),
+            "extra": {
+                "n_input_workers": n,
+                "num_splits": splits,
+                "elements_per_split": eps,
+                "epochs": epochs,
+                "wall_s": rep.wall_s,
+                "infeed_wait_frac": (round(wait_frac, 4)
+                                     if wait_frac is not None else None),
+                "inproc_elements_per_sec": round(base_eps, 1),
+                "inproc_infeed_wait_frac": round(base_wait_frac, 4),
+                "fetch_wait_s": rep.fetch_wait_s,
+                "steady_completed": rep.completed,
+                "churn_completed": (repk.completed if repk is not None
+                                    else None),
+                "splits_reassigned_per_kill": (
+                    repk.splits_reassigned if repk is not None
+                    else None),
+                "workers_died": (repk.workers_died
+                                 if repk is not None else []),
+                "churn_duplicates": (repk.duplicate_elements
+                                     if repk is not None else None),
+                "churn_missing": (repk.missing_elements
+                                  if repk is not None else None),
+                "rollup_workers_seen": rep.rollup_workers_seen,
+                "seed": seed,
+            },
+        }
+        rows.append(row)
+        print(json.dumps(row))
+        from distributed_tensorflow_tpu import telemetry
+        telemetry.event(
+            "data.row", n_input_workers=n,
+            elements_per_sec=rep.elements_per_sec,
+            infeed_wait_frac=row["extra"]["infeed_wait_frac"],
+            splits_reassigned=row["extra"]["splits_reassigned_per_kill"])
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "data_service",
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": rows}, f, indent=1)
+            f.write("\n")
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -1118,7 +1247,7 @@ if __name__ == "__main__":
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
                                  "input_pipeline", "scaling", "serving",
-                                 "fleet"],
+                                 "fleet", "data_service"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -1138,6 +1267,14 @@ if __name__ == "__main__":
     parser.add_argument("--fleet-sizes", default=None,
                         help="with --fleet: comma-separated worker "
                              "counts (default 8,64,256,1000)")
+    parser.add_argument("--data-service", action="store_true",
+                        help="run the disaggregated data-service bench "
+                             "(in-process pipeline vs N input workers: "
+                             "elements/s, infeed_wait_frac, splits "
+                             "reassigned per kill)")
+    parser.add_argument("--data-workers", default=None,
+                        help="with --data-service: comma-separated "
+                             "input-worker counts (default 1,2,4)")
     parser.add_argument("--qps", type=float, default=None,
                         help="with --serving: target arrival rate")
     parser.add_argument("--requests", type=int, default=None,
@@ -1161,6 +1298,11 @@ if __name__ == "__main__":
                   if args.fleet_sizes else (8, 64, 256, 1000))
         run_fleet(out_path=args.out, worker_counts=counts,
                   seed=args.seed)
+    elif args.data_service or args.workload == "data_service":
+        counts = (tuple(int(x) for x in args.data_workers.split(","))
+                  if args.data_workers else (1, 2, 4))
+        run_data_service(out_path=args.out, worker_counts=counts,
+                         seed=args.seed)
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
                     n_requests=args.requests, seed=args.seed,

@@ -122,7 +122,18 @@ class Checkpoint:
     ``restore``.
     """
 
-    def __init__(self, **objects):
+    def __init__(self, single_writer: bool = False, **objects):
+        #: ``single_writer=True`` declares that THIS process alone owns
+        #: the full tracked state and saves/restores it regardless of
+        #: how many processes the distributed runtime has — the
+        #: disaggregated-cluster case (input/data_service.py: one
+        #: trainer + N input workers who never checkpoint). The commit
+        #: protocol then skips its cross-process barriers and KV shard
+        #: gathering: an SPMD commit barrier over a cluster whose other
+        #: members never save would block for the full barrier timeout
+        #: on every save. Requires every tracked leaf to be fully
+        #: addressable from this process (no cross-process sharding).
+        self._single_writer = bool(single_writer)
         self._objects = objects
         self._save_counter = 0
         self._async_thread: threading.Thread | None = None
@@ -210,10 +221,16 @@ class Checkpoint:
                                                             dtype=np.int64)
         return host_arrays, index
 
+    def _proc(self) -> int:
+        """Shard-owner id: a single-writer checkpoint is always shard 0
+        (the saving process owns everything), whatever this process's
+        cluster rank is."""
+        return 0 if self._single_writer else jax.process_index()
+
     def _write_impl(self, path: str, *, async_write: bool,
                     tier: str = "durable", pipeline_to: str | None = None,
                     on_captured=None, span_id: str | None = None) -> str:
-        proc = jax.process_index()
+        proc = self._proc()
         tmp = f"{path}.tmp.{proc}"
         os.makedirs(tmp, exist_ok=True)
         host_arrays, index = self._capture()
@@ -340,7 +357,12 @@ class Checkpoint:
         # fresh KV keys (legacy TSL clients cannot safely re-read
         # deleted-then-recreated keys).
         sums_prefix = f"dtx_ckpt_sums/{token}.{self._save_counter}"
-        if agent.is_distributed:
+        # single-writer: the commit involves exactly one process — no
+        # shard barrier to meet, no peer sums to gather, and the index
+        # is ours to write whatever our cluster rank is
+        distributed = agent.is_distributed and not self._single_writer
+        chief = agent.is_chief or self._single_writer
+        if distributed:
             try:
                 agent.key_value_set(f"{sums_prefix}/p{agent.process_id}",
                                     json.dumps(sums))
@@ -355,9 +377,9 @@ class Checkpoint:
                 print(f"[dtx.checkpoint] WARNING: shard barrier failed "
                       f"({e}); committing possibly-incomplete checkpoint "
                       f"{path}", file=sys.stderr)
-        if agent.is_chief:
+        if chief:
             all_sums = dict(sums)
-            if agent.is_distributed:
+            if distributed:
                 # enumerated point reads (every process published before
                 # the shard barrier; legacy TSL clients hang on remote
                 # GetKeyValueDir, and a dead peer just contributes no
@@ -378,7 +400,7 @@ class Checkpoint:
                 os.fsync(f.fileno())
             os.replace(tmp_index, os.path.join(path, _INDEX_FILE))
             _fsync_dir(path)      # the index rename IS the commit point
-        if agent.is_distributed:
+        if distributed:
             try:
                 agent.barrier(f"ckpt_index/{token}", timeout_s=600.0)
             except Exception:
@@ -391,7 +413,7 @@ class Checkpoint:
         if decision is not None and decision.action == "corrupt":
             # Torn write AFTER the commit protocol finished: the index
             # says the checkpoint is complete, the storage disagrees.
-            shard = os.path.join(path, f"shard_{jax.process_index()}.npz")
+            shard = os.path.join(path, f"shard_{self._proc()}.npz")
             size = os.path.getsize(shard)
             with open(shard, "rb+") as f:
                 f.truncate(max(size - max(size // 4, 1), 0))
@@ -445,13 +467,13 @@ class Checkpoint:
                     # parts by it (file order is NOT slice order).
                     offset = shards[0][0][0].start or 0
                 return arr, meta, offset
-            if jax.process_index() == 0:
+            if self._proc() == 0:
                 return np.asarray(val), meta, None
             return None, meta, None
         arr = np.asarray(leaf)
         meta = {"kind": "array", "shape": list(arr.shape),
                 "dtype": str(arr.dtype)}
-        return (arr if jax.process_index() == 0 else None), meta, None
+        return (arr if self._proc() == 0 else None), meta, None
 
     @staticmethod
     def _slice_meta(index) -> list:

@@ -413,9 +413,27 @@ class CoordinationServiceAgent:
                     raise CoordinationError(
                         f"key_value_increment({key!r}) failed: {e}") from e
         self._inc_hint[key] = i
-        try:            # value key for plain readers (write-only: safe)
+        # Value key for plain readers (write-direction: safe). The
+        # publish is best-effort AND racy: a slower peer's SMALLER
+        # value can land after ours (lost update — observed as a
+        # full-suite flake in the 2-process barrier/increment test).
+        # One verify-read + conditional re-publish closes the common
+        # ordering: the larger writer re-asserts its value if a stale
+        # one overwrote it. (Still best-effort by design — the slot
+        # keys are the ground truth.)
+        try:
             c.key_value_set_bytes(key, str(i).encode(),
                                   allow_overwrite=True)
+            cur = self._legacy_get_once(c, key, 50)
+            stale = True
+            if cur is not None:
+                try:
+                    stale = int(cur) < i
+                except ValueError:
+                    pass
+            if stale:
+                c.key_value_set_bytes(key, str(i).encode(),
+                                      allow_overwrite=True)
         except Exception:
             pass
         return i

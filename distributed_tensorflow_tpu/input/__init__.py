@@ -9,6 +9,13 @@ from distributed_tensorflow_tpu.input.dataset import (
     InputOptions,
 )
 from distributed_tensorflow_tpu.input import image_ops
+from distributed_tensorflow_tpu.input.data_service import (
+    DataInputWorker,
+    DataServiceClient,
+    DataServiceConfig,
+    DataServiceDispatcher,
+)
+from distributed_tensorflow_tpu.input.split_provider import SplitProvider
 from distributed_tensorflow_tpu.input.example_parser import (
     FixedLenFeature,
     VarLenFeature,
@@ -19,8 +26,10 @@ from distributed_tensorflow_tpu.input.example_parser import (
 )
 
 __all__ = [
-    "AUTOTUNE", "AutoShardPolicy", "Dataset", "DistributedDataset",
-    "InputContext", "InputOptions", "FixedLenFeature", "VarLenFeature",
+    "AUTOTUNE", "AutoShardPolicy", "DataInputWorker", "DataServiceClient",
+    "DataServiceConfig", "DataServiceDispatcher", "Dataset",
+    "DistributedDataset", "InputContext", "InputOptions",
+    "FixedLenFeature", "SplitProvider", "VarLenFeature",
     "encode_example", "example_reader", "image_ops", "parse_example",
     "parse_single_example",
 ]

@@ -376,9 +376,26 @@ class Dataset:
         return self._derive(lambda: itertools.islice(src(), n, None),
                             op=lambda d: d.skip(n))
 
+    @staticmethod
+    def _check_shard_args(num_shards: int, index: int):
+        """Shared validation for shard/shard_files (≙ tf.data's
+        Dataset.shard errors). ``islice`` would treat a bad index as a
+        plain offset — a negative index raises deep inside itertools
+        and an out-of-range one silently yields nothing (an empty
+        worker that deadlocks its peers in the first collective)."""
+        if num_shards < 1:
+            raise ValueError(
+                f"num_shards must be >= 1, got {num_shards}")
+        if not 0 <= index < num_shards:
+            raise ValueError(
+                f"shard index {index} out of range [0, {num_shards}); an "
+                f"out-of-range index would silently yield no elements "
+                f"(or alias another shard)")
+
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """DATA-policy sharding: every ``num_shards``-th element
         (≙ tf.data Dataset.shard used by auto_shard_dataset)."""
+        self._check_shard_args(num_shards, index)
         src = self._gen_fn
         return self._derive(
             lambda: itertools.islice(src(), index, None, num_shards),
@@ -394,11 +411,7 @@ class Dataset:
         batching per shard."""
         if not self._files:
             raise ValueError("Dataset has no file list; use DATA sharding")
-        if not 0 <= index < num_shards:
-            raise ValueError(
-                f"shard index {index} out of range [0, {num_shards}); an "
-                f"out-of-range index would silently alias another shard's "
-                f"files (duplicate samples)")
+        self._check_shard_args(num_shards, index)
         if len(self._files) < num_shards:
             # Deterministic on EVERY worker (≙ tf.data FILE auto-shard's
             # 'not enough files' error) — erroring only on the
@@ -409,6 +422,20 @@ class Dataset:
                 f"{len(self._files)} file(s) cannot be sharded "
                 f"{num_shards} ways. Use more files or "
                 f"AutoShardPolicy.DATA.")
+        files, reader, chain = self.replay_spec()
+        ds = Dataset.from_files(files[index::num_shards], reader)
+        for op in reversed(chain):
+            ds = op(ds)
+        return ds
+
+    def replay_spec(self):
+        """The recorded rebuild recipe of a file-rooted pipeline:
+        ``(files, reader, chain)`` where replaying ``chain`` (outermost
+        last) over ``from_files(subset, reader)`` rebuilds this
+        pipeline on any file subset. This is the FILE auto-shard
+        machinery (:meth:`shard_files`) exposed for the disaggregated
+        data service (input/split_provider.py), which replays the same
+        chain per FILE split on remote input workers."""
         chain = []
         node = self
         while getattr(node, "_parent", None) is not None:
@@ -423,11 +450,7 @@ class Dataset:
             raise ValueError(
                 "pipeline root has no file source (e.g. Dataset.zip or "
                 "a generator root); use AutoShardPolicy.DATA")
-        ds = Dataset.from_files(node._files[index::num_shards],
-                                node._reader)
-        for op in reversed(chain):
-            ds = op(ds)
-        return ds
+        return list(node._files), node._reader, chain
 
     def interleave(self, map_fn: Callable[..., "Dataset"],
                    cycle_length: int = 4,

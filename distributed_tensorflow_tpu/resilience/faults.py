@@ -54,6 +54,20 @@ Instrumented sites:
                           suppressed for a window); the seeded
                           fault plans of bench.py --fleet and
                           tools/fleet_sweep.py are rules on this site
+``data.dispatch``         input/data_service.DataServiceDispatcher.tick
+                          (tag=job) — a ``raise`` fails one dispatch
+                          round; the background loop must absorb it
+                          and the next tick must re-derive assignment
+``data.fetch``            input/data_service.DataServiceClient split
+                          fetch (tag=split id) — a ``raise`` models a
+                          transient payload-read failure the trainer
+                          retries under its decorrelated RetryPolicy
+``data.worker_step``      input/data_service.DataInputWorker per
+                          split-processing attempt (tag=worker id) —
+                          ``raise`` crashes the input worker mid-epoch,
+                          ``delay`` stalls it past the lease budget;
+                          either must end in the dispatcher re-issuing
+                          the lease and an exactly-once epoch
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

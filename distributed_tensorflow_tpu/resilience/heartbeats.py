@@ -39,18 +39,21 @@ import time
 
 from distributed_tensorflow_tpu.cluster import elastic
 
-#: Per-worker heartbeat key (written by the worker every step).
-_HB_PREFIX = "fleet/hb"
-#: Per-shard summary key (written by the shard's reducer).
-_SUM_PREFIX = "fleet/hbsum"
+#: Default key namespace. The data service
+#: (input/data_service.py) rides the same transport under its own
+#: prefix (``data/<job>``) so input-worker liveness and trainer-fleet
+#: liveness never share keys.
+_DEFAULT_PREFIX = "fleet"
 
 
-def hb_key(shard: int, pid: int) -> str:
-    return f"{_HB_PREFIX}/{shard}/{pid}"
+def hb_key(shard: int, pid: int, *, prefix: str = _DEFAULT_PREFIX) -> str:
+    """Per-worker heartbeat key (written by the worker every step)."""
+    return f"{prefix}/hb/{shard}/{pid}"
 
 
-def sum_key(shard: int) -> str:
-    return f"{_SUM_PREFIX}/{shard}"
+def sum_key(shard: int, *, prefix: str = _DEFAULT_PREFIX) -> str:
+    """Per-shard summary key (written by the shard's reducer)."""
+    return f"{prefix}/hbsum/{shard}"
 
 
 def shard_of(pid: int, shard_size: int) -> int:
@@ -74,12 +77,14 @@ class ShardedHeartbeatPublisher:
 
     def __init__(self, agent, *, pid: int | None = None,
                  num_workers: int | None = None, shard_size: int = 32,
-                 summarize_every: int = 1):
+                 summarize_every: int = 1,
+                 key_prefix: str = _DEFAULT_PREFIX):
         self.agent = agent
         self.pid = pid if pid is not None else agent.process_id
         self.num_workers = (num_workers if num_workers is not None
                             else agent.num_processes)
         self.shard_size = shard_size
+        self.key_prefix = key_prefix
         self.shard = shard_of(self.pid, shard_size)
         self.is_reducer = (self.pid ==
                            shard_members(self.shard, shard_size,
@@ -89,8 +94,9 @@ class ShardedHeartbeatPublisher:
 
     def beat(self, step: int):
         """Publish liveness (and maybe the shard summary) for one step."""
-        self.agent.key_value_set(hb_key(self.shard, self.pid),
-                                 f"{int(step)} {time.time():.6f}")
+        self.agent.key_value_set(
+            hb_key(self.shard, self.pid, prefix=self.key_prefix),
+            f"{int(step)} {time.time():.6f}")
         self._beats += 1
         if self.is_reducer and self._beats % self.summarize_every == 0:
             self.summarize()
@@ -100,15 +106,17 @@ class ShardedHeartbeatPublisher:
         members = {}
         for m in shard_members(self.shard, self.shard_size,
                                self.num_workers):
-            raw = self.agent.key_value_try_get(hb_key(self.shard, m))
+            raw = self.agent.key_value_try_get(
+                hb_key(self.shard, m, prefix=self.key_prefix))
             if raw is None:
                 continue
             parsed = _parse_hb(raw)
             if parsed is not None:
                 members[str(m)] = parsed
         if members:
-            self.agent.key_value_set(sum_key(self.shard),
-                                     json.dumps(members))
+            self.agent.key_value_set(
+                sum_key(self.shard, prefix=self.key_prefix),
+                json.dumps(members))
 
 
 def _parse_hb(raw: bytes) -> "list | None":
@@ -173,10 +181,12 @@ class ShardedKVHeartbeats:
     """
 
     def __init__(self, agent, *, shard_size: int = 32,
-                 summary_stale_s: float = 2.0):
+                 summary_stale_s: float = 2.0,
+                 key_prefix: str = _DEFAULT_PREFIX):
         self.agent = agent
         self.shard_size = shard_size
         self.summary_stale_s = summary_stale_s
+        self.key_prefix = key_prefix
         self.generation = 0
         #: ops accounting for the cost curves: summary reads vs
         #: fallback member reads per read_all
@@ -187,7 +197,8 @@ class ShardedKVHeartbeats:
                   summarize_every: int = 1) -> ShardedHeartbeatPublisher:
         return ShardedHeartbeatPublisher(
             self.agent, pid=pid, num_workers=num_workers,
-            shard_size=self.shard_size, summarize_every=summarize_every)
+            shard_size=self.shard_size, summarize_every=summarize_every,
+            key_prefix=self.key_prefix)
 
     def clear(self, num_workers: int):
         # Nothing to unlink: a reform bumps the generation, and the new
@@ -198,7 +209,8 @@ class ShardedKVHeartbeats:
     def _read_shard_fallback(self, shard: int, num_workers: int,
                              out: dict):
         for m in shard_members(shard, self.shard_size, num_workers):
-            raw = self.agent.key_value_try_get(hb_key(shard, m))
+            raw = self.agent.key_value_try_get(
+                hb_key(shard, m, prefix=self.key_prefix))
             self.reads_fallback += 1
             if raw is None:
                 continue
@@ -212,7 +224,8 @@ class ShardedKVHeartbeats:
         now = time.time()
         with elastic.generation_override(self.generation):
             for shard in range(num_shards(num_workers, self.shard_size)):
-                raw = self.agent.key_value_try_get(sum_key(shard))
+                raw = self.agent.key_value_try_get(
+                    sum_key(shard, prefix=self.key_prefix))
                 self.reads_summary += 1
                 summary = None
                 if raw is not None:

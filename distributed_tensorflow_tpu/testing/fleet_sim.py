@@ -822,3 +822,294 @@ class FleetSim:
                         break
             out.append(rec)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated data service: simulated input-worker fleet
+# ---------------------------------------------------------------------------
+
+def seeded_data_kill_schedule(seed: int, num_workers: int, *,
+                              kills: int = 1,
+                              attempt_range: "tuple[int, int]" = (1, 4)
+                              ) -> faults.FaultSchedule:
+    """Seed-derived input-worker deaths on the ``data.worker_step``
+    site: each kill picks a victim and the split-processing ATTEMPT it
+    dies on (per-tag hit counter — attempt 1 means the worker dies
+    holding a lease it never completed). A pure function of the seed
+    (the resilience/faults.py discipline)."""
+    rng = random.Random(f"dtx-data-kill:{seed}")
+    victims = rng.sample(range(num_workers),
+                         k=min(kills, num_workers))
+    rules = []
+    for victim in victims:
+        at = rng.randrange(*attempt_range)
+        rules.append(faults.FaultRule(site="data.worker_step",
+                                      action="raise",
+                                      tag=str(victim), hits=(at,)))
+    return faults.FaultSchedule(rules=tuple(rules), seed=seed)
+
+
+@dataclasses.dataclass
+class DataFleetReport:
+    """What one DataServiceSim.run measured (bench.py --data-service's
+    raw rows + the chaos/property-test observables)."""
+
+    num_workers: int
+    num_splits: int
+    epochs: int
+    wall_s: float
+    completed: bool
+    #: exactly-once accounting, per epoch: the consumed multiset vs
+    #: the expected one
+    elements_delivered: int = 0
+    expected_elements: int = 0
+    duplicate_elements: int = 0
+    missing_elements: int = 0
+    #: per-epoch sorted element multisets (the property test's object)
+    epoch_multisets: list = dataclasses.field(default_factory=list)
+    splits_reassigned: int = 0
+    workers_died: list = dataclasses.field(default_factory=list)
+    elements_per_sec: float = 0.0
+    fetch_wait_s: float = 0.0
+    splits_per_worker: dict = dataclasses.field(default_factory=dict)
+    rollup_workers_seen: int = 0
+    rollup_splits_processed: "int | None" = None
+    faults_fired: list = dataclasses.field(default_factory=list)
+    error: "str | None" = None
+
+    def to_row(self) -> dict:
+        row = dataclasses.asdict(self)
+        row.pop("epoch_multisets", None)      # big; not a bench field
+        return row
+
+
+class DataServiceSim:
+    """N simulated input workers + the real dispatcher/worker/client
+    code (input/data_service.py) over one in-memory KV.
+
+    Worker threads run the REAL :class:`~distributed_tensorflow_tpu.
+    input.data_service.DataInputWorker` loop; a seeded ``raise`` on
+    ``data.worker_step`` kills the thread mid-epoch (its heartbeats
+    stop, exactly like a SIGKILL'd input-worker process), and the real
+    dispatcher must re-issue the dead worker's leases to survivors.
+    The consumer thread drains every epoch through the real
+    :class:`DataServiceClient` and the report carries the exactly-once
+    accounting (duplicates / missing vs the expected multiset), the
+    reassignment count, and per-worker split throughput rolled up
+    through the PR 11 tree topology (each worker publishes its own
+    metrics registry; the root rollup is collected once at the end).
+
+    ``elements_per_split`` elements are synthesized per FILE split;
+    ``work_s`` sleeps that long per split (GIL-releasing — models the
+    decode/IO the disaggregation exists to offload).
+    """
+
+    def __init__(self, num_workers: int, num_splits: int, *,
+                 epochs: int = 1, elements_per_split: int = 4,
+                 work_s: float = 0.0, lease_timeout_s: float = 0.5,
+                 poll_interval_s: float = 0.01,
+                 fault_schedule: "faults.FaultSchedule | None" = None,
+                 generation: int = 0, fanout: int = 16,
+                 hb_shard_size: int = 32, seed: int = 0,
+                 consumer_batch: int = 0,
+                 consumer_step_s: float = 0.0,
+                 timeout_s: float = 60.0):
+        self.num_workers = num_workers
+        self.num_splits = num_splits
+        self.epochs = epochs
+        self.elements_per_split = elements_per_split
+        self.work_s = work_s
+        #: trainer-shaped consumer pacing: every ``consumer_batch``
+        #: elements cost one ``consumer_step_s`` "train step" (0 =
+        #: drain flat out). fetch_wait_s / wall_s is then exactly the
+        #: run's infeed-wait fraction — the bench's host-boundedness
+        #: observable.
+        self.consumer_batch = consumer_batch
+        self.consumer_step_s = consumer_step_s
+        self.fault_schedule = fault_schedule
+        self.generation = generation
+        self.tree = aggregate.RollupTopology(num_workers, fanout=fanout)
+        self.seed = seed
+        self.timeout_s = timeout_s
+        self.kv = coordination._LocalService()
+        from distributed_tensorflow_tpu.input import data_service as _ds
+        from distributed_tensorflow_tpu.input.dataset import Dataset
+        from distributed_tensorflow_tpu.input.split_provider import (
+            SplitProvider,
+        )
+        self._ds = _ds
+        self.cfg = _ds.DataServiceConfig(
+            job=f"sim{seed}", lease_timeout_s=lease_timeout_s,
+            poll_interval_s=poll_interval_s,
+            hb_shard_size=hb_shard_size, fetch_timeout_s=timeout_s)
+        work = self.work_s
+
+        def reader(path):
+            idx = int(path.rsplit(":", 1)[1])
+            if work:
+                time.sleep(work)           # the offloaded decode/IO
+            for j in range(self.elements_per_split):
+                yield idx * 1_000_000 + j
+
+        files = [f"sim://split:{i}" for i in range(num_splits)]
+        self.provider = SplitProvider(
+            files, lambda subset: Dataset.from_files(subset, reader),
+            seed=seed)
+
+    def expected_multiset(self) -> "list[int]":
+        return sorted(s * 1_000_000 + j
+                      for s in range(self.num_splits)
+                      for j in range(self.elements_per_split))
+
+    def _agent(self, pid: int) -> SimAgent:
+        return SimAgent(self.kv, pid, self.num_workers)
+
+    def run(self) -> DataFleetReport:
+        n = self.num_workers
+        report = DataFleetReport(
+            num_workers=n, num_splits=self.num_splits,
+            epochs=self.epochs, wall_s=0.0, completed=False,
+            expected_elements=(self.num_splits
+                               * self.elements_per_split * self.epochs))
+        regs = [_registry.MetricsRegistry() for _ in range(n)]
+        workers = []
+        stop = threading.Event()
+        died: dict[int, str] = {}
+        died_lock = threading.Lock()
+
+        def worker_main(wid: int):
+            with elastic.generation_override(self.generation):
+                iw = self._ds.DataInputWorker(
+                    self._agent(wid), self.provider, self.cfg,
+                    worker_id=wid, num_workers=n, epochs=self.epochs,
+                    reg=regs[wid])
+                workers.append(iw)
+                beats = [0]
+                orig_beat = iw.pub.beat
+
+                def beat_and_publish(step):
+                    orig_beat(step)
+                    beats[0] += 1
+                    if beats[0] % 5 == 0:
+                        aggregate.publish_snapshot(
+                            iw.agent, regs[wid], process_id=wid,
+                            seq=beats[0])
+                        aggregate.run_duties(iw.agent, self.tree, wid)
+                iw.pub.beat = beat_and_publish
+                try:
+                    iw.run(stop)
+                    # final partial so short runs reach the root
+                    aggregate.publish_snapshot(iw.agent, regs[wid],
+                                               process_id=wid,
+                                               seq=beats[0] + 1)
+                    aggregate.run_duties(iw.agent, self.tree, wid)
+                except faults.FaultInjected as e:
+                    with died_lock:
+                        died[wid] = str(e)
+                except coordination.CoordinationError:
+                    with died_lock:
+                        died[wid] = "coordination error"
+
+        disp_holder: dict = {}
+
+        def dispatcher_main():
+            with elastic.generation_override(self.generation):
+                disp = self._ds.DataServiceDispatcher(
+                    self._agent(n), self.provider, self.cfg,
+                    num_workers=n, epochs=self.epochs)
+                disp_holder["disp"] = disp
+                while not stop.is_set():
+                    try:
+                        if not disp.tick():
+                            return
+                    except faults.FaultInjected:
+                        pass            # injected dispatch failure:
+                    time.sleep(self.cfg.poll_interval_s)  # next tick
+
+        schedule_cm = (faults.inject(self.fault_schedule)
+                       if self.fault_schedule is not None
+                       else contextlib.nullcontext())
+        t0 = time.time()
+        with schedule_cm as registry:
+            threads = [threading.Thread(target=worker_main, args=(w,),
+                                        daemon=True,
+                                        name=f"data-worker-{w}")
+                       for w in range(n)]
+            dt_thread = threading.Thread(target=dispatcher_main,
+                                         daemon=True,
+                                         name="data-dispatcher")
+            for t in threads:
+                t.start()
+            dt_thread.start()
+            client = None
+            try:
+                with elastic.generation_override(self.generation):
+                    client = self._ds.DataServiceClient(
+                        self._agent(n + 1), self.cfg)
+                    for e in range(self.epochs):
+                        got = []
+                        in_batch = 0
+                        for el in client.epoch(e):
+                            got.append(el)
+                            in_batch += 1
+                            if self.consumer_batch and \
+                                    in_batch >= self.consumer_batch:
+                                time.sleep(self.consumer_step_s)
+                                in_batch = 0
+                        report.epoch_multisets.append(sorted(got))
+                    report.completed = True
+            except Exception as exc:              # noqa: BLE001
+                report.error = f"{type(exc).__name__}: {exc}"
+            finally:
+                with elastic.generation_override(self.generation):
+                    self._ds.signal_shutdown(self._agent(n + 1),
+                                             self.cfg)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=5.0)
+                dt_thread.join(timeout=5.0)
+            report.faults_fired = [
+                {"site": s, "tag": t_, "hit": h, "action": a}
+                for s, t_, h, a, _ in (registry.events()
+                                       if registry is not None else [])]
+        report.wall_s = round(time.time() - t0, 3)
+
+        expected = self.expected_multiset()
+        delivered = 0
+        dup = miss = 0
+        for got in report.epoch_multisets:
+            delivered += len(got)
+            from collections import Counter
+            ce, cg = Counter(expected), Counter(got)
+            dup += sum((cg - ce).values())
+            miss += sum((ce - cg).values())
+        report.elements_delivered = delivered
+        report.duplicate_elements = dup
+        report.missing_elements = miss
+        if client is not None:
+            report.fetch_wait_s = round(client.total_wait_s, 4)
+        report.elements_per_sec = round(
+            delivered / max(report.wall_s, 1e-6), 1)
+        disp = disp_holder.get("disp")
+        if disp is not None:
+            report.splits_reassigned = disp.splits_reassigned
+        report.workers_died = sorted(died)
+        report.splits_per_worker = {
+            iw.worker_id: iw.splits_processed for iw in workers}
+        # settle sweep (the FleetSim discipline): propagate the final
+        # partials to the root deterministically before collecting
+        settle_agent = self._agent(n + 2)
+        with elastic.generation_override(self.generation):
+            for _ in range(self.tree.depth):
+                for pid in range(n):
+                    aggregate.run_duties(settle_agent, self.tree, pid)
+            rollup = aggregate.collect_rollup_tree(settle_agent,
+                                                   self.tree)
+        workers_seen = rollup.get("workers") or {}
+        report.rollup_workers_seen = len(workers_seen)
+        splits_metric = (rollup.get("metrics") or {}).get(
+            "data/splits_processed")
+        if isinstance(splits_metric, dict) and \
+                isinstance(splits_metric.get("sum"), (int, float)):
+            report.rollup_splits_processed = int(splits_metric["sum"])
+        return report

@@ -16,6 +16,13 @@ data-parallel with periodic checkpoints; if one dies (try
 cluster under a fresh generation, and the job resumes from the last
 intact checkpoint. Render the run with ``tools/obs_report.py
 <telemetry-dir>`` to see the recovery timeline.
+
+``--data-service`` runs the DISAGGREGATED-INPUT topology (ISSUE 12):
+task 0 trains and dispatches FILE splits, tasks 1..M are input
+workers executing the registered pipeline under heartbeat-backed
+leases over the coordination KV; ``--kill-seed`` SIGKILLs input
+workers mid-epoch and the epoch's exactly-once split delivery must
+survive (gated by ``tools/chaos_sweep.py --data``).
 """
 
 import argparse
@@ -28,6 +35,217 @@ if _REPO not in sys.path:
 
 #: deterministic synthetic sample pool shared by every worker/generation
 _POOL = 512
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated data service (ISSUE 12): task 0 = trainer + dispatcher,
+# tasks 1..M = input workers, all under one recovery supervisor
+# ---------------------------------------------------------------------------
+
+def _npz_reader(path):
+    """Per-file reader of the split files ``write_mnist_split_files``
+    lays down — module-level so the split pipeline factory pickles by
+    reference into input-worker processes."""
+    import numpy as np
+    with np.load(path) as z:
+        images, labels = z["image"], z["label"]
+    for i in range(len(labels)):
+        yield {"image": images[i], "label": labels[i]}
+
+
+def mnist_split_pipeline(files):
+    """The registered per-split pipeline (SplitProvider.from_factory):
+    unbatched examples; the trainer batches (batch composition follows
+    split-completion order, the element MULTISET is deterministic)."""
+    from distributed_tensorflow_tpu.input.dataset import Dataset
+    return Dataset.from_files(list(files), _npz_reader)
+
+
+def write_mnist_split_files(data_dir, num_files, pool=_POOL):
+    """Shard the deterministic synthetic pool into FILE splits."""
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.mnist_cnn import synthetic_data
+    data = synthetic_data(pool)
+    per = pool // num_files
+    os.makedirs(data_dir, exist_ok=True)
+    files = []
+    for i in range(num_files):
+        path = os.path.join(data_dir, f"mnist-{i:03d}.npz")
+        sl = slice(i * per, (i + 1) * per)
+        np.savez(path, image=data["image"][sl], label=data["label"][sl])
+        files.append(path)
+    return files
+
+
+def seeded_input_kill_plan(seed, input_workers, *, kills=1,
+                           step_range=(1, 3)):
+    """Seed-derived SIGKILLs of INPUT-WORKER tasks (cluster task ids
+    1..M; task 0 is the trainer): fire once the victim's heartbeat
+    reports >= after_step splits processed — mid-epoch by
+    construction."""
+    import random as _random
+
+    from distributed_tensorflow_tpu.resilience import KillSpec
+    rng = _random.Random(f"dtx-data-kill:{seed}")
+    victims = rng.sample(range(input_workers),
+                         k=min(kills, input_workers))
+    return [KillSpec(worker=1 + v, after_step=rng.randrange(*step_range))
+            for v in victims]
+
+
+def data_service_worker(data_dir, ckpt_dir, epochs, global_batch, lr,
+                        input_workers):
+    """One generation of one data-service cluster task. Task 0 is the
+    trainer (plus the split dispatcher); tasks 1..M are input workers
+    executing the registered pipeline over leased FILE splits. All KV
+    traffic is generation-namespaced, so a supervisor reform fences
+    every straggler of the dead incarnation."""
+    import glob as _glob
+
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+    from distributed_tensorflow_tpu.cluster.coordination import (
+        CoordinationError, coordination_service)
+    from distributed_tensorflow_tpu.input import data_service as dsvc
+    from distributed_tensorflow_tpu.input.split_provider import (
+        SplitProvider)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    runtime = bootstrap.initialize()
+    if runtime.num_processes > 1:
+        # The CPU test backend's gloo client creation is COLLECTIVE:
+        # every process of the distributed runtime must initialize its
+        # backend or the ones that do (the trainer's first jit) block
+        # forever in make_cpu_client waiting for the rest. Input
+        # workers never run a jax computation, so touch the backend
+        # explicitly.
+        import jax
+        jax.local_devices()
+    tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tdir:
+        tv_events.configure(tdir, process_id=runtime.process_id)
+    agent = coordination_service()
+    files = sorted(_glob.glob(os.path.join(data_dir, "*.npz")))
+    provider = SplitProvider.from_factory(files, mnist_split_pipeline,
+                                          seed=0)
+    cfg = dsvc.DataServiceConfig(job="mnist", lease_timeout_s=1.0,
+                                 fetch_timeout_s=60.0)
+    if runtime.process_id == 0:
+        return _data_service_trainer(
+            runtime, agent, provider, cfg, ckpt_dir, epochs,
+            global_batch, lr, input_workers)
+    wid = runtime.process_id - 1
+    worker = dsvc.DataInputWorker(
+        agent, provider, cfg, worker_id=wid,
+        num_workers=input_workers, epochs=epochs,
+        heartbeat_fn=elastic.heartbeat)
+    try:
+        worker.run()
+    except CoordinationError:
+        pass          # coordinator torn down at job end: released
+    bootstrap.shutdown()
+    return ("input_worker", wid, worker.splits_processed)
+
+
+def _data_service_trainer(runtime, agent, provider, cfg, ckpt_dir,
+                          epochs, global_batch, lr, input_workers):
+    import time as _time
+
+    import jax
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, CheckpointManager)
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+    from distributed_tensorflow_tpu.input import data_service as dsvc
+    from distributed_tensorflow_tpu.models.mnist_cnn import (
+        create_train_state)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    from distributed_tensorflow_tpu.telemetry import goodput
+
+    ledger = goodput.GoodputLedger()
+    goodput.activate(ledger)
+    dispatcher = dsvc.DataServiceDispatcher(
+        agent, provider, cfg, num_workers=input_workers, epochs=epochs)
+    dispatcher.start()
+    client = dsvc.DataServiceClient(
+        agent, cfg, heartbeat_fn=lambda _s: elastic.heartbeat())
+
+    state, model, tx = create_train_state(jax.random.PRNGKey(0),
+                                          learning_rate=lr)
+    params, opt_state = state["params"], state["opt_state"]
+
+    def loss_fn(p, images, labels):
+        logits = model.apply({"params": p}, images)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def apply_fn(p, o, grads):
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o
+
+    leaves, treedef = jax.tree_util.tree_flatten((params, opt_state))
+    # single_writer: the trainer alone owns the model state — the
+    # input workers are cluster members but never checkpoint, so the
+    # SPMD commit barrier would block for its full timeout every save
+    ckpt = Checkpoint(single_writer=True, leaves=list(leaves))
+    mgr = CheckpointManager(ckpt, ckpt_dir, checkpoint_name="dsvc")
+    start_epoch = 0
+    res = mgr.restore_latest()
+    if res is not None:
+        tier, start_epoch, restored = res
+        params, opt_state = jax.tree_util.tree_unflatten(
+            treedef, [restored[f"leaves/{i}"]
+                      for i in range(len(leaves))])
+        print(f"[gen {runtime.generation}] trainer resumed at epoch "
+              f"{start_epoch} from the {tier} tier")
+
+    loss = float("nan")
+    step = 0
+    last_wait = client.total_wait_s
+    for epoch in range(start_epoch, epochs):
+        batch_buf = []
+        for el in client.epoch(epoch):
+            batch_buf.append(el)
+            if len(batch_buf) < global_batch:
+                continue
+            t0 = _time.perf_counter()
+            images = np.stack([b["image"] for b in batch_buf])
+            labels = np.stack([b["label"] for b in batch_buf])
+            batch_buf = []
+            loss, grads = grad_fn(params, images, labels)
+            loss = float(loss)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            jax.block_until_ready(params)
+            dur_s = _time.perf_counter() - t0
+            # fetch-wait accrued since the previous step prices into
+            # the infeed_wait badput bucket (event-walk AND live paths)
+            wait_s = client.total_wait_s - last_wait
+            last_wait = client.total_wait_s
+            elastic.heartbeat(step)
+            tv_events.event("train.step", step=step, loss=loss,
+                            dur_s=round(dur_s + wait_s, 6),
+                            infeed_wait_s=round(wait_s, 6))
+            ledger.step_completed(dur_s + wait_s, infeed_s=wait_s)
+            step += 1
+        refresh = jax.tree_util.tree_flatten((params, opt_state))[0]
+        ckpt._objects["leaves"] = list(refresh)
+        ledger.enter("ckpt_block")
+        mgr.save(checkpoint_number=epoch + 1)
+        ledger.enter("idle")
+        print(f"[gen {runtime.generation}] epoch {epoch} done: "
+              f"loss={loss:.4f} fetch_wait={client.total_wait_s:.2f}s "
+              f"reassigned={dispatcher.splits_reassigned}")
+    dsvc.signal_shutdown(agent, cfg)
+    dsvc.await_shutdown_acks(agent, cfg, input_workers)
+    dispatcher.stop()
+    ckpt.sync()
+    bootstrap.shutdown()
+    return (0, start_epoch, loss)
 
 
 def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
@@ -211,6 +429,40 @@ def run_elastic(args):
               f"{args.telemetry_dir}")
 
 
+def run_data_service(args):
+    import tempfile
+
+    from distributed_tensorflow_tpu.resilience import RecoverySupervisor
+
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="mnist_dsvc_")
+    data_dir = os.path.join(ckpt_dir, "splits")
+    files = write_mnist_split_files(data_dir, args.split_files)
+    kill_plan = ()
+    if args.kill_seed is not None:
+        kill_plan = seeded_input_kill_plan(
+            args.kill_seed, args.input_workers, kills=args.kills)
+        print(f"input-worker kill plan (seed {args.kill_seed}): "
+              f"{kill_plan}")
+    sup = RecoverySupervisor(
+        data_service_worker,
+        num_workers=1 + args.input_workers,
+        args=(data_dir, ckpt_dir, args.epochs, args.global_batch,
+              args.lr, args.input_workers),
+        max_restarts=args.restart_budget, kill_plan=kill_plan,
+        generation_timeout_s=args.generation_timeout,
+        telemetry_dir=args.telemetry_dir)
+    result = sup.run()
+    for value in sorted(result.return_values, key=str):
+        print(f"task result: {value}")
+    print(f"done: {len(files)} splits x {args.epochs} epochs over "
+          f"{args.input_workers} input worker(s), "
+          f"{sup.restarts_used} restart(s), "
+          f"final generation {sup.generation}")
+    if args.telemetry_dir:
+        print(f"recovery timeline: python tools/obs_report.py "
+              f"{args.telemetry_dir}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=100)
@@ -259,8 +511,25 @@ def main():
                     help="elastic: disable host/peer snapshot tiers")
     ap.add_argument("--generation-timeout", type=float, default=600.0,
                     help="elastic: per-generation wall budget (s)")
+    ap.add_argument("--data-service", action="store_true",
+                    help="run with a disaggregated input service under "
+                         "the recovery supervisor: task 0 trains (and "
+                         "dispatches FILE splits), tasks 1..M execute "
+                         "the input pipeline under heartbeat-backed "
+                         "leases (--kill-seed SIGKILLs input workers)")
+    ap.add_argument("--input-workers", type=int, default=2,
+                    help="data-service: input-worker tasks")
+    ap.add_argument("--epochs", type=int, default=2,
+                    help="data-service: epochs (each = one exactly-once "
+                         "pass over every FILE split)")
+    ap.add_argument("--split-files", type=int, default=8,
+                    help="data-service: FILE splits the sample pool is "
+                         "sharded into")
     args = ap.parse_args()
 
+    if args.data_service:
+        run_data_service(args)
+        return
     if args.elastic:
         run_elastic(args)
         return

@@ -243,3 +243,34 @@ def test_legacy_increment_cold_start_seeds_probe_hint(monkeypatch):
     fake.rpcs = 0
     assert agent.key_value_increment("ctr") == 202
     assert fake.rpcs <= 3, fake.rpcs
+
+
+def test_legacy_increment_republishes_over_stale_value(monkeypatch):
+    """Lost-update hardening: the best-effort value-key publish can be
+    overwritten by a SLOWER peer's smaller value landing late (the
+    2-process barrier/increment flake). The verify-read after our
+    publish must detect the stale smaller value and re-assert ours."""
+    fake = _FakeLegacyClient()
+    # one increment already claimed by a slow peer that has not
+    # finished publishing
+    fake.kv["ctr/__c__/1"] = b"1"
+    fake.kv["ctr"] = b"1"
+    reads = {"ctr": 0}
+    orig_get = fake.blocking_key_value_get
+
+    def get(key, wait_ms):
+        if key == "ctr":
+            reads["ctr"] += 1
+            if reads["ctr"] == 2:
+                # the verify read races the slow peer's stale publish:
+                # its value-1 write lands right before we look
+                fake.kv["ctr"] = b"1"
+        return orig_get(key, wait_ms)
+
+    fake.blocking_key_value_get = get
+    agent = CoordinationServiceAgent()
+    monkeypatch.setattr(type(agent), "_client", property(lambda s: fake))
+    assert agent._is_legacy(fake)
+    assert agent.key_value_increment("ctr") == 2
+    # the stale 1 was overwritten by the re-publish
+    assert fake.kv["ctr"] == b"2"

@@ -1,0 +1,357 @@
+"""Disaggregated data service (ISSUE 12): FILE split provider,
+heartbeat-backed leases, exactly-once delivery under input-worker
+churn and trainer reform."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster import coordination, elastic
+from distributed_tensorflow_tpu.input import data_service as dsvc
+from distributed_tensorflow_tpu.input.dataset import Dataset
+from distributed_tensorflow_tpu.input.split_provider import SplitProvider
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.testing import fleet_sim
+
+
+def _file_provider(tmp_path, n_files=4, per_file=3, seed=3):
+    files = []
+    for i in range(n_files):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text("\n".join(str(i * 10 + j) for j in range(per_file)))
+        files.append(str(p))
+
+    def reader(path):
+        with open(path) as f:
+            for line in f:
+                yield int(line)
+
+    ds = Dataset.from_files(files, reader).map(lambda x: x + 100)
+    return SplitProvider.from_dataset(ds, seed=seed), files
+
+
+# ---------------------------------------------------------------------------
+# Split provider
+# ---------------------------------------------------------------------------
+
+def test_split_provider_replays_recorded_chain(tmp_path):
+    provider, _files = _file_provider(tmp_path)
+    assert provider.num_splits == 4
+    # per-split rebuild == the chain over exactly that file
+    for i in range(4):
+        assert provider.elements(i) == [i * 10 + j + 100
+                                        for j in range(3)]
+
+
+def test_split_provider_epoch_order_deterministic(tmp_path):
+    p1, files = _file_provider(tmp_path, n_files=8)
+    p2 = SplitProvider.from_factory(
+        files, lambda fs: Dataset.from_iterable(list(fs)), seed=3)
+    for epoch in (0, 1, 7):
+        order = p1.epoch_order(epoch)
+        assert sorted(order) == list(range(8))     # a permutation
+        assert order == p2.epoch_order(epoch)      # seed-pure
+    assert p1.epoch_order(0) != p1.epoch_order(1)  # epoch-keyed
+
+
+def test_split_provider_rejects_non_file_pipelines(tmp_path):
+    with pytest.raises(ValueError, match=">= 1 file"):
+        SplitProvider([], lambda fs: None)
+    gen_rooted = Dataset.from_generator(lambda: iter(range(3)))
+    with pytest.raises(ValueError, match="file source"):
+        SplitProvider.from_dataset(gen_rooted)
+    provider, _ = _file_provider(tmp_path)
+    with pytest.raises(ValueError, match="out of range"):
+        provider.build(99)
+
+
+# ---------------------------------------------------------------------------
+# Protocol units (real classes over one in-memory KV)
+# ---------------------------------------------------------------------------
+
+def _run_service(provider, *, num_workers, epochs=1, cfg=None):
+    """Dispatcher + worker threads + client over one _LocalService;
+    returns (sorted elements per epoch, dispatcher, workers)."""
+    cfg = cfg or dsvc.DataServiceConfig(job="t", lease_timeout_s=0.4,
+                                        poll_interval_s=0.01,
+                                        fetch_timeout_s=20.0)
+    service = coordination._LocalService()
+    agents = [fleet_sim.SimAgent(service, p, num_workers + 2)
+              for p in range(num_workers + 2)]
+    disp = dsvc.DataServiceDispatcher(agents[-1], provider, cfg,
+                                      num_workers=num_workers,
+                                      epochs=epochs)
+    stop = threading.Event()
+    workers, threads = [], []
+    for w in range(num_workers):
+        iw = dsvc.DataInputWorker(agents[w], provider, cfg,
+                                  worker_id=w, num_workers=num_workers,
+                                  epochs=epochs)
+        workers.append(iw)
+
+        def run(iw=iw):
+            try:
+                iw.run(stop)
+            except faults.FaultInjected:
+                pass                     # simulated worker death
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        threads.append(t)
+    disp.start()
+    client = dsvc.DataServiceClient(agents[-2], cfg)
+    try:
+        per_epoch = [sorted(client.epoch(e)) for e in range(epochs)]
+    finally:
+        dsvc.signal_shutdown(agents[-2], cfg)
+        stop.set()
+        disp.stop()
+        for t in threads:
+            t.join(timeout=5.0)
+    return per_epoch, disp, workers, client
+
+
+def test_done_record_is_write_once(tmp_path):
+    """Two workers completing the SAME split (a re-issued lease both
+    sides finished) produce ONE done record — the first claim wins and
+    the loser's attempt is silently discarded."""
+    provider, _ = _file_provider(tmp_path)
+    cfg = dsvc.DataServiceConfig(job="race")
+    service = coordination._LocalService()
+    a0, a1, a2 = (fleet_sim.SimAgent(service, p, 3) for p in range(3))
+    dsvc.register_job(a0, cfg, provider, epochs=1, num_workers=2)
+    w0 = dsvc.DataInputWorker(a0, provider, cfg, worker_id=0,
+                              num_workers=2, epochs=1)
+    w1 = dsvc.DataInputWorker(a1, provider, cfg, worker_id=1,
+                              num_workers=2, epochs=1)
+    w0._process(0, 2)
+    w1._process(0, 2)                     # loses the claim race
+    assert w0.splits_processed == 1
+    assert w1.splits_processed == 0       # loser does not count it
+    import json
+    rec = json.loads(a2.key_value_try_get(
+        dsvc._done_key(cfg, 0, 2)).decode())
+    assert rec["worker"] == 0
+
+
+def test_service_delivers_full_epoch(tmp_path):
+    """Steady state over the real protocol classes: one epoch, every
+    element delivered exactly once (the dead-worker cases are the
+    chaos scenarios below)."""
+    provider, _ = _file_provider(tmp_path)
+    per_epoch, _disp, _workers, _c = _run_service(provider,
+                                                  num_workers=2)
+    assert per_epoch[0] == sorted(provider.elements(i)[j]
+                                  for i in range(4) for j in range(3))
+
+
+def test_client_retries_injected_fetch_faults(tmp_path):
+    """A transient data.fetch failure is retried under the client's
+    decorrelated RetryPolicy — delivery still exactly-once."""
+    provider, _ = _file_provider(tmp_path)
+    schedule = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="data.fetch", hits=(1, 3)),), seed=0)
+    with faults.inject(schedule) as reg:
+        per_epoch, _d, _w, _c = _run_service(provider, num_workers=2)
+    expected = sorted(x for i in range(4)
+                      for x in provider.elements(i))
+    assert per_epoch[0] == expected
+    assert any(site == "data.fetch" for site, *_ in reg.events())
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once property: the consumed multiset per epoch is IDENTICAL
+# across {no faults, worker killed mid-epoch, worker killed holding an
+# unstarted lease, trainer reform mid-epoch}
+# ---------------------------------------------------------------------------
+
+_N_WORKERS, _N_SPLITS, _EPOCHS = 4, 10, 2
+
+
+def _sim(fault_schedule=None, generation=0, seed=11):
+    return fleet_sim.DataServiceSim(
+        _N_WORKERS, _N_SPLITS, epochs=_EPOCHS, elements_per_split=3,
+        lease_timeout_s=0.3, fault_schedule=fault_schedule,
+        generation=generation, seed=seed)
+
+
+def test_exactly_once_no_faults():
+    sim = _sim()
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert rep.epoch_multisets == [sim.expected_multiset()] * _EPOCHS
+    # balanced-ish split distribution across the live fleet
+    assert set(rep.splits_per_worker) == set(range(_N_WORKERS))
+
+
+def test_exactly_once_worker_killed_mid_epoch():
+    # victim dies on its SECOND split-processing attempt: it completed
+    # work this epoch, then died holding a started lease
+    schedule = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="data.worker_step", action="raise",
+                         tag="1", hits=(2,)),), seed=1)
+    sim = _sim(schedule)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.workers_died == [1]
+    assert rep.splits_reassigned >= 1
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert rep.epoch_multisets == [sim.expected_multiset()] * _EPOCHS
+
+
+def test_exactly_once_worker_killed_holding_unstarted_lease():
+    # victim dies on its FIRST attempt: leases issued, nothing done
+    schedule = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="data.worker_step", action="raise",
+                         tag="2", hits=(1,)),), seed=2)
+    sim = _sim(schedule)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.workers_died == [2]
+    assert rep.splits_reassigned >= 1
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert rep.epoch_multisets == [sim.expected_multiset()] * _EPOCHS
+
+
+def test_exactly_once_worker_stalled_past_lease_budget():
+    # a STALL (not a death) past the lease budget also forfeits the
+    # lease; the stalled worker's late completion loses the done race
+    schedule = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="data.worker_step", action="delay",
+                         delay_s=1.2, tag="0", hits=(2,)),), seed=4)
+    sim = _sim(schedule)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.workers_died == []          # stalled, not dead
+    assert rep.splits_reassigned >= 1
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert rep.epoch_multisets == [sim.expected_multiset()] * _EPOCHS
+
+
+def test_exactly_once_trainer_reform_mid_epoch():
+    """Generation fencing: a trainer reform mid-epoch abandons gen-1's
+    half-delivered epoch; the gen-2 redelivery is complete and exact —
+    no contamination from the dead generation's keys, and a gen-1
+    straggler worker's late writes stay invisible to gen 2."""
+    service = coordination._LocalService()
+
+    def run_gen(gen, *, abandon_after=None, straggler_holdover=None):
+        sim = _sim(generation=gen)
+        sim.kv = service                  # SHARED service across gens
+        if abandon_after is None:
+            rep = sim.run()
+            return sim, rep
+        # gen-1 pass: consume only part of epoch 0, then walk away
+        # (the reform kills the consumer mid-epoch)
+        with elastic.generation_override(gen):
+            stop = threading.Event()
+            workers = []
+            for w in range(_N_WORKERS):
+                iw = dsvc.DataInputWorker(
+                    sim._agent(w), sim.provider, sim.cfg, worker_id=w,
+                    num_workers=_N_WORKERS, epochs=_EPOCHS)
+                t = threading.Thread(target=iw.run, args=(stop,),
+                                     daemon=True)
+                t.start()
+                workers.append(t)
+            disp = dsvc.DataServiceDispatcher(
+                sim._agent(_N_WORKERS), sim.provider, sim.cfg,
+                num_workers=_N_WORKERS, epochs=_EPOCHS)
+            disp.start()
+            client = dsvc.DataServiceClient(
+                sim._agent(_N_WORKERS + 1), sim.cfg)
+            got = []
+            for el in client.epoch(0):
+                got.append(el)
+                if len(got) >= abandon_after:
+                    break                  # reform: consumer dies here
+            disp.stop()
+            stop.set()
+            for t in workers:
+                t.join(timeout=5.0)
+            assert 0 < len(got) < _N_SPLITS * 3
+        return sim, got
+
+    _sim1, partial = run_gen(1, abandon_after=4)
+    sim2, rep2 = run_gen(2)
+    assert rep2.completed, rep2.error
+    assert rep2.duplicate_elements == 0 and rep2.missing_elements == 0
+    assert rep2.epoch_multisets == [sim2.expected_multiset()] * _EPOCHS
+    # the dead generation's namespace still holds its keys, disjoint
+    # from gen 2's (the lifecycle GC's job to sweep, not ours)
+    with elastic.generation_override(1):
+        agent = fleet_sim.SimAgent(service, 99, _N_WORKERS)
+        assert agent.key_value_try_get(
+            dsvc._spec_key(sim2.cfg)) is not None
+
+
+@pytest.mark.slow
+def test_exactly_once_hundred_workers_seeded_kills():
+    """The tentpole's O(100) mode: 100 simulated input workers, seeded
+    kill schedule, exactly-once delivery and tree-rollup visibility."""
+    # at 150 splits / 100 workers each worker only sees ~1-2 leases:
+    # pin every victim's death to its FIRST attempt so all three kills
+    # actually fire
+    schedule = fleet_sim.seeded_data_kill_schedule(
+        7, 100, kills=3, attempt_range=(1, 2))
+    sim = fleet_sim.DataServiceSim(
+        100, 150, epochs=1, elements_per_split=2,
+        lease_timeout_s=0.5, fault_schedule=schedule, seed=7,
+        timeout_s=120.0)
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert len(rep.workers_died) == 3
+    assert rep.splits_reassigned >= 3
+    assert rep.rollup_workers_seen >= 90   # dead workers stop publishing
+    assert rep.rollup_splits_processed == 150
+
+
+# ---------------------------------------------------------------------------
+# Fetch-wait lands in the goodput ledger (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_fetch_wait_priced_as_infeed_badput(tmp_path):
+    """Live path: the trainer feeds its fetch-wait into the ledger;
+    event-walk path: a data-service run's train.step events carry
+    infeed_wait_s and the wall == goodput + Σ badput identity holds."""
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    from distributed_tensorflow_tpu.telemetry import goodput
+
+    provider, _ = _file_provider(tmp_path, n_files=4, per_file=3)
+    run_dir = tmp_path / "tel"
+    tv_events.configure(str(run_dir), process_id=0)
+    ledger = goodput.GoodputLedger(register=False)
+    try:
+        per_epoch, _d, _w, client = _run_service(provider,
+                                                 num_workers=2)
+        # a mini trainer step-loop over the delivered elements
+        batch, step = [], 0
+        last_wait = 0.0
+        for el in per_epoch[0]:
+            batch.append(el)
+            if len(batch) < 6:
+                continue
+            wait = client.total_wait_s - last_wait
+            last_wait = client.total_wait_s
+            dur = 0.002 + wait
+            time.sleep(0.002)
+            tv_events.event("train.step", step=step,
+                            dur_s=round(dur, 6),
+                            infeed_wait_s=round(wait, 6))
+            ledger.step_completed(dur, infeed_s=wait)
+            batch, step = [], step + 1
+    finally:
+        tv_events.shutdown()
+    assert client.total_wait_s > 0          # the service made us wait
+    # live ledger: identity + infeed priced
+    snap = ledger.snapshot()
+    attributed = snap["goodput_s"] + sum(snap["badput_s"].values())
+    assert attributed == pytest.approx(snap["wall_s"], rel=0.02)
+    assert snap["badput_s"]["infeed_wait"] > 0
+    # event-walk ledger over the run dir: same identity, same bucket
+    walked = goodput.ledger_from_run(str(run_dir))
+    assert abs(walked["identity_error_s"]) <= 0.01 * walked["wall_s"]
+    assert walked["badput_s"]["infeed_wait"] > 0

@@ -48,6 +48,31 @@ def test_shard_data_policy():
     assert list(ds) == [1, 5, 9]
 
 
+def test_shard_validates_arguments():
+    """ISSUE 12 satellite: islice-backed shard would silently yield
+    nothing (index >= num_shards) or raise deep inside itertools
+    (negative index) — both must be loud ValueErrors instead."""
+    ds = Dataset.range(10)
+    for num_shards, index in ((0, 0), (-2, 0)):
+        with pytest.raises(ValueError, match="num_shards"):
+            ds.shard(num_shards, index)
+    for index in (-1, 4, 99):
+        with pytest.raises(ValueError, match="out of range"):
+            ds.shard(4, index)
+    # boundary indices stay valid
+    assert list(ds.shard(4, 0)) == [0, 4, 8]
+    assert list(ds.shard(4, 3)) == [3, 7]
+
+
+def test_shard_files_validates_num_shards(tmp_path):
+    f = tmp_path / "only.txt"
+    f.write_text("")
+    ds = Dataset.from_files([str(f)], reader=lambda p: iter([1]))
+    for num_shards in (0, -1):
+        with pytest.raises(ValueError, match="num_shards"):
+            ds.shard_files(num_shards, 0)
+
+
 def test_shard_files_policy():
     files = [f"f{i}" for i in range(4)]
     ds = Dataset.from_files(files, reader=lambda f: iter([f + "_a", f + "_b"]))

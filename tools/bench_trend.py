@@ -28,6 +28,11 @@ Series:
   control-plane rows per worker count (bench.py --fleet /
   tools/fleet_sweep.py); detect/MTTR gate INVERTED (>10% growth in
   supervisor detect latency or recovery MTTR fails);
+- ``data/elements_per_sec/nNN`` + ``data/infeed_wait_frac/nNN`` /
+  ``data/splits_reassigned_per_kill/nNN`` — the ``DATA_r*.json``
+  disaggregated data-service rows per input-worker count (bench.py
+  --data-service); wait-frac and reassigned-per-kill gate INVERTED
+  (>10% growth fails);
 - goodput/badput columns (``bench/goodput_frac``,
   ``serving/goodput_frac``, ``serving/badput_replay_frac``,
   ``serving/slo_p99_budget_consumed`` — the last two inverted): present
@@ -191,6 +196,46 @@ def load_fleet_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     return series
 
 
+def load_data_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from DATA_r*.json (ISSUE 12): per
+    input-worker count, the data-service throughput series plus
+    infeed-wait-fraction and splits-reassigned-per-kill series carrying
+    ``lower_is_better`` so the regression gate inverts (a trainer that
+    starts WAITING more, or a kill that costs more re-issued leases,
+    fails)."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "DATA_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            extra = row.get("extra") or {}
+            n = extra.get("n_input_workers")
+            if not isinstance(n, int):
+                continue
+            key = f"n{n:02d}"
+            series.setdefault(f"data/elements_per_sec/{key}", {})[rnd] = {
+                "value": row.get("value"),
+                "unit": row.get("unit"),
+                "vs_inproc": row.get("vs_baseline"),
+            }
+            if isinstance(extra.get("infeed_wait_frac"), (int, float)):
+                series.setdefault(f"data/infeed_wait_frac/{key}",
+                                  {})[rnd] = {
+                    "value": extra["infeed_wait_frac"],
+                    "lower_is_better": True}
+            if isinstance(extra.get("splits_reassigned_per_kill"),
+                          (int, float)):
+                series.setdefault(
+                    f"data/splits_reassigned_per_kill/{key}", {})[rnd] = {
+                    "value": extra["splits_reassigned_per_kill"],
+                    "lower_is_better": True}
+    return series
+
+
 def check_regressions(series: "dict[str, dict[int, dict]]",
                       regression_frac: float) -> "list[str]":
     """Latest round of each series vs the BEST prior round: a drop past
@@ -279,6 +324,7 @@ def main(argv=None) -> int:
     series.update(load_scaling_history(args.repo))
     series.update(load_serving_history(args.repo))
     series.update(load_fleet_history(args.repo))
+    series.update(load_data_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

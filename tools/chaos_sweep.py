@@ -37,6 +37,17 @@ requests: the union of ``served-*.jsonl`` ids equals the full seeded
 request set, with any cross-generation duplicates having generated
 IDENTICAL tokens (deterministic re-serve).
 
+``--data`` sweeps the DISAGGREGATED-INPUT axis (ISSUE 12): each seed
+runs a supervised data-service mnist job (examples/train_mnist.py
+--data-service — task 0 trains and dispatches FILE splits, tasks 1..M
+are input workers under heartbeat-backed leases) with a seed-derived
+INPUT-WORKER SIGKILL schedule. A seed survives only when the job
+completes, the recovery timeline is recorded, AND the exactly-once
+split accounting holds: every epoch the trainer completed consumed
+each split exactly once (zero lost, zero duplicated — the
+``data.split_consumed`` records are the proof), with the goodput
+identity intact and the recovery priced.
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -51,6 +62,7 @@ Usage::
     python tools/chaos_sweep.py --kill --seeds 3      # SIGKILL sweep
     python tools/chaos_sweep.py --kill --shrink --workers 3 --seeds 3
     python tools/chaos_sweep.py --serve --seeds 3     # serving sweep
+    python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -233,6 +245,136 @@ def run_kill_seed(seed: int, *, workers: int, steps: int,
     return ok, dt
 
 
+def _split_accounting_gate(run_dir: str, num_splits: int,
+                           epochs: int, kills: int) -> "list[str]":
+    """Exactly-once split delivery under input-worker churn (ISSUE 12):
+    for every epoch the trainer COMPLETED (``data.epoch_consumed``),
+    its ``data.split_consumed`` records must cover split ids
+    0..num_splits-1 exactly once — zero lost, zero duplicated; the
+    union of completed (generation, epoch) pairs must cover every
+    configured epoch; and the supervisor must have recorded one
+    ``recovery.chaos_kill`` per scheduled kill plus >= 1 worker death.
+    Returns violation messages (empty = ok)."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    bad = []
+    consumed: dict = {}          # (gen, epoch) -> list of split ids
+    completed: set = set()       # (gen, epoch) the trainer finished
+    chaos_kills = 0
+    deaths = 0
+    for pid, events in read_run(run_dir).items():
+        for ev in events:
+            gen = ev.get("gen", 0)
+            name = ev.get("ev")
+            if name == "data.split_consumed":
+                consumed.setdefault((gen, ev.get("epoch")),
+                                    []).append(ev.get("split"))
+            elif name == "data.epoch_consumed":
+                completed.add((gen, ev.get("epoch")))
+            elif name == "recovery.chaos_kill":
+                chaos_kills += 1
+            elif name == "recovery.worker_death":
+                deaths += 1
+    if not completed:
+        return [f"no completed data-service epoch recorded under "
+                f"{run_dir}"]
+    expected = set(range(num_splits))
+    for key in sorted(completed):
+        splits = consumed.get(key, [])
+        dup = sorted({s for s in splits if splits.count(s) > 1})
+        missing = sorted(expected - set(splits))
+        extra = sorted(set(splits) - expected)
+        if dup:
+            bad.append(f"gen{key[0]} epoch {key[1]}: DUPLICATED "
+                       f"split(s) {dup[:8]}")
+        if missing:
+            bad.append(f"gen{key[0]} epoch {key[1]}: LOST split(s) "
+                       f"{missing[:8]}")
+        if extra:
+            bad.append(f"gen{key[0]} epoch {key[1]}: unknown split "
+                       f"id(s) {extra[:8]}")
+    done_epochs = {e for _, e in completed}
+    missing_epochs = sorted(set(range(epochs)) - done_epochs)
+    if missing_epochs:
+        bad.append(f"epoch(s) never completed in any generation: "
+                   f"{missing_epochs}")
+    if chaos_kills < kills:
+        bad.append(f"only {chaos_kills}/{kills} scheduled input-worker "
+                   f"kills were recorded (recovery.chaos_kill)")
+    if deaths < 1:
+        bad.append("no recovery.worker_death recorded for the kill")
+    return bad
+
+
+def run_data_seed(seed: int, *, input_workers: int, epochs: int,
+                  split_files: int, budget: int, kills: int,
+                  keep_dirs: bool,
+                  goodput_floor: "float | None" = None) \
+        -> tuple[bool, float]:
+    """One supervised data-service mnist run with a seed-derived
+    INPUT-WORKER SIGKILL schedule; survival = clean exit + recovery
+    telemetry + exactly-once split accounting on every completed epoch
+    + the goodput-ledger identity (recovery priced)."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_data_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "train_mnist.py"),
+           "--data-service", "--input-workers", str(input_workers),
+           "--epochs", str(epochs), "--split-files", str(split_files),
+           "--kill-seed", str(seed), "--kills", str(kills),
+           "--restart-budget", str(budget),
+           "--ckpt-dir", os.path.join(run_dir, "ckpt"),
+           "--telemetry-dir", run_dir]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if ok:
+        gate_cmd = [sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    run_dir, "--check",
+                    "--require", "recovery.restart",
+                    "--require", "recovery.run_complete",
+                    "--require", "data.split_consumed"]
+        gate = subprocess.run(gate_cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: run finished but telemetry gate "
+                  f"FAILED (rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
+    if ok:
+        violations = _split_accounting_gate(run_dir, split_files,
+                                            epochs, kills)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: exactly-once split accounting "
+                  f"FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _goodput_gate(run_dir, goodput_floor,
+                                   expect_recovery=True)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: goodput-ledger gate FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if not ok and proc.returncode != 0:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def _served_requests_gate(run_dir: str, n_requests: int,
                           serve_seed: int) -> "list[str]":
     """Zero dropped in-flight requests: the union of every replica's
@@ -351,6 +493,20 @@ def main(argv=None) -> int:
                          "mid-load: supervisor must restart the replica, "
                          "in-flight requests must be re-served (zero "
                          "dropped), recovery visible in obs_report")
+    ap.add_argument("--data", action="store_true",
+                    help="sweep seed-driven SIGKILLs of INPUT WORKERS "
+                         "through a supervised data-service mnist run: "
+                         "every completed epoch must show exactly-once "
+                         "split delivery (zero lost, zero duplicated) "
+                         "with the recovery visible in telemetry")
+    ap.add_argument("--input-workers", type=int, default=2,
+                    help="--data: input-worker tasks per run")
+    ap.add_argument("--epochs", type=int, default=2,
+                    help="--data: epochs per run")
+    ap.add_argument("--split-files", type=int, default=8,
+                    help="--data: FILE splits per epoch")
+    ap.add_argument("--kills", type=int, default=1,
+                    help="--data: scheduled input-worker kills per run")
     ap.add_argument("--requests", type=int, default=24,
                     help="--serve: seeded workload size per run")
     ap.add_argument("--shrink", action="store_true",
@@ -387,11 +543,19 @@ def main(argv=None) -> int:
         ap.error("--shrink requires --kill")
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
-    if args.serve and args.kill:
-        ap.error("--serve and --kill are separate sweep axes")
+    if sum(bool(x) for x in (args.serve, args.kill, args.data)) > 1:
+        ap.error("--kill, --serve and --data are separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.serve:
+        if args.data:
+            ok, dt = run_data_seed(s, input_workers=args.input_workers,
+                                   epochs=args.epochs,
+                                   split_files=args.split_files,
+                                   budget=args.restart_budget,
+                                   kills=args.kills,
+                                   keep_dirs=args.keep_dirs,
+                                   goodput_floor=args.goodput_floor)
+        elif args.serve:
             ok, dt = run_serve_seed(s, workers=args.workers,
                                     requests=args.requests,
                                     budget=args.restart_budget,

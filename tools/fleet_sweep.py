@@ -21,7 +21,14 @@ the fleet under the real RecoverySupervisor, and gate:
   middle generations (bounded KV size).
 
 ``--check`` additionally gates the checked-in FLEET_r*.json scaling
-curve (the bench.py --fleet output, latest round):
+curve (the bench.py --fleet output, latest round) AND the
+DATA_r*.json input-worker fleet curve (bench.py --data-service,
+ISSUE 12): steady + churn phases complete, exactly-once accounting
+clean under the seeded kill, the largest-N service row at or above
+the in-process pipeline with the trainer's infeed-wait fraction
+reduced, and every churn row showing >= 1 re-issued lease.
+
+FLEET_r*.json gates:
 
 - per-worker KV ops per step stay ~flat in N (sub-linearity: the
   max/min ratio across the N sweep is bounded);
@@ -102,9 +109,9 @@ def run_fleet_seed(seed: int, *, workers: int, steps: int,
 # FLEET_r*.json curve gates
 # ---------------------------------------------------------------------------
 
-def latest_fleet_round(repo: str = REPO) -> "tuple[int, list] | None":
+def _latest_round(repo: str, pattern: str) -> "tuple[int, list] | None":
     best = None
-    for path in sorted(glob.glob(os.path.join(repo, "FLEET_r*.json"))):
+    for path in sorted(glob.glob(os.path.join(repo, pattern))):
         m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
         rnd = int(m.group(1)) if m else -1
         try:
@@ -115,6 +122,67 @@ def latest_fleet_round(repo: str = REPO) -> "tuple[int, list] | None":
         if rows and (best is None or rnd > best[0]):
             best = (rnd, rows)
     return best
+
+
+def latest_fleet_round(repo: str = REPO) -> "tuple[int, list] | None":
+    return _latest_round(repo, "FLEET_r*.json")
+
+
+def latest_data_round(repo: str = REPO) -> "tuple[int, list] | None":
+    return _latest_round(repo, "DATA_r*.json")
+
+
+def check_data_curve(rows: list) -> "list[str]":
+    """Gate the input-worker fleet curve of DATA_r*.json (ISSUE 12).
+
+    - every steady phase completed; every churn phase (N >= 2)
+      completed with ZERO lost and ZERO duplicated elements — the
+      exactly-once contract is part of the throughput claim;
+    - the largest-N service row beats the in-process pipeline
+      (vs_baseline >= 1.0) AND cuts the trainer's infeed-wait
+      fraction below the in-process run's — the host-boundedness win
+      the service exists for;
+    - churn rows carry splits_reassigned_per_kill >= 1 (the lease
+      re-issue actually ran).
+    Returns violations (empty = ok)."""
+    bad = []
+    by_n = {}
+    for row in rows:
+        extra = row.get("extra") or {}
+        n = extra.get("n_input_workers")
+        if isinstance(n, int):
+            by_n[n] = (row, extra)
+    if not by_n:
+        return ["no data-service rows with n_input_workers found"]
+    for n in sorted(by_n):
+        row, extra = by_n[n]
+        if extra.get("steady_completed") is not True:
+            bad.append(f"row N={n}: steady phase did not complete")
+        if n >= 2:
+            if extra.get("churn_completed") is not True:
+                bad.append(f"row N={n}: churn phase did not complete")
+            for field in ("churn_duplicates", "churn_missing"):
+                if extra.get(field) not in (0,):
+                    bad.append(f"row N={n}: {field} = "
+                               f"{extra.get(field)!r} (exactly-once "
+                               f"violated under churn)")
+            r = extra.get("splits_reassigned_per_kill")
+            if not isinstance(r, int) or r < 1:
+                bad.append(f"row N={n}: splits_reassigned_per_kill = "
+                           f"{r!r} (the kill forced no lease re-issue)")
+    n_hi = max(by_n)
+    row, extra = by_n[n_hi]
+    vsb = row.get("vs_baseline")
+    if not isinstance(vsb, (int, float)) or vsb < 1.0:
+        bad.append(f"row N={n_hi}: service throughput is not >= the "
+                   f"in-process pipeline (vs_baseline={vsb!r})")
+    wf, base_wf = (extra.get("infeed_wait_frac"),
+                   extra.get("inproc_infeed_wait_frac"))
+    if not (isinstance(wf, (int, float))
+            and isinstance(base_wf, (int, float)) and wf < base_wf):
+        bad.append(f"row N={n_hi}: infeed_wait_frac {wf!r} not below "
+                   f"the in-process pipeline's {base_wf!r}")
+    return bad
 
 
 def check_curve(rows: list, *, flatness_max: float = 3.0,
@@ -209,6 +277,24 @@ def main(argv=None) -> int:
                             for r in rows)
                 print(f"fleet_sweep: curve gate OK on FLEET_r{rnd:02d} "
                       f"(N={ns})")
+        latest_data = latest_data_round(args.repo)
+        if latest_data is None:
+            print("fleet_sweep: no DATA_r*.json found to gate "
+                  "(input-worker fleet curve)", file=sys.stderr)
+            rc = 1
+        else:
+            rnd, rows = latest_data
+            violations = check_data_curve(rows)
+            if violations:
+                rc = 1
+                for v in violations:
+                    print(f"fleet_sweep: DATA GATE r{rnd:02d} — {v}",
+                          file=sys.stderr)
+            else:
+                ns = sorted((r.get("extra") or {}).get("n_input_workers")
+                            for r in rows)
+                print(f"fleet_sweep: data-service curve gate OK on "
+                      f"DATA_r{rnd:02d} (N={ns})")
 
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
