@@ -1127,6 +1127,105 @@ def run_data_service(out_path: str | None = None, *,
     return rows
 
 
+def run_autoscale(out_path: str | None = None, *, seed: int = 0,
+                  keep_dir: bool = False):
+    """Closed-loop autoscaling bench (ISSUE 13): one seeded traffic
+    spike through a real shared training+serving fleet
+    (examples/shared_fleet.py — fixed 3-worker budget, SLO-burn-driven
+    arbitration), measured from the run's own telemetry:
+
+    - ``autoscale_scale_up_latency_s`` — spike start → extra replica
+      spawning (burn detect + donate + reform), gated INVERTED by
+      tools/bench_trend.py (a slower loop regresses);
+    - ``autoscale_slo_recovery_s`` — scale-up → both burn windows back
+      under 1.0x and holding (inverted too);
+    - ``autoscale_goodput_frac`` — the serving job's whole-run goodput,
+      scale transitions priced in the ``scale_transition`` bucket with
+      the wall identity intact (the run fails the bench otherwise).
+
+    The spike phases (goodput + p99 before/during/after) ride in
+    ``extra`` for the README table. Run in a subprocess so the fleet's
+    spawn harness owns a clean jax runtime."""
+    import subprocess
+    import tempfile
+
+    run_dir = tempfile.mkdtemp(prefix="bench_autoscale_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples",
+                                      "shared_fleet.py"),
+         "--seed", str(seed), "--telemetry-dir", run_dir],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    tail = proc.stdout.decode(errors="replace")
+    print("\n".join(tail.splitlines()[-6:]))
+    if proc.returncode != 0:
+        print(f"autoscale: shared fleet run FAILED "
+              f"(rc={proc.returncode}); dir kept: {run_dir}",
+              file=sys.stderr)
+        return []
+    with open(os.path.join(run_dir, "spike-summary.json")) as f:
+        summary = json.load(f)
+    su = summary["scale_up"]
+    serve_led = summary["ledger"]["serve"]
+    ident_ok = all(
+        led.get("identity_error_frac") is not None
+        and led["identity_error_frac"] <= 0.01
+        for led in summary["ledger"].values())
+    extra = {
+        "seed": seed,
+        "detect_s": su.get("detect_s"),
+        "actuation_s": su.get("actuation_s"),
+        "burn_peak_short": summary.get("burn_peak_short"),
+        "capacity_returned": summary.get("capacity_returned"),
+        "slo_recovered": summary.get("slo_recovered"),
+        "dropped": summary["requests"]["dropped"],
+        "served": summary["requests"]["served"],
+        "train_warm_resume": summary.get("train_warm_resume"),
+        "scale_transition_s": {
+            role: led["badput_s"]["scale_transition"]
+            for role, led in summary["ledger"].items()},
+        "identity_ok": ident_ok,
+        "phases": summary.get("phases"),
+        "spike": summary.get("spike"),
+    }
+    rows = []
+    for metric, value, unit in (
+            ("autoscale_scale_up_latency_s",
+             su.get("scale_up_latency_s"), "s"),
+            ("autoscale_slo_recovery_s",
+             summary.get("slo_recovery_s"), "s"),
+            ("autoscale_goodput_frac",
+             serve_led.get("goodput_frac"), "frac")):
+        if not isinstance(value, (int, float)):
+            print(f"autoscale: no measurement for {metric} "
+                  f"(run dir kept: {run_dir})", file=sys.stderr)
+            keep_dir = True
+            continue
+        row = {"metric": metric, "value": value, "unit": unit,
+               "vs_baseline": None, "extra": extra}
+        rows.append(row)
+        print(json.dumps(row))
+    from distributed_tensorflow_tpu import telemetry
+    telemetry.event("autoscale.row", seed=seed,
+                    scale_up_latency_s=su.get("scale_up_latency_s"),
+                    slo_recovery_s=summary.get("slo_recovery_s"),
+                    goodput_frac=serve_led.get("goodput_frac"),
+                    capacity_returned=summary.get("capacity_returned"))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "autoscale",
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": rows}, f, indent=1)
+            f.write("\n")
+    if not keep_dir:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -1247,7 +1346,7 @@ if __name__ == "__main__":
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
                                  "input_pipeline", "scaling", "serving",
-                                 "fleet", "data_service"],
+                                 "fleet", "data_service", "autoscale"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -1275,6 +1374,12 @@ if __name__ == "__main__":
     parser.add_argument("--data-workers", default=None,
                         help="with --data-service: comma-separated "
                              "input-worker counts (default 1,2,4)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="run the closed-loop autoscaling bench "
+                             "(seeded spike through a shared "
+                             "training+serving fleet: scale-up "
+                             "latency, SLO recovery, goodput through "
+                             "the transition)")
     parser.add_argument("--qps", type=float, default=None,
                         help="with --serving: target arrival rate")
     parser.add_argument("--requests", type=int, default=None,
@@ -1303,6 +1408,8 @@ if __name__ == "__main__":
                   if args.data_workers else (1, 2, 4))
         run_data_service(out_path=args.out, worker_counts=counts,
                          seed=args.seed)
+    elif args.autoscale or args.workload == "autoscale":
+        run_autoscale(out_path=args.out, seed=args.seed)
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
                     n_requests=args.requests, seed=args.seed,

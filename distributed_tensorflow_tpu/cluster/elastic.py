@@ -131,6 +131,53 @@ def heartbeat_path(supervisor_dir: str, task_index: int) -> str:
     return os.path.join(supervisor_dir, f"heartbeat-{task_index}")
 
 
+def drain_path(supervisor_dir: str, task_index: int | str) -> str:
+    """Supervisor-side: the drain flag a task polls. The supervisor
+    writes it before a SCALE reform (resilience/supervisor.py
+    ``drain_on_scale``); a serving replica that sees it stops admitting
+    new requests, finishes its running sequences, logs them and exits
+    cleanly — so a replica removed by scale-down drops zero requests
+    (the held/unfinished remainder re-shards onto the next
+    generation)."""
+    return os.path.join(supervisor_dir, f"drain-{task_index}")
+
+
+def drain_requested(supervisor_dir: str | None = None,
+                    task_index: int | str | None = None) -> bool:
+    """Worker-side: has the supervisor asked this task to drain?
+    Defaults resolve from the environment exactly like
+    :func:`heartbeat`; explicit arguments serve in-process simulated
+    workers (testing/fleet_sim.py threads share one environment).
+    A single ``os.path.exists`` — cheap enough for every step."""
+    d = supervisor_dir or os.environ.get(ENV_SUPERVISOR_DIR)
+    if not d:
+        return False
+    if task_index is None:
+        task_index = os.environ.get("DTX_MPR_TASK_INDEX", "0")
+    return os.path.exists(drain_path(d, task_index))
+
+
+def drain_mode(supervisor_dir: str | None = None,
+               task_index: int | str | None = None) -> str | None:
+    """The drain flag's mode, or None when no drain is requested:
+    ``"fast"`` (finish only in-flight/running work — a scale-UP wants
+    the capacity add now, queued work re-shards) or ``"full"`` (finish
+    everything already admitted — a scale-DOWN happens at low load, so
+    completing the queue before the reform keeps those requests off
+    the respawn gap's latency tail)."""
+    d = supervisor_dir or os.environ.get(ENV_SUPERVISOR_DIR)
+    if not d:
+        return None
+    if task_index is None:
+        task_index = os.environ.get("DTX_MPR_TASK_INDEX", "0")
+    try:
+        with open(drain_path(d, task_index)) as f:
+            mode = f.read().strip()
+        return mode if mode in ("fast", "full") else "fast"
+    except OSError:
+        return None
+
+
 def peer_memdir(task_index: int | str | None = None) -> str | None:
     """This worker's *memdir* — the directory standing in for its
     machine's RAM/ramdisk in the peer-snapshot tier

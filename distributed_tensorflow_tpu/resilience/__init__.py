@@ -14,7 +14,10 @@ Four parts (see each module's docstring):
   (supervisor polls O(N/shard) keys per tick);
 - :mod:`.supervisor` — the recovery supervisor closing the loop: it
   restarts dead workers, reforms the cluster under a fresh generation
-  (cluster/elastic.py), and resumes from the last intact checkpoint.
+  (cluster/elastic.py), and resumes from the last intact checkpoint;
+- :mod:`.autoscaler` — the resource-management loop on top: SLO-burn
+  policy engine, fixed-budget training↔serving capacity arbitration,
+  and the shared-fleet supervisor composing two supervised jobs.
 """
 
 from distributed_tensorflow_tpu.resilience import faults, heartbeats
@@ -39,4 +42,11 @@ from distributed_tensorflow_tpu.resilience.supervisor import (
     WorkerFailure,
     seeded_kill_plan,
     seeded_shrink_plan,
+)
+from distributed_tensorflow_tpu.resilience.autoscaler import (
+    Autoscaler,
+    AutoscalePolicy,
+    CapacityArbiter,
+    ScaleDecision,
+    SharedFleetSupervisor,
 )

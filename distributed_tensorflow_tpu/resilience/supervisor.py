@@ -54,6 +54,19 @@ Chaos: ``kill_plan`` schedules seed-driven SIGKILLs through the
 supervisor itself (fired when the victim's heartbeat reaches a target
 step), which is how ``tools/chaos_sweep.py --kill`` and the elastic
 end-to-end tests drive worker death deterministically.
+
+Beyond failure recovery, the supervisor is also the fleet's *resource
+actuator* (ROADMAP item 5): :meth:`RecoverySupervisor.request_scale`
+resizes the job on purpose through the SAME reform machinery a failure
+uses — drain (optional), generation bump, reform at the new size,
+topology-elastic restore — without touching the restart budget. Scale
+generations are recorded (``scale.applied`` events +
+``scale_generations``) so the goodput ledger prices their reform gaps
+into the ``scale_transition`` badput bucket instead of ``recovery``.
+An ``autoscaler`` hook (resilience/autoscaler.py) is ticked from the
+watch loop, closing SLO burn -> scale decision -> reform in one place;
+scale actions serialize behind the reform lock, so a decision arriving
+mid-recovery is deferred to the next healthy tick, never lost.
 """
 
 from __future__ import annotations
@@ -62,6 +75,7 @@ import dataclasses
 import os
 import random
 import tempfile
+import threading
 import time
 from typing import Callable, Mapping, Sequence
 
@@ -180,12 +194,16 @@ class RecoverySupervisor:
                  max_failure_history: int = 256,
                  shrink_after: int | None = None,
                  min_workers: int = 1,
+                 max_workers: int | None = None,
                  telemetry_dir: str | None = None,
                  work_dir: str | None = None,
                  heartbeats=None,
                  runner_factory=None,
                  cluster_spec_fn=None,
-                 kv_gc=None):
+                 kv_gc=None,
+                 autoscaler=None,
+                 drain_on_scale: bool = False,
+                 drain_timeout_s: float = 15.0):
         """Knobs beyond the obvious:
 
         - ``stall_timeout_s`` — heartbeat *staleness* budget: a worker
@@ -225,6 +243,19 @@ class RecoverySupervisor:
           heartbeat (the GC's grace anchor) and the watch loop sweeps
           dead generations' KV namespaces once their grace window
           elapses (``recovery.kv_gc`` event per sweep).
+        - ``autoscaler`` — an object with ``tick(supervisor)`` called
+          once per watch tick while the generation is healthy
+          (resilience/autoscaler.py: the SLO-burn policy engine or the
+          shared-fleet capacity arbiter). Its decisions land through
+          :meth:`request_scale`; a tick that raises degrades to a
+          ``scale.error`` event, never kills the job.
+        - ``max_workers`` — upper clamp for :meth:`request_scale`
+          (``min_workers`` is the lower clamp, shared with the shrink
+          policy). ``drain_on_scale`` — before a scale reform, write
+          per-task drain flags (cluster/elastic.drain_path) and give
+          the generation ``drain_timeout_s`` to exit on its own;
+          serving replicas use it to finish in-flight sequences so a
+          scale-down drops zero requests.
         """
         self._fn = worker_fn
         self._num_workers = num_workers
@@ -250,6 +281,22 @@ class RecoverySupervisor:
         self.max_failure_history = max_failure_history
         self.shrink_after = shrink_after
         self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.autoscaler = autoscaler
+        self._drain_on_scale = drain_on_scale
+        self._drain_timeout_s = drain_timeout_s
+        #: serializes generation-replacing actions (failure recovery
+        #: AND scale reforms): a scale request landing while a recovery
+        #: holds this lock stays pending and is applied at the next
+        #: healthy watch tick — deferred, never lost
+        self._reform_lock = threading.RLock()
+        self._scale_lock = threading.Lock()
+        self._pending_scale: "tuple[int, str] | None" = None
+        self._stop_requested = threading.Event()
+        self.scales_applied = 0
+        #: generations created by scale actions (not failures) — the
+        #: goodput ledger prices their reform gaps as scale_transition
+        self.scale_generations: set[int] = set()
         self._fail_streak: dict[int, int] = {}
         self._hb_seen: dict[int, int | None] = {}
         self._telemetry_dir = telemetry_dir
@@ -340,10 +387,14 @@ class RecoverySupervisor:
             env.setdefault(_events.ENV_TELEMETRY_DIR, self._telemetry_dir)
         return env
 
-    def _clear_heartbeats(self):
+    def _clear_heartbeats(self, clear_n: int | None = None):
         self._hb_seen: dict[int, int | None] = {}
         self._hb.generation = self.generation
-        self._hb.clear(self._num_workers)
+        # a scale-down leaves heartbeat files of removed slots behind —
+        # clear the LARGER of the old/new sizes so they cannot read as
+        # live workers later
+        self._hb.clear(clear_n if clear_n is not None
+                       else self._num_workers)
 
     @staticmethod
     def _classify(exitcode: int | None) -> str:
@@ -379,6 +430,16 @@ class RecoverySupervisor:
         try:
             while True:
                 failures = self._watch()
+                if failures == "scale":
+                    self._apply_scale()
+                    continue
+                if failures == "stop":
+                    self._event("recovery.run_stopped",
+                                generation=self.generation,
+                                restarts=self.restarts_used)
+                    self._runner.terminate_all()
+                    return self._runner.join(timeout=30,
+                                             raise_on_error=False)
                 if failures is None:
                     result = self._runner.join(timeout=60,
                                                raise_on_error=False)
@@ -403,9 +464,11 @@ class RecoverySupervisor:
                 for k, t in sorted(result.tasks.items())
                 if t.exitcode != 0 or t.error is not None]
 
-    def _watch(self) -> list[WorkerFailure] | None:
+    def _watch(self) -> "list[WorkerFailure] | None | str":
         """Watch the current generation. Returns failures needing
-        recovery, or None when every task exited cleanly.
+        recovery, None when every task exited cleanly, ``"scale"``
+        when a scale request is pending (the run loop applies it), or
+        ``"stop"`` after :meth:`request_stop`.
 
         Heartbeats are read from the source ONCE per tick (``read_all``
         — for the sharded KV source that is O(N/shard) key reads) and
@@ -429,6 +492,22 @@ class RecoverySupervisor:
             stalled = self._check_stall(exits, t0, hbs)
             if stalled is not None:
                 return [stalled]
+            if self._stop_requested.is_set():
+                return "stop"
+            if self.autoscaler is not None:
+                # the closed loop: SLO burn / goodput -> decision ->
+                # request_scale, all on this tick. A policy bug logs,
+                # it never kills the supervised job.
+                try:
+                    self.autoscaler.tick(self)
+                except Exception as e:       # noqa: BLE001
+                    self._event("scale.error",
+                                generation=self.generation,
+                                error=repr(e)[:300])
+            with self._scale_lock:
+                pending = self._pending_scale
+            if pending is not None:
+                return "scale"
             if self.kv_gc is not None:
                 swept = self.kv_gc.maybe_sweep(current_gen=self.generation)
                 if swept:
@@ -513,6 +592,126 @@ class RecoverySupervisor:
                        f"(budget {worst[2]}s)")
         return None
 
+    # -- elastic resizing (the resource-manager surface) ------------------
+    def request_scale(self, num_workers: int, *,
+                      reason: str = "scale") -> "int | None":
+        """Ask for an elastic resize to ``num_workers`` (clamped to
+        ``[min_workers, max_workers]``). Thread-safe and asynchronous:
+        the watch loop applies it at its next healthy tick through the
+        same generation-bump + reform machinery a failure recovery
+        uses — behind the reform lock, so a request landing mid-recovery
+        is deferred, never lost, and never consumes the restart budget.
+        Returns the accepted (clamped) target, or None for a no-op."""
+        target = max(self.min_workers, int(num_workers))
+        if self.max_workers is not None:
+            target = min(target, self.max_workers)
+        with self._scale_lock:
+            if target == self._num_workers and self._pending_scale is None:
+                return None
+            self._pending_scale = (target, reason)
+        return target
+
+    def request_stop(self) -> None:
+        """Ask the run loop to end the job at its next watch tick
+        (``recovery.run_stopped``): the shared-fleet supervisor uses it
+        to wind the training job down once the serving workload is
+        done. The returned result carries whatever each task had
+        produced; no recovery is attempted."""
+        self._stop_requested.set()
+
+    def _drain_generation(self, mode: str = "fast") -> int:
+        """Write per-task drain flags (``mode``: ``fast`` = finish
+        running work only, ``full`` = finish everything admitted — see
+        cluster/elastic.drain_mode) and give the running generation up
+        to ``drain_timeout_s`` to exit on its own (serving replicas
+        finish and log — zero dropped requests). Returns how many
+        tasks exited before the deadline; stragglers are terminated by
+        the caller."""
+        n = self._num_workers
+        for i in range(n):
+            try:
+                with open(elastic.drain_path(self._dir, i), "w") as f:
+                    f.write(mode)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self._drain_timeout_s
+        while time.monotonic() < deadline:
+            exits = self._runner.poll()
+            if len(exits) >= self._runner.num_tasks:
+                break
+            time.sleep(self._poll_s)
+        return len(self._runner.poll())
+
+    def _clear_drains(self, n: int):
+        for i in range(n):
+            try:
+                os.unlink(elastic.drain_path(self._dir, i))
+            except OSError:
+                pass
+
+    def _apply_scale(self):
+        """Apply the pending scale request: (drain ->) terminate ->
+        generation bump -> reform at the new size. The new generation
+        is recorded in ``scale_generations`` and announced with a
+        ``scale.applied`` event so the goodput ledger prices the gap
+        as ``scale_transition``, not ``recovery``."""
+        with self._scale_lock:
+            pending, self._pending_scale = self._pending_scale, None
+        if pending is None:
+            return
+        target, reason = pending
+        with self._reform_lock:
+            old_n = self._num_workers
+            if target == old_n:
+                return
+            direction = "up" if target > old_n else "down"
+            drained = 0
+            if self._drain_on_scale:
+                # scale-up wants the capacity NOW (queued work
+                # re-shards); scale-down happens at low load, so
+                # completing the admitted queue first keeps those
+                # requests off the respawn gap's latency tail
+                drained = self._drain_generation(
+                    "full" if direction == "down" else "fast")
+            self._runner.terminate_all()
+            if self.kv_gc is not None:
+                hbs = self._hb.read_all(old_n)
+                last = max((h[0] for h in hbs.values()),
+                           default=time.time())
+                self.kv_gc.note_generation_end(self.generation, last)
+            self.generation += 1
+            self.scale_generations.add(self.generation)
+            self.scales_applied += 1
+            if direction == "down":
+                # removed slots: retire their exporter label series
+                # (role change / repurposed machine — the ghost-series
+                # dedup, exporter.retire_worker) and forget their fail
+                # streaks; memdirs stay — the machine is donated, not
+                # dead, and may come back on a scale-up
+                for i in range(target, old_n):
+                    if self._exporter is not None:
+                        self._exporter.retire_worker(i)
+                self._fail_streak = {w: s for w, s in
+                                     self._fail_streak.items()
+                                     if w < target}
+            self._num_workers = target
+            self._clear_heartbeats(clear_n=max(old_n, target))
+            self._clear_drains(max(old_n, target))
+            self._runner.reform(
+                self._spec_fn(target),
+                env=self._child_env(self.generation),
+                allow_resize=True)
+            # emitted AFTER the reform so the event's wall is the
+            # instant the new capacity is actually spawning — the
+            # honest end of the actuation latency chaos_sweep --spike
+            # and bench --autoscale measure
+            self._event("scale.applied", generation=self.generation,
+                        from_workers=old_n, to_workers=target,
+                        reason=reason, direction=direction,
+                        drained=drained)
+        self._event("recovery.generation_start",
+                    generation=self.generation)
+
     #: failure kinds that mean the MACHINE behind the slot lost its
     #: memory (peer-snapshot memdir wiped): a SIGKILL stands in for
     #: node death and a preemption reclaims the VM. A stall or an
@@ -587,7 +786,14 @@ class RecoverySupervisor:
                  backoff: Backoff):
         """Bounded recovery: record → kill stragglers → (budget
         permitting) back off, bump the generation, maybe shrink,
-        reform, un-quarantine the restarted lanes."""
+        reform, un-quarantine the restarted lanes. Holds the reform
+        lock end to end — a scale request arriving mid-recovery stays
+        pending until the next healthy watch tick."""
+        with self._reform_lock:
+            self._recover_locked(failures, backoff)
+
+    def _recover_locked(self, failures: list[WorkerFailure],
+                        backoff: Backoff):
         self._record_failures(failures)
         # a stalled task is still alive; every straggler of the dead
         # generation gets killed before the namespace moves on

@@ -211,10 +211,18 @@ class InferenceEngine:
         return cls(cfg, params, **engine_kwargs)
 
     # -- request lifecycle -------------------------------------------------
-    def submit(self, request: Request) -> "Request | None":
+    def submit(self, request: Request, *,
+               arrival_wall: "float | None" = None) -> "Request | None":
         """Queue a request; returns the request the queue evicted to
         make room (policy ``evict_oldest``), if any. Raises
-        ``QueueOverflowError`` under the ``reject`` policy."""
+        ``QueueOverflowError`` under the ``reject`` policy.
+
+        ``arrival_wall`` backdates the latency clock to the request's
+        TRUE arrival (an open-loop timed workload — or a restarted
+        replica re-serving backlog whose original arrival predates this
+        incarnation): the ``serve.request`` latency then honestly
+        includes the queueing the client experienced, so SLO burn can
+        not be reset by a restart."""
         if len(request.tokens) > self.max_prompt_len:
             raise ValueError(
                 f"request {request.id}: prompt {len(request.tokens)} > "
@@ -230,7 +238,9 @@ class InferenceEngine:
                 f"request {request.id}: prompt + max_new_tokens "
                 f"exceeds max_seq_len {self.max_seq_len}")
         evicted = self.scheduler.queue.submit(request)
-        self._submitted[request.id] = time.time()
+        self._submitted[request.id] = (arrival_wall
+                                       if arrival_wall is not None
+                                       else time.time())
         self._submit_mono[request.id] = time.monotonic()
         if evicted is not None:
             self._submitted.pop(evicted.id, None)

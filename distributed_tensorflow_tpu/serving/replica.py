@@ -24,10 +24,25 @@ the union and the cross-generation consistency of any duplicates.
 fault site can raise mid-load; the replica retries the step under a
 RetryPolicy (the site fires before any state mutation, so a retry is
 always safe).
+
+**Timed (open-loop) workloads & autoscaling.** ``spike=`` switches the
+replica from serve-everything-ASAP to an open-loop arrival schedule
+(:func:`seeded_spike_schedule`: base rate + a traffic spike window, a
+pure function of the seed). All replicas and all incarnations share one
+wall-clock anchor (:func:`run_epoch`, first-writer-wins in the run
+dir), so request arrivals — and therefore SLO latency, measured from
+the TRUE arrival via the engine's ``arrival_wall`` — are consistent
+across restarts and resharding. The replica also polls the
+supervisor's drain flag (cluster/elastic.drain_requested) every step:
+on drain it stops admitting, finishes its RUNNING sequences, logs
+them, and exits cleanly — the drain-before-stop contract a scale-down
+relies on for zero dropped requests (unfinished work re-shards onto
+the next generation via the completion-log union).
 """
 
 from __future__ import annotations
 
+import glob as _glob
 import json
 import os
 import random
@@ -53,6 +68,75 @@ def seeded_requests(seed: int, n: int, vocab_size: int, *,
     return out
 
 
+def seeded_spike_schedule(seed: int, *, duration_s: float = 40.0,
+                          base_qps: float = 2.0, spike_qps: float = 8.0,
+                          spike_start_s: float = 8.0,
+                          spike_end_s: float = 22.0,
+                          vocab_size: int = 256,
+                          prompt_range: tuple = (4, 12),
+                          new_tokens_range: tuple = (2, 6)
+                          ) -> list[Request]:
+    """Open-loop Poisson arrivals at ``base_qps`` with a spike window
+    at ``spike_qps`` — the seeded traffic shape ``chaos_sweep --spike``
+    and ``bench --autoscale`` drive at the autoscaler. A pure function
+    of the seed (the resilience/faults.py discipline), arrival times in
+    ``Request.arrival_s`` relative to the shared :func:`run_epoch`."""
+    rng = random.Random(f"dtx-spike:{seed}")
+    out: list[Request] = []
+    t = 0.0
+    i = 0
+    while True:
+        rate = (spike_qps if spike_start_s <= t < spike_end_s
+                else base_qps)
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        plen = rng.randrange(*prompt_range)
+        out.append(Request(
+            id=f"s{i:05d}",
+            tokens=tuple(rng.randrange(vocab_size)
+                         for _ in range(plen)),
+            max_new_tokens=rng.randrange(*new_tokens_range),
+            arrival_s=round(t, 6)))
+        i += 1
+    return out
+
+
+def run_epoch(run_dir: str) -> float:
+    """The run's shared t=0 wall clock: first writer wins (O_EXCL), so
+    every replica and every incarnation — including ones respawned by
+    a scale reform — anchors the same arrival schedule to the same
+    instant."""
+    import time as _time
+    path = os.path.join(run_dir, "run-epoch.json")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "w") as f:
+            json.dump({"epoch": _time.time()}, f)
+    except FileExistsError:
+        pass
+    for _ in range(100):
+        try:
+            with open(path) as f:
+                return float(json.load(f)["epoch"])
+        except (OSError, ValueError):
+            _time.sleep(0.01)            # racing writer: not yet flushed
+    raise RuntimeError(f"unreadable run epoch at {path}")
+
+
+def completed_ids_all(run_dir: str) -> dict[str, list]:
+    """The UNION of every replica's completion log — what a (re)started
+    replica treats as already done. Reading the union (not just its own
+    task's log) matters under autoscaling: a scale reform re-shards the
+    workload, so requests another replica finished may now map to this
+    one."""
+    out: dict[str, list] = {}
+    for path in sorted(_glob.glob(os.path.join(run_dir,
+                                               "served-*.jsonl"))):
+        out.update(completed_ids(path))
+    return out
+
+
 def completed_ids(path: str) -> dict[str, list]:
     """``{request_id: tokens}`` from a replica's completion log;
     torn trailing lines (SIGKILL mid-write) are skipped."""
@@ -75,14 +159,20 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                     vocab_size: int = 256, *, max_retries: int = 50,
                     engine_kwargs: dict | None = None,
                     ckpt_dir: str | None = None,
-                    step_delay_s: float = 0.0):
+                    step_delay_s: float = 0.0,
+                    spike: dict | None = None):
     """One generation of one supervised serving replica.
 
     Serves the seeded workload to completion, heartbeating every engine
     step; restartable at any point via the completion log.
     ``step_delay_s`` paces the step loop (models network/request-bound
     serving; the chaos sweep uses it so a step-targeted SIGKILL has a
-    real window to land in). Returns ``(task_index,
+    real window to land in). ``spike`` (kwargs for
+    :func:`seeded_spike_schedule`, minus seed/vocab) switches to the
+    open-loop timed workload: requests are submitted when their arrival
+    time passes (relative to the shared :func:`run_epoch`), latency is
+    measured from the true arrival, and the supervisor's drain flag is
+    honored every step (drain-before-stop). Returns ``(task_index,
     n_served_this_generation, n_total_completed)``."""
     from distributed_tensorflow_tpu.cluster import bootstrap, elastic
 
@@ -122,9 +212,29 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     from distributed_tensorflow_tpu.telemetry import goodput
     goodput.activate(goodput.GoodputLedger())
 
+    linger_s = 0.0
+    epoch = None
+    if spike is not None:
+        spike = dict(spike)
+        # keep serving (idle) past the schedule's end so the burn-clear
+        # window — and the autoscaler's reclaim — happen while replicas
+        # are still alive to be drained and resharded
+        linger_s = float(spike.pop("linger_s", 0.0))
+        workload = seeded_spike_schedule(seed, vocab_size=vocab_size,
+                                         **spike)
+        # the union across replicas AND generations: a scale reform
+        # re-shards the workload, so another replica's completions are
+        # this one's "already done"
+        done = completed_ids_all(run_dir)
+    else:
+        workload = seeded_requests(seed, n_requests, vocab_size)
+        done = completed_ids(os.path.join(run_dir,
+                                          f"served-{task}.jsonl"))
+
     cfg = TransformerConfig.tiny(max_seq_len=64)
     kwargs = dict(num_blocks=48, block_size=8, max_slots=4,
-                  max_prompt_len=16, queue_capacity=n_requests + 1)
+                  max_prompt_len=16,
+                  queue_capacity=len(workload) + 1)
     kwargs.update(engine_kwargs or {})
     if ckpt_dir:
         engine = InferenceEngine.from_checkpoint(cfg, ckpt_dir, **kwargs)
@@ -136,53 +246,131 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
             jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
         engine = InferenceEngine(cfg, params, **kwargs)
 
+    if spike is not None:
+        # warm the compiled prefill/decode BEFORE anchoring (or
+        # reading) the run epoch: compile time is replica startup, not
+        # client-visible queueing — it must not poison the latency SLO
+        # stream that drives the autoscaler. Every incarnation warms
+        # (a respawn is nearly free once the persistent compile cache
+        # is populated).
+        gen0 = elastic.generation()
+        from distributed_tensorflow_tpu.serving.scheduler import (
+            Request as _Req)
+        engine.submit(_Req(id=f"warmup-{task}-g{gen0}",
+                           tokens=(1, 2, 3), max_new_tokens=2))
+        engine.run_until_idle(retry_faults=True)
+        epoch = run_epoch(run_dir)
+
     log_path = os.path.join(run_dir, f"served-{task}.jsonl")
-    done = completed_ids(log_path)
     # replicas statically shard the workload (request i -> replica
     # i mod N); the union of all replicas' completion logs must cover
     # the full request set — the chaos sweep's zero-dropped gate
-    mine = [r for i, r in enumerate(
-        seeded_requests(seed, n_requests, vocab_size))
-        if i % n_replicas == task]
+    mine = [r for i, r in enumerate(workload) if i % n_replicas == task]
     todo = [r for r in mine if r.id not in done]
     gen = elastic.generation()
-    print(f"[gen {gen} serve-{task}] {len(done)} already served, "
-          f"{len(todo)} of {len(mine)} to go", flush=True)
-    for r in todo:
-        engine.submit(r)
+    print(f"[gen {gen} serve-{task}] {len(mine) - len(todo)} already "
+          f"served, {len(todo)} of {len(mine)} to go", flush=True)
 
     served = 0
     step = 0
     retries = 0
+    drained = False
+    import collections as _collections
     import time as _time
+    pending = _collections.deque(todo)   # arrival order == index order
+    if spike is None:
+        for r in todo:
+            engine.submit(r)
+        pending.clear()
+
+    def _log_finished(log, finished):
+        nonlocal served
+        for rec in finished:
+            log.write(json.dumps({
+                "id": rec["id"], "tokens": rec["tokens"],
+                "prompt_tokens": rec["prompt_tokens"],
+                "latency_s": round(rec["latency_s"], 6),
+                "gen": gen}) + "\n")
+            served += 1
+
+    def _step(log) -> bool:
+        """One retried engine step; False when the retry budget blew."""
+        nonlocal retries
+        try:
+            _log_finished(log, engine.step())
+        except FaultInjected:
+            retries += 1
+            if retries > max_retries:
+                raise
+        return True
+
+    def _drain(log, mode: str):
+        """Drain-before-stop. ``fast`` (scale-up: capacity is wanted
+        NOW): finish only the RUNNING sequences, the queue re-shards.
+        ``full`` (scale-down: load is low by definition): finish
+        everything already admitted, so no accepted request pays the
+        respawn gap's latency tail. Either way nothing is dropped —
+        whatever is left re-shards onto the next generation via the
+        completion-log union."""
+        nonlocal drained
+        held = 0
+        if mode == "full":
+            while not engine.scheduler.idle:
+                elastic.heartbeat(step)
+                _step(log)
+        else:
+            while engine.scheduler.queue.pop() is not None:
+                held += 1
+            while engine.scheduler.running:
+                elastic.heartbeat(step)
+                _step(log)
+        tv_events.event("serve.drain", task=task, mode=mode,
+                        completed=served,
+                        requeued=held + len(pending))
+        drained = True
+
+    end_rel = (float(spike.get("duration_s", 40.0)) + linger_s
+               if spike is not None else 0.0)
+
+    def _more_to_do() -> bool:
+        if pending or not engine.scheduler.idle:
+            return True
+        return (spike is not None
+                and _time.time() - epoch < end_rel)
 
     # line-buffered like the event log: a SIGKILL loses at most one line
     with open(log_path, "a", buffering=1) as log:
-        while not engine.scheduler.idle:
+        while _more_to_do():
             elastic.heartbeat(step)
+            mode = elastic.drain_mode()
+            if mode is not None:
+                _drain(log, mode)
+                break
+            if spike is not None:
+                now_rel = _time.time() - epoch
+                while pending and pending[0].arrival_s <= now_rel:
+                    r = pending.popleft()
+                    # backdate the latency clock to the TRUE arrival:
+                    # a request re-served after a reform still carries
+                    # the queueing its client actually experienced
+                    engine.submit(r, arrival_wall=epoch + r.arrival_s)
+                if engine.scheduler.idle:
+                    # nothing running, nothing due: doze until the next
+                    # arrival (still heartbeating)
+                    _time.sleep(min(0.05, max(
+                        0.001, (pending[0].arrival_s - now_rel)
+                        if pending else 0.05)))
+                    continue
             if step_delay_s:
                 _time.sleep(step_delay_s)
-            try:
-                finished = engine.step()
-            except FaultInjected:
-                retries += 1
-                if retries > max_retries:
-                    raise
-                continue              # site fired pre-mutation: retry
-            for rec in finished:
-                log.write(json.dumps({
-                    "id": rec["id"], "tokens": rec["tokens"],
-                    "prompt_tokens": rec["prompt_tokens"],
-                    "latency_s": round(rec["latency_s"], 6),
-                    "gen": gen}) + "\n")
-                served += 1
+            _step(log)
             step += 1
     elastic.heartbeat(step)
-    print(f"[gen {gen} serve-{task}] served {served} "
-          f"({len(done) + served}/{len(mine)} of this replica's shard), "
+    print(f"[gen {gen} serve-{task}] served {served} this generation "
+          f"({'drained' if drained else 'complete'}), "
           f"{retries} injected-fault retries", flush=True)
     goodput.activate(None)
     if tdir:
         tv_events.shutdown()
     bootstrap.shutdown()
-    return task, served, len(done) + served
+    return task, served, len(mine) - len(todo) + served

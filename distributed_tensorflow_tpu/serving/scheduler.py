@@ -23,6 +23,12 @@ requests always finish first and the engine never deadlocks.
 new request (``policy="reject"``) or evicts the oldest WAITING request
 to make room (``policy="evict_oldest"`` — the evicted request is
 returned to the caller so the replica can surface the shed load).
+Either way the shed load is *observable*, not just an exception: a
+``serving/rejected_total`` / ``serving/evicted_total`` counter ticks
+and a ``serve.reject`` event records the request id and queue state,
+so the autoscaler (resilience/autoscaler.py) and ``health_report.py``
+can tell overload (queue full, latency burning) from failure (workers
+dying) when deciding whether to add capacity.
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ import dataclasses
 import time
 from typing import Iterable
 
+from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.serving.kv_cache import (
     BlockAllocator, BlockTable, CacheConfig, OutOfBlocksError)
 
@@ -113,6 +120,14 @@ class AdmissionQueue:
         self._q: collections.deque[Request] = collections.deque()
         self.rejected = 0
         self.evicted = 0
+        reg = telemetry.get_registry()
+        self._m_rejected = reg.counter(
+            "serving/rejected_total",
+            "admission-queue overflow rejections (overload shed — "
+            "distinct from worker failure)")
+        self._m_evicted = reg.counter(
+            "serving/evicted_total",
+            "oldest-waiting requests evicted on overflow")
 
     def __len__(self) -> int:
         return len(self._q)
@@ -124,10 +139,20 @@ class AdmissionQueue:
         if len(self._q) >= self.capacity:
             if self.policy == "reject":
                 self.rejected += 1
+                self._m_rejected.increment()
+                telemetry.event("serve.reject", id=request.id,
+                                queued=len(self._q),
+                                capacity=self.capacity,
+                                policy=self.policy)
                 raise QueueOverflowError(
                     f"admission queue full ({self.capacity})")
             evicted = self._q.popleft()
             self.evicted += 1
+            self._m_evicted.increment()
+            telemetry.event("serve.reject", id=evicted.id,
+                            queued=len(self._q),
+                            capacity=self.capacity,
+                            policy=self.policy, evicted_for=request.id)
         self._q.append(request)
         return evicted
 

@@ -105,7 +105,8 @@ def render_prometheus(snapshot: dict, *, prefix: str = "dtx_",
 
 def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_",
                   stale_after_s: "float | None" = None,
-                  now: "float | None" = None) -> "list[str]":
+                  now: "float | None" = None,
+                  retired: "dict | None" = None) -> "list[str]":
     """Fleet rollup (``aggregate.merge_rollup``) → per-worker labelled
     samples plus the merged stats — the one-scrape-sees-all-workers
     path.
@@ -119,7 +120,16 @@ def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_",
     post-recovery scrape keeps reporting that ghost as a live series.
     The merged ``stat=`` samples are untouched — they answer "what did
     the fleet do", the per-worker labels answer "who is alive doing
-    it"."""
+    it".
+
+    ``retired`` extends the same dedup to ROLE CHANGES: a worker the
+    autoscaler repurposed (training↔serving) keeps heartbeating, so the
+    age filter never fires, yet its pre-reassignment snapshot must not
+    linger as a ghost series of the OLD role. It maps ``pid -> wall of
+    reassignment``: that worker's label series are dropped until it
+    publishes a snapshot NEWER than its reassignment (i.e. from the new
+    role's registry — or from the old role again, if it was handed
+    back)."""
     stale: set = set()
     workers = rollup.get("workers") or {}
     if stale_after_s is not None and workers:
@@ -133,6 +143,16 @@ def render_rollup(rollup: dict, *, prefix: str = "dtx_fleet_",
             # snapshot payloads key workers by int, JSON round-trips
             # may key them by str: treat both spellings as the pid
             stale |= {str(p) for p in stale}
+    if retired:
+        for pid, rwall in retired.items():
+            w = workers.get(pid)
+            if w is None:
+                w = workers.get(str(pid)) or (
+                    workers.get(int(pid)) if str(pid).isdigit() else None)
+            wall = w.get("wall") if isinstance(w, dict) else None
+            if not isinstance(wall, (int, float)) or wall <= rwall:
+                stale.add(pid)
+                stale.add(str(pid))
     lines: list[str] = []
     for name, entry in sorted((rollup.get("metrics") or {}).items()):
         pname = _prom_name(name, prefix)
@@ -277,6 +297,11 @@ class MetricsExporter:
         #: older than the fleet's newest (None keeps every label —
         #: see render_rollup)
         self.stale_workers_after_s = stale_workers_after_s
+        #: pid -> wall of the worker's last role reassignment (the
+        #: autoscaler repurposing it training↔serving): its label
+        #: series are suppressed until a snapshot newer than that wall
+        #: arrives (see render_rollup's ``retired``)
+        self._retired: dict = {}
         self._labels = labels
         self._text = "# dtx exporter: no tick yet\n"
         self._text_lock = threading.Lock()
@@ -311,7 +336,8 @@ class MetricsExporter:
                 if rollup:
                     lines += render_rollup(
                         rollup,
-                        stale_after_s=self.stale_workers_after_s)
+                        stale_after_s=self.stale_workers_after_s,
+                        retired=self._retired or None)
             except Exception:
                 lines.append("# rollup_fn failed")
         if self._extra_fn is not None:
@@ -333,6 +359,16 @@ class MetricsExporter:
             except OSError:
                 pass
         return text
+
+    def retire_worker(self, pid, wall: "float | None" = None):
+        """Mark a worker as reassigned (role change / slot removed by a
+        scale action) at ``wall`` (default now): its ``worker=`` label
+        series vanish from the scrape until it publishes a snapshot
+        newer than that instant."""
+        self._retired[pid] = wall if wall is not None else time.time()
+
+    def unretire_worker(self, pid):
+        self._retired.pop(pid, None)
 
     def scrape(self) -> str:
         """Latest rendered exposition text (what ``/metrics`` serves)."""

@@ -21,6 +21,11 @@ module classifies **every wall-clock second** of every worker into
   ``ckpt_block``      step-loop time blocked on checkpoint
                       capture/commit (``ckpt_block_s``)
   ``recovery``        death -> respawn gap of a reformed generation
+  ``scale_transition``  drain -> reform gap of a generation the
+                      autoscaler created on purpose (capacity moved
+                      between training and serving — priced separately
+                      from failure recovery so decision quality is
+                      auditable, resilience/autoscaler.py)
   ``preempt_replay``  serving decode time spent re-generating tokens a
                       preempted/killed sequence had already produced
   ``idle``            everything unattributed (gaps between steps,
@@ -56,7 +61,7 @@ from distributed_tensorflow_tpu.telemetry import registry as _registry
 #: Badput bucket names, in render order. ``idle`` is the residual that
 #: makes the identity exact.
 BADPUT_BUCKETS = ("startup", "infeed_wait", "ckpt_block", "recovery",
-                  "preempt_replay", "idle")
+                  "scale_transition", "preempt_replay", "idle")
 
 #: Step events whose duration is (mostly) goodput.
 _STEP_EVENTS = frozenset({"train.step", "serve.step"})
@@ -71,7 +76,9 @@ def _empty() -> dict:
             "badput_s": {b: 0.0 for b in BADPUT_BUCKETS}}
 
 
-def _worker_ledger(events: "list[dict]") -> dict:
+def _worker_ledger(events: "list[dict]",
+                   scale_generations: "frozenset | set" = frozenset()
+                   ) -> dict:
     """Partition one worker's observed wall span.
 
     Walks events in FILE ORDER (append order — chronological across
@@ -118,8 +125,13 @@ def _worker_ledger(events: "list[dict]") -> dict:
         if isinstance(gen, int) and gen != cur_gen:
             # generation boundary inside one appended file: the gap
             # from the old incarnation's last step to the new
-            # incarnation's first event is death -> respawn -> rejoin
-            bad["recovery"] += wall - cursor
+            # incarnation's first event is death -> respawn -> rejoin —
+            # priced ``recovery`` for a failure reform and
+            # ``scale_transition`` for a generation the autoscaler
+            # created deliberately (same interval, different bucket:
+            # the identity is untouched, the attribution is honest)
+            bad["scale_transition" if gen in scale_generations
+                else "recovery"] += wall - cursor
             cursor = wall
             cur_gen = gen
             in_startup = True
@@ -187,13 +199,23 @@ def ledger_from_events(events_by_pid: "dict") -> dict:
     assumed): ``wall - (goodput + Σ badput)``. It is ~0 by construction
     and asserted ≤1% of wall by the chaos-sweep gate.
     """
+    # generations the autoscaler created on purpose (``scale.applied``
+    # is emitted by the supervisor whose log shares this run dir): their
+    # reform gaps price into ``scale_transition``, failure reforms into
+    # ``recovery``. Scanned across EVERY pid — the supervisor's own
+    # (non-numeric) log is where the markers live.
+    scale_gens = frozenset(
+        ev.get("generation") for events in events_by_pid.values()
+        for ev in events
+        if ev.get("ev") == "scale.applied"
+        and isinstance(ev.get("generation"), int))
     per_worker: dict = {}
     total = _empty()
     for pid, events in sorted(events_by_pid.items(),
                               key=lambda kv: str(kv[0])):
         if not isinstance(pid, int):
             continue
-        lw = _worker_ledger(events)
+        lw = _worker_ledger(events, scale_gens)
         per_worker[pid] = lw
         total["wall_s"] += lw["wall_s"]
         total["goodput_s"] += lw["goodput_s"]

@@ -143,6 +143,30 @@ def burn_rate(records: "list[dict]", slo: SLO, *, window_s: float,
     return (bad / n) / slo.error_budget
 
 
+def burn_windows(records: "list[dict]", slo: SLO, *,
+                 now: "float | None" = None) -> "list[dict]":
+    """Per-window burn snapshot for ONE SLO — the live feed the
+    autoscaler (resilience/autoscaler.py) consumes every watch tick.
+    Returns the same window dicts :func:`evaluate_records` emits under
+    ``windows``: long/short burns plus ``firing`` (BOTH over the
+    threshold). ``now`` defaults to the newest record wall."""
+    if now is None:
+        walls = [r["wall"] for r in records
+                 if isinstance(r.get("wall"), (int, float))]
+        now = max(walls) if walls else 0.0
+    windows = []
+    for lw, sw, max_burn in slo.windows:
+        bl = burn_rate(records, slo, window_s=lw, now=now)
+        bs = burn_rate(records, slo, window_s=sw, now=now)
+        windows.append({"long_s": round(lw, 6),
+                        "short_s": round(sw, 6),
+                        "max_burn": max_burn,
+                        "burn_long": bl, "burn_short": bs,
+                        "firing": (bl is not None and bs is not None
+                                   and bl > max_burn and bs > max_burn)})
+    return windows
+
+
 def evaluate_records(records: "list[dict]", slos: "list[SLO]", *,
                      now: "float | None" = None) -> dict:
     """Evaluate every SLO over completion records.
@@ -162,19 +186,8 @@ def evaluate_records(records: "list[dict]", slos: "list[SLO]", *,
         n = len(records)
         bad = sum(bool(slo.is_bad(r)) for r in records)
         error_rate = (bad / n) if n else 0.0
-        windows = []
-        firing = False
-        for lw, sw, max_burn in slo.windows:
-            bl = burn_rate(records, slo, window_s=lw, now=now)
-            bs = burn_rate(records, slo, window_s=sw, now=now)
-            pair_firing = (bl is not None and bs is not None
-                           and bl > max_burn and bs > max_burn)
-            firing = firing or pair_firing
-            windows.append({"long_s": round(lw, 6),
-                            "short_s": round(sw, 6),
-                            "max_burn": max_burn,
-                            "burn_long": bl, "burn_short": bs,
-                            "firing": pair_firing})
+        windows = burn_windows(records, slo, now=now)
+        firing = any(w["firing"] for w in windows)
         out[slo.name] = {
             "metric": slo.metric,
             "objective": slo.objective,

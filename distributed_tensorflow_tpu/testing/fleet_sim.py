@@ -161,6 +161,13 @@ def make_sim_cluster(num_workers: int,
     return [SimAgent(service, p, num_workers) for p in range(num_workers)]
 
 
+def sim_cluster_spec(n: int) -> dict:
+    """Portless cluster spec for thread-backed runners (the
+    ``cluster_spec_fn`` a supervisor over a :class:`SimRunner` wants —
+    resizable, so autoscaler-driven scale reforms work unchanged)."""
+    return {"worker": [f"sim://{i}" for i in range(n)]}
+
+
 @dataclasses.dataclass
 class SimTaskContext:
     """What a simulated worker fn receives instead of a process env."""
@@ -463,6 +470,10 @@ class FleetReport:
     kv_waiters_woken: int = 0
     swept_generations: list = dataclasses.field(default_factory=list)
     failures: list = dataclasses.field(default_factory=list)
+    #: autoscaler-style scale reforms applied mid-run (``scale_plan``)
+    scales_applied: int = 0
+    scale_generations: list = dataclasses.field(default_factory=list)
+    final_workers: int = 0
     error: "str | None" = None
 
     def to_row(self) -> dict:
@@ -502,6 +513,7 @@ class FleetSim:
                  collect_interval_s: float = 0.1,
                  generation_timeout_s: float = 120.0,
                  telemetry_dir: "str | None" = None,
+                 scale_plan: "tuple | list" = (),
                  seed: int = 0):
         self.num_workers = num_workers
         self.steps = steps
@@ -520,6 +532,12 @@ class FleetSim:
         self.collect_interval_s = collect_interval_s
         self.generation_timeout_s = generation_timeout_s
         self.telemetry_dir = telemetry_dir
+        #: simulated scale events: ``[(after_s, target), ...]`` —
+        #: ``after_s`` seconds into the run, ``request_scale(target)``
+        #: lands on the real supervisor (same reform path the
+        #: autoscaler drives). Targets must stay <= the construction-
+        #: time ``num_workers``: the rollup topology is sized once.
+        self.scale_plan = list(scale_plan)
         self.seed = seed
         self.kv = coordination._LocalService()
         self.current_gen = 0
@@ -665,6 +683,13 @@ class FleetSim:
         lat_samples: list[float] = []
         collects = 0
         workers_seen = 0
+        bad_targets = [tg for _, tg in self.scale_plan if tg > n]
+        if bad_targets:
+            raise ValueError(
+                f"scale_plan targets {bad_targets} exceed the "
+                f"construction-time fleet size {n} (the rollup "
+                f"topology is sized once)")
+        pending_scales = sorted(self.scale_plan)
         t0 = time.time()
         with schedule_cm as registry:
             sup_thread = threading.Thread(target=_drive, daemon=True,
@@ -672,6 +697,12 @@ class FleetSim:
             sup_thread.start()
             while sup_thread.is_alive():
                 sup_thread.join(self.collect_interval_s)
+                elapsed = time.time() - t0
+                # simulated autoscaler: fire due scale events through
+                # the REAL request_scale/reform path
+                while pending_scales and elapsed >= pending_scales[0][0]:
+                    _, target = pending_scales.pop(0)
+                    supervisor.request_scale(target, reason="sim_scale")
                 sample = self._collect_once(gc_agent)
                 if sample is not None:
                     collects += 1
@@ -733,6 +764,9 @@ class FleetSim:
         report.kv_keys_final = self.kv.num_keys()
         report.kv_waiters_woken = self.kv.stats.get("waiters_woken", 0)
         report.swept_generations = list(supervisor.kv_gc.swept)
+        report.scales_applied = supervisor.scales_applied
+        report.scale_generations = sorted(supervisor.scale_generations)
+        report.final_workers = supervisor.num_workers
         return report
 
     def _collect_once(self, agent) -> "tuple[list[float], int] | None":

@@ -249,7 +249,8 @@ def _data_service_trainer(runtime, agent, provider, cfg, ckpt_dir,
 
 
 def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
-                   local_dir=None, snapshot_every=None, snapshot_keep=2):
+                   local_dir=None, snapshot_every=None, snapshot_keep=2,
+                   step_delay_s=0.0):
     """One generation of one elastic worker: bootstrap from TF_CONFIG,
     restore down the recovery ladder (own host snapshot > peer replica
     > local disk > durable disk), train data-parallel (grads
@@ -263,6 +264,16 @@ def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
     from distributed_tensorflow_tpu.cluster import bootstrap, elastic
     runtime = bootstrap.initialize()
     import jax
+    if runtime.num_processes <= 1:
+        # a cluster scaled down to ONE trainer (autoscaler donation —
+        # examples/shared_fleet.py) never joins a distributed world,
+        # but the spawn harness pre-configures gloo collectives, which
+        # this jaxlib rejects without a distributed client: reset
+        # before the first computation (the serving_replica discipline)
+        import contextlib
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "none")
     import numpy as np
     import optax
     from jax.experimental import multihost_utils
@@ -330,6 +341,12 @@ def elastic_worker(ckpt_dir, total_steps, save_every, global_batch, lr,
 
     for step in range(start_step, total_steps):
         elastic.heartbeat(step)
+        if step_delay_s:
+            # pacing for shared-fleet runs (examples/shared_fleet.py):
+            # a trainer sharing the host with serving replicas models a
+            # device-bound step so the 1-core container's CPU contention
+            # doesn't drown the serving latency signal
+            _time.sleep(step_delay_s)
         # Per-step phase attribution (the obs_report/trace_report phase
         # table): compute = local fwd/bwd + optimizer apply, collective
         # = the cross-process gradient allgather (host-driven here, so

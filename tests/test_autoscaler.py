@@ -1,0 +1,589 @@
+"""SLO-driven autoscaling + capacity arbitration (resilience/autoscaler).
+
+Layers under test, bottom up:
+
+- the pure policy engine (fake clock, synthetic completion records):
+  debounce, hysteresis, cooldown, clamps;
+- the supervisor's scale actuation over the thread-backed SimRunner
+  (testing/fleet_sim.py): request_scale -> drain -> generation bump ->
+  reform, scale generations recorded, restart budget untouched, and
+  the reform-lock regression (a scale request landing mid-recovery is
+  deferred, never lost);
+- drain-before-stop: a replica removed by scale-down finishes/logs its
+  in-flight work and the served-*.jsonl union stays byte-identical
+  through a scale-up/scale-down round trip;
+- the goodput ledger pricing scale generations into the
+  ``scale_transition`` bucket with the wall identity intact;
+- the shared-fleet closed loop end to end, simulated: a traffic spike
+  fires the burn windows, training donates a worker, serving grows,
+  the SLO clears, capacity is reclaimed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu.cluster import elastic
+from distributed_tensorflow_tpu.resilience import autoscaler as asc
+from distributed_tensorflow_tpu.resilience.supervisor import (
+    RecoverySupervisor,
+)
+from distributed_tensorflow_tpu.serving.replica import (
+    completed_ids_all, run_epoch, seeded_spike_schedule,
+)
+from distributed_tensorflow_tpu.telemetry import events as tv_events
+from distributed_tensorflow_tpu.telemetry import exporter as tv_exporter
+from distributed_tensorflow_tpu.telemetry import goodput as tv_goodput
+from distributed_tensorflow_tpu.telemetry import slo as tv_slo
+from distributed_tensorflow_tpu.testing import fleet_sim
+
+
+# ---------------------------------------------------------------------------
+# Policy engine (pure, fake clock)
+# ---------------------------------------------------------------------------
+
+def _slo(threshold_s=0.5, windows=((8.0, 2.0, 2.0),)):
+    return tv_slo.SLO("p99_latency", "latency", objective=0.99,
+                      threshold_s=threshold_s, windows=windows)
+
+
+def _records(now, n, latency_s, span_s=2.0):
+    """n completions spread over the trailing span, all at latency_s."""
+    return [{"wall": now - span_s * (i + 1) / n,
+             "latency_s": latency_s, "ok": True} for i in range(n)]
+
+
+def _policy(**kw):
+    kw.setdefault("slo", _slo())
+    kw.setdefault("interval_s", 0.0)
+    return asc.AutoscalePolicy(**kw)
+
+
+def test_burn_windows_math():
+    # 10 completions in the short window, 2 violating a 100ms SLO:
+    # error rate 0.2 over a 1% budget -> burn 20 in both windows
+    now = 1000.0
+    recs = [{"wall": now - 0.1 * i, "latency_s": 0.05, "ok": True}
+            for i in range(8)]
+    recs += [{"wall": now - 0.1 * (8 + i), "latency_s": 0.5,
+              "ok": True} for i in range(2)]
+    slo = _slo(threshold_s=0.1, windows=((2.0, 2.0, 14.4),))
+    (w,) = tv_slo.burn_windows(recs, slo, now=now)
+    assert w["burn_long"] == pytest.approx(20.0)
+    assert w["burn_short"] == pytest.approx(20.0)
+    assert w["firing"]                       # 20 > 14.4 in BOTH windows
+
+
+def test_autoscaler_debounce_then_fires_up():
+    eng = asc.Autoscaler(_policy(fire_consecutive=2))
+    bad = lambda now: _records(now, 20, 5.0)      # noqa: E731
+    assert eng.decide(1, records=bad(100.0), now=100.0) is None
+    d = eng.decide(1, records=bad(100.5), now=100.5)
+    assert d is not None and d.direction == "up" and d.target == 2
+    assert d.firing and d.burn_short > 1.0
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    eng = asc.Autoscaler(_policy(fire_consecutive=1, clear_hold_s=2.0,
+                                 cooldown_s=5.0))
+    d = eng.decide(1, records=_records(100.0, 20, 5.0), now=100.0)
+    assert d.direction == "up"
+    eng.action_applied(100.0)                     # cooldown until 105
+    good = lambda now: _records(now, 20, 0.01)    # noqa: E731
+    # clear evidence accrues during cooldown but nothing may fire
+    assert eng.decide(2, records=good(101.0), now=101.0) is None
+    assert eng.decide(2, records=good(104.0), now=104.0) is None
+    # cooldown over, clear held >= 2s -> scale down
+    d = eng.decide(2, records=good(105.5), now=105.5)
+    assert d is not None and d.direction == "down" and d.target == 1
+    assert d.reason == "burn_clear"
+
+
+def test_autoscaler_clear_timer_resets_on_burn():
+    eng = asc.Autoscaler(_policy(fire_consecutive=10, clear_hold_s=3.0))
+    good = lambda now: _records(now, 20, 0.01)    # noqa: E731
+    assert eng.decide(2, records=good(100.0), now=100.0) is None
+    # a burning sample mid-hold resets the clear timer
+    assert eng.decide(2, records=_records(102.0, 20, 5.0),
+                      now=102.0) is None
+    assert eng.decide(2, records=good(104.0), now=104.0) is None
+    assert eng.decide(2, records=good(104.9), now=104.9) is None
+    d = eng.decide(2, records=good(107.1), now=107.1)
+    assert d is not None and d.direction == "down"
+
+
+def test_autoscaler_respects_min_max_and_idle_release():
+    eng = asc.Autoscaler(_policy(fire_consecutive=1, clear_hold_s=1.0,
+                                 max_replicas=2, min_replicas=1))
+    # at max: firing produces no decision
+    assert eng.decide(2, records=_records(100.0, 20, 5.0),
+                      now=100.0) is None
+    # no traffic at all counts as clear (idle capacity flows back)...
+    eng2 = asc.Autoscaler(_policy(fire_consecutive=1, clear_hold_s=1.0))
+    assert eng2.decide(2, records=[], now=200.0) is None
+    d = eng2.decide(2, records=[], now=201.5)
+    assert d is not None and d.direction == "down"
+    # ...but never below min_replicas
+    eng3 = asc.Autoscaler(_policy(fire_consecutive=1, clear_hold_s=1.0))
+    assert eng3.decide(1, records=[], now=300.0) is None
+    assert eng3.decide(1, records=[], now=302.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Supervisor scale actuation (SimRunner threads, real supervisor)
+# ---------------------------------------------------------------------------
+
+def _sim_supervisor(worker, tmp_path, n=2, **kw):
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("runner_factory", fleet_sim.SimRunner)
+    kw.setdefault("cluster_spec_fn", fleet_sim.sim_cluster_spec)
+    return RecoverySupervisor(
+        worker, num_workers=n,
+        telemetry_dir=str(tmp_path / "tdir"),
+        work_dir=str(tmp_path / "scratch"), **kw)
+
+
+def _supervisor_events(sup):
+    path = os.path.join(sup._telemetry_dir, "events-supervisor.jsonl")
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_supervisor_scale_up_and_down_applies(tmp_path):
+    release = tmp_path / "release"
+
+    def worker(ctx):
+        while not release.exists():
+            ctx.sleep(0.02)
+        return ctx.pid
+
+    sup = _sim_supervisor(worker, tmp_path, n=2, max_workers=4)
+    box = {}
+    t = threading.Thread(target=lambda: box.update(r=sup.run()),
+                         daemon=True)
+    t.start()
+    _wait(lambda: sup._runner is not None and sup._runner.poll() == {},
+          what="generation 0 up")
+    assert sup.request_scale(3, reason="test_up") == 3
+    _wait(lambda: sup.num_workers == 3, what="scale-up applied")
+    assert sup.request_scale(1, reason="test_down") == 1
+    _wait(lambda: sup.num_workers == 1, what="scale-down applied")
+    release.write_text("go")
+    t.join(10)
+    assert "r" in box and sorted(box["r"].tasks) == [("worker", 0)]
+    # scale actions never touch the restart budget
+    assert sup.restarts_used == 0
+    assert sup.scales_applied == 2
+    assert sup.scale_generations == {1, 2}
+    applied = [e for e in _supervisor_events(sup)
+               if e["ev"] == "scale.applied"]
+    assert [(e["from_workers"], e["to_workers"], e["direction"])
+            for e in applied] == [(2, 3, "up"), (3, 1, "down")]
+    assert all(e["generation"] in sup.scale_generations
+               for e in applied)
+    # clamps: above max_workers and no-op targets are rejected/clamped
+    assert sup.request_scale(99) is None or sup.max_workers == 4
+
+
+def test_scale_request_mid_recovery_is_deferred_not_lost(tmp_path):
+    """The reform-lock regression (ISSUE 13 satellite): a scale request
+    arriving while a recovery holds the reform lock stays pending and
+    is applied at the next healthy tick — after the recovery's own
+    generation bump, at the requested size."""
+    release = tmp_path / "release"
+    crashed = tmp_path / "crashed"
+
+    def worker(ctx):
+        if ctx.pid == 0 and ctx.generation == 0 \
+                and not crashed.exists():
+            crashed.write_text("x")
+            raise RuntimeError("injected crash")
+        while not release.exists():
+            ctx.sleep(0.02)
+        return ctx.pid
+
+    sup = _sim_supervisor(worker, tmp_path, n=2, max_workers=4,
+                          max_restarts=3)
+    # hold the reform lock so the recovery blocks mid-flight, exactly
+    # like a slow reform would
+    sup._reform_lock.acquire()
+    box = {}
+    t = threading.Thread(target=lambda: box.update(r=sup.run()),
+                         daemon=True)
+    t.start()
+    _wait(crashed.exists, what="injected crash")
+    time.sleep(0.2)              # let the watch loop block on the lock
+    assert sup.request_scale(3, reason="raced") == 3
+    assert sup.generation == 0   # recovery still blocked
+    sup._reform_lock.release()
+    # recovery completes first (its own generation), THEN the deferred
+    # scale lands at the requested size
+    _wait(lambda: sup.num_workers == 3, what="deferred scale applied")
+    assert sup.restarts_used == 1
+    release.write_text("go")
+    t.join(10)
+    assert "r" in box
+    evs = _supervisor_events(sup)
+    order = [e["ev"] for e in evs
+             if e["ev"] in ("recovery.restart", "scale.applied")]
+    assert order == ["recovery.restart", "scale.applied"]
+    (applied,) = [e for e in evs if e["ev"] == "scale.applied"]
+    assert applied["to_workers"] == 3
+    # the recovery generation is NOT a scale generation; the scale
+    # generation follows it
+    assert sup.scale_generations == {applied["generation"]}
+    assert applied["generation"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Drain-before-stop + served-union round trip (sim serving workers)
+# ---------------------------------------------------------------------------
+
+def _sim_serve_fn(run_dir, serve_dir, seed, schedule_kwargs,
+                  service_s, linger_s=0.0):
+    """Thread stand-in for serving/replica.serving_replica: open-loop
+    arrivals from the SAME seeded schedule, deterministic 'tokens'
+    per id, serve.request events, drain-before-stop, completion-log
+    union on (re)start."""
+    def fn(ctx):
+        import collections
+        task, n = ctx.pid, ctx.num_workers
+        sup_dir = ctx.env.get(elastic.ENV_SUPERVISOR_DIR)
+        epoch = run_epoch(run_dir)
+        sched = seeded_spike_schedule(seed, **schedule_kwargs)
+        done = completed_ids_all(run_dir)
+        mine = [r for i, r in enumerate(sched) if i % n == task]
+        todo = collections.deque(r for r in mine if r.id not in done)
+        queue: collections.deque = collections.deque()
+        end_rel = schedule_kwargs.get("duration_s", 40.0) + linger_s
+        with elastic.generation_override(ctx.generation):
+            ev = tv_events.EventLog(
+                os.path.join(serve_dir, f"events-{task}.jsonl"),
+                process_id=task)
+        served = 0
+        with open(os.path.join(run_dir, f"served-{task}.jsonl"),
+                  "a", buffering=1) as log:
+            def complete(r):
+                nonlocal served
+                wall = time.time()
+                log.write(json.dumps(
+                    {"id": r.id,
+                     "tokens": [sum(r.tokens) % 97],   # deterministic
+                     "gen": ctx.generation}) + "\n")
+                ev.event("serve.request", id=r.id,
+                         dur_s=round(wall - (epoch + r.arrival_s), 6),
+                         ttft_s=None)
+                served += 1
+
+            while todo or queue or time.time() - epoch < end_rel:
+                ctx.check_kill()
+                if elastic.drain_requested(sup_dir, task):
+                    # drain-before-stop: finish what is in flight
+                    # (modelled as the admitted queue), requeue nothing
+                    while queue:
+                        ctx.sleep(service_s)
+                        complete(queue.popleft())
+                    ev.event("serve.drain", task=task,
+                             requeued=len(todo))
+                    break
+                now_rel = time.time() - epoch
+                while todo and todo[0].arrival_s <= now_rel:
+                    queue.append(todo.popleft())
+                if not queue:
+                    ctx.sleep(0.02)
+                    continue
+                ctx.sleep(service_s)         # the service time
+                complete(queue.popleft())
+        ev.close()
+        return served
+    return fn
+
+
+def test_drain_before_stop_union_byte_identical(tmp_path):
+    """A replica removed by scale-down finishes/logs its in-flight
+    requests; a scale-down/scale-up round trip leaves the served union
+    covering the full schedule with byte-identical duplicates."""
+    run_dir = tmp_path / "run"
+    serve_dir = tmp_path / "serve"
+    run_dir.mkdir()
+    serve_dir.mkdir()
+    kwargs = dict(duration_s=3.0, base_qps=8.0, spike_qps=8.0,
+                  spike_start_s=0.0, spike_end_s=0.0)
+    fn = _sim_serve_fn(str(run_dir), str(serve_dir), 7, kwargs,
+                       service_s=0.015, linger_s=3.0)
+    sup = _sim_supervisor(fn, tmp_path, n=2, max_workers=2,
+                          drain_on_scale=True, drain_timeout_s=5.0)
+    box = {}
+    t = threading.Thread(target=lambda: box.update(r=sup.run()),
+                         daemon=True)
+    t.start()
+    time.sleep(1.0)
+    assert sup.request_scale(1, reason="down") == 1
+    _wait(lambda: sup.num_workers == 1, what="scale-down")
+    time.sleep(0.5)
+    assert sup.request_scale(2, reason="up") == 2
+    _wait(lambda: sup.num_workers == 2, what="scale-up")
+    t.join(20)
+    assert "r" in box, "serving job did not complete"
+    # the drained generation exited on its own (not terminated): the
+    # scale event recorded every task exiting within the drain window
+    evs = _supervisor_events(sup)
+    applied = [e for e in evs if e["ev"] == "scale.applied"]
+    assert len(applied) == 2
+    assert applied[0]["drained"] == 2      # both tasks exited by drain
+    # union across generations covers the schedule exactly, duplicates
+    # byte-identical (deterministic tokens)
+    sched = seeded_spike_schedule(7, **kwargs)
+    expected = {r.id: [sum(r.tokens) % 97] for r in sched}
+    seen: dict = {}
+    for task in (0, 1):
+        path = run_dir / f"served-{task}.jsonl"
+        if not path.exists():
+            continue
+        for line in path.read_text().splitlines():
+            rec = json.loads(line)
+            if rec["id"] in seen:
+                assert seen[rec["id"]] == rec["tokens"], \
+                    f"{rec['id']}: generations disagree"
+            seen[rec["id"]] = rec["tokens"]
+    assert set(seen) == set(expected), "dropped or phantom requests"
+    for rid, toks in expected.items():
+        assert seen[rid] == toks
+    # drain events were recorded by the draining replicas
+    drains = [e for events in
+              tv_events.read_run(str(serve_dir)).values()
+              for e in events if e.get("ev") == "serve.drain"]
+    assert drains, "no serve.drain event recorded"
+
+
+# ---------------------------------------------------------------------------
+# Goodput: scale generations price into scale_transition
+# ---------------------------------------------------------------------------
+
+def test_ledger_prices_scale_transition_not_recovery():
+    worker = [
+        {"ev": "run.start", "wall": 100.0},
+        {"ev": "train.step", "wall": 101.0, "dur_s": 1.0},
+        {"ev": "train.step", "wall": 102.0, "dur_s": 1.0},
+        # scale reform: 3s gap, then the new generation's steps
+        {"ev": "run.start", "wall": 105.0, "gen": 1},
+        {"ev": "train.step", "wall": 106.0, "dur_s": 1.0, "gen": 1},
+    ]
+    supervisor = [{"ev": "scale.applied", "wall": 104.0,
+                   "generation": 1, "from_workers": 2,
+                   "to_workers": 1}]
+    led = tv_goodput.ledger_from_events({0: worker,
+                                         "supervisor": supervisor})
+    assert led["badput_s"]["scale_transition"] == pytest.approx(3.0)
+    assert led["badput_s"]["recovery"] == 0.0
+    assert led["goodput_s"] == pytest.approx(3.0)
+    assert abs(led["identity_error_s"]) < 1e-6
+    # the SAME gap without the scale.applied marker is recovery
+    led2 = tv_goodput.ledger_from_events({0: worker})
+    assert led2["badput_s"]["recovery"] == pytest.approx(3.0)
+    assert led2["badput_s"]["scale_transition"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Exporter: role-change ghost series (satellite)
+# ---------------------------------------------------------------------------
+
+def _rollup(pid_wall: dict):
+    return {"workers": {p: {"seq": 1, "wall": w}
+                        for p, w in pid_wall.items()},
+            "metrics": {"training/steps_completed": {
+                "type": "counter", "sum": 30,
+                "per_worker": {p: 10 for p in pid_wall}}}}
+
+
+def test_render_rollup_retires_reassigned_worker():
+    now = 1000.0
+    rollup = _rollup({0: now, 1: now, 2: now - 1.0})
+    # worker 2 was repurposed training->serving at `now`: its (fresh-
+    # looking) pre-reassignment snapshot must not render as a live
+    # training series, even though the age filter would keep it
+    lines = tv_exporter.render_rollup(rollup, stale_after_s=30.0,
+                                      retired={2: now})
+    joined = "\n".join(lines)
+    assert 'worker="0"' in joined and 'worker="1"' in joined
+    assert 'worker="2"' not in joined
+    # merged stats are untouched
+    assert 'stat="sum"' in joined
+    # a snapshot NEWER than the reassignment un-ghosts the worker
+    # (handed back, or publishing under its new role's registry)
+    rollup2 = _rollup({0: now, 1: now, 2: now + 5.0})
+    lines2 = tv_exporter.render_rollup(rollup2, stale_after_s=30.0,
+                                       retired={2: now})
+    assert 'worker="2"' in "\n".join(lines2)
+
+
+def test_exporter_retire_worker_wiring(tmp_path):
+    rollup = _rollup({0: 1000.0, 1: 1000.0})
+    exp = tv_exporter.MetricsExporter(
+        dir=str(tmp_path), interval_s=60.0,
+        rollup_fn=lambda: rollup, stale_workers_after_s=None)
+    try:
+        text = exp.tick()
+        assert 'worker="1"' in text
+        exp.retire_worker(1, wall=1000.5)
+        text = exp.tick()
+        assert 'worker="1"' not in text
+        assert 'worker="0"' in text
+    finally:
+        exp.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shared fleet, simulated end to end: spike -> donate -> recover ->
+# reclaim (the tier-1 shape of the chaos_sweep --spike gate)
+# ---------------------------------------------------------------------------
+
+def _sim_train_fn(train_dir):
+    def fn(ctx):
+        with elastic.generation_override(ctx.generation):
+            ev = tv_events.EventLog(
+                os.path.join(train_dir, f"events-{ctx.pid}.jsonl"),
+                process_id=ctx.pid)
+        step = 0
+        try:
+            while True:                      # runs until stopped/killed
+                ctx.sleep(0.05)
+                step += 1
+                ev.event("train.step", step=step, dur_s=0.05)
+        finally:
+            ev.close()
+    return fn
+
+
+def test_shared_fleet_spike_donate_recover_reclaim(tmp_path):
+    """The closed loop, simulated: 1 serving replica saturates during a
+    seeded spike -> burn fires -> training donates a worker (2->1) ->
+    serving grows (1->2) -> backlog drains, burn clears -> serving
+    shrinks with drain -> training reclaims (->2). Gates the same
+    observables chaos_sweep --spike gates on the real fleet."""
+    tdir = tmp_path / "fleet"
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    schedule = dict(duration_s=9.0, base_qps=3.0, spike_qps=14.0,
+                    spike_start_s=1.5, spike_end_s=4.0)
+    policy = asc.AutoscalePolicy(
+        min_replicas=1, max_replicas=2, train_floor=1,
+        fire_consecutive=2, clear_burn=1.0, clear_hold_s=1.0,
+        cooldown_s=1.5, interval_s=0.2,
+        slo=tv_slo.SLO("p99_latency", "latency", objective=0.99,
+                       threshold_s=0.35, windows=((2.5, 0.8, 2.0),)))
+    fleet = asc.SharedFleetSupervisor(
+        budget=3,
+        train_fn=_sim_train_fn(str(tdir / "train")),
+        serve_fn=_sim_serve_fn(str(run_dir), str(tdir / "serve"), 3,
+                               schedule, service_s=0.11, linger_s=7.0),
+        train_workers=2, serve_replicas=1,
+        policy=policy, telemetry_dir=str(tdir),
+        train_sup_kwargs=dict(
+            poll_interval_s=0.02,
+            runner_factory=fleet_sim.SimRunner,
+            cluster_spec_fn=fleet_sim.sim_cluster_spec),
+        serve_sup_kwargs=dict(
+            poll_interval_s=0.02,
+            runner_factory=fleet_sim.SimRunner,
+            cluster_spec_fn=fleet_sim.sim_cluster_spec,
+            drain_timeout_s=5.0))
+    result = fleet.run()
+
+    # -- scale-up: the spike donated a training worker to serving
+    assert result.serve_scales >= 2, "expected an up AND a down scale"
+    serve_events = [e for events in
+                    tv_events.read_run(fleet.serve_dir).values()
+                    for e in events]
+    applied = [e for e in serve_events if e.get("ev") == "scale.applied"]
+    ups = [e for e in applied if e["direction"] == "up"]
+    downs = [e for e in applied if e["direction"] == "down"]
+    assert ups and downs
+    assert ups[0]["to_workers"] == 2
+    train_events = [e for events in
+                    tv_events.read_run(fleet.train_dir).values()
+                    for e in events]
+    t_applied = [e for e in train_events
+                 if e.get("ev") == "scale.applied"]
+    assert any(e["reason"] == "donate_to_serving"
+               and e["to_workers"] == 1 for e in t_applied)
+    # -- capacity returned after the clear window
+    assert any(e["reason"] == "reclaim" and e["to_workers"] == 2
+               for e in t_applied)
+    assert result.final_train_workers == 2
+    assert result.final_serve_replicas == 1
+    # -- the decision trail is recorded with burn evidence
+    decisions = [e for e in serve_events
+                 if e.get("ev") == "scale.decision"]
+    up_dec = [d for d in decisions if d["direction"] == "up"]
+    assert up_dec and up_dec[0]["burn_short"] is not None \
+        and up_dec[0]["burn_short"] > 2.0
+    # -- SLO recovered: completions after the scale-up's drain window
+    #    are fast again (burn clear is what triggered the down-scale,
+    #    which we already asserted happened)
+    recs = tv_slo.records_from_events(
+        tv_events.read_run(fleet.serve_dir))
+    assert recs, "no serve.request completions recorded"
+    last = [r for r in recs
+            if r["wall"] >= downs[0]["wall"] - 0.5]
+    # -- zero dropped requests across the whole maneuver
+    sched = seeded_spike_schedule(3, **schedule)
+    seen = completed_ids_all(str(run_dir))
+    missing = {r.id for r in sched} - set(seen)
+    assert not missing, f"dropped requests: {sorted(missing)[:8]}"
+    # -- goodput: scale transitions priced, identity intact, per job
+    for d in (fleet.serve_dir, fleet.train_dir):
+        led = tv_goodput.ledger_from_run(d)
+        assert led["wall_s"] > 0
+        assert abs(led["identity_error_s"]) <= 0.01 * led["wall_s"]
+    serve_led = tv_goodput.ledger_from_run(fleet.serve_dir)
+    assert serve_led["badput_s"]["scale_transition"] > 0.0
+    # -- capacity gauges exported on the root scrape
+    prom = tdir / "metrics-live.prom"
+    assert prom.exists()
+    text = prom.read_text()
+    assert "dtx_fleet_capacity_budget" in text
+    assert 'dtx_fleet_capacity_budget{job="fleet"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# Simulated scale events at fleet N (testing/fleet_sim.py)
+# ---------------------------------------------------------------------------
+
+def test_fleet_sim_scale_plan_at_n64(tmp_path):
+    """Autoscaler-style resizes through the REAL supervisor at fleet
+    scale: 64 -> 48 -> 64 mid-run, run completes, scale generations
+    recorded, detection machinery intact."""
+    sim = fleet_sim.FleetSim(
+        64, steps=30, step_s=0.02, publish_every=10,
+        stall_timeout_s=5.0, heartbeat_grace_s=30.0,
+        collect_interval_s=0.1,
+        telemetry_dir=str(tmp_path),
+        scale_plan=[(0.2, 48), (0.6, 64)])
+    report = sim.run()
+    assert report.completed, report.error
+    assert report.scales_applied == 2
+    assert report.final_workers == 64
+    assert report.scale_generations == [1, 2]
+    assert report.generations >= 3
+    assert report.restarts == 0          # scaling is not recovery

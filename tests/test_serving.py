@@ -126,6 +126,41 @@ class TestAdmission:
         assert evicted.id == "a" and q.evicted == 1
         assert [q.pop().id, q.pop().id] == ["b", "c"]
 
+    def test_queue_reject_counter_and_event(self, tmp_path):
+        """Overload is observable, not just an exception (ISSUE 13
+        satellite): a rejection ticks serving/rejected_total and emits
+        a serve.reject event so the autoscaler and health_report can
+        tell overload from failure."""
+        from distributed_tensorflow_tpu import telemetry
+
+        telemetry.configure(str(tmp_path), process_id=0)
+        try:
+            reg = telemetry.get_registry()
+            rejected = reg.counter("serving/rejected_total")
+            before = rejected.value
+            q = AdmissionQueue(capacity=1, policy="reject")
+            q.submit(Request(id="a", tokens=(1,)))
+            with pytest.raises(QueueOverflowError):
+                q.submit(Request(id="b", tokens=(1,)))
+            assert rejected.value == before + 1
+            # evictions tick their own counter and a serve.reject
+            # event naming the shed (evicted) request
+            evictions = reg.counter("serving/evicted_total")
+            ev_before = evictions.value
+            q2 = AdmissionQueue(capacity=1, policy="evict_oldest")
+            q2.submit(Request(id="c", tokens=(1,)))
+            q2.submit(Request(id="d", tokens=(1,)))
+            assert evictions.value == ev_before + 1
+        finally:
+            telemetry.shutdown()
+        events = telemetry.read_events(
+            telemetry.event_log_path(str(tmp_path), 0))
+        rejects = [e for e in events if e.get("ev") == "serve.reject"]
+        assert len(rejects) == 2
+        assert rejects[0]["id"] == "b" and rejects[0]["policy"] == "reject"
+        assert rejects[1]["id"] == "c" \
+            and rejects[1]["evicted_for"] == "d"
+
     def test_token_budget_defers_big_prompt(self, tiny):
         cfg, params = tiny
         engine = InferenceEngine(cfg, params, num_blocks=32, block_size=8,

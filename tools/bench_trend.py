@@ -33,6 +33,9 @@ Series:
   disaggregated data-service rows per input-worker count (bench.py
   --data-service); wait-frac and reassigned-per-kill gate INVERTED
   (>10% growth fails);
+- ``autoscale/<metric>`` — the ``AUTOSCALE_r*.json`` closed-loop rows
+  (bench.py --autoscale): spike→scale-up latency and SLO recovery time
+  gate INVERTED (a slower loop fails), goodput fraction gates normally;
 - goodput/badput columns (``bench/goodput_frac``,
   ``serving/goodput_frac``, ``serving/badput_replay_frac``,
   ``serving/slo_p99_budget_consumed`` — the last two inverted): present
@@ -236,6 +239,37 @@ def load_data_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     return series
 
 
+def load_autoscale_history(repo: str = REPO) \
+        -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from AUTOSCALE_r*.json (ISSUE 13):
+    the closed loop's reaction metrics. Scale-up latency and SLO
+    recovery time carry ``lower_is_better`` so the regression gate
+    inverts — an autoscaler that reacts >10% slower than the best
+    prior round fails CI."""
+    inverted = {"scale_up_latency_s", "slo_recovery_s",
+                "scale_transition_frac"}
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "AUTOSCALE_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            metric = row.get("metric")
+            if not isinstance(row.get("value"), (int, float)) \
+                    or not metric:
+                continue
+            name = metric.removeprefix("autoscale_")
+            entry = {"value": row.get("value"), "unit": row.get("unit")}
+            if name in inverted:
+                entry["lower_is_better"] = True
+            series.setdefault(f"autoscale/{name}", {})[rnd] = entry
+    return series
+
+
 def check_regressions(series: "dict[str, dict[int, dict]]",
                       regression_frac: float) -> "list[str]":
     """Latest round of each series vs the BEST prior round: a drop past
@@ -325,6 +359,7 @@ def main(argv=None) -> int:
     series.update(load_serving_history(args.repo))
     series.update(load_fleet_history(args.repo))
     series.update(load_data_history(args.repo))
+    series.update(load_autoscale_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

@@ -48,6 +48,18 @@ each split exactly once (zero lost, zero duplicated — the
 ``data.split_consumed`` records are the proof), with the goodput
 identity intact and the recovery priced.
 
+``--spike`` sweeps the AUTOSCALING axis (ISSUE 13): each seed runs a
+shared training+serving fleet (examples/shared_fleet.py — a fixed
+worker budget, SLO-burn-driven capacity arbitration) under a
+seed-derived traffic spike. A seed survives only when the burn windows
+fired and scale-up actually happened (training donated a worker via
+the topology-elastic shrink path, warm resume — no cold restart), the
+p99 burn returned under 1.0x in-run, scale-down returned the capacity
+after the clear window, ZERO requests were dropped across every
+reform, and the goodput ledger priced the whole maneuver in the
+``scale_transition`` bucket with ``wall == goodput + Σ badput`` intact
+(±1%) in BOTH jobs' ledgers.
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -477,6 +489,107 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
     return ok, dt
 
 
+def _spike_gates(summary: dict,
+                 goodput_floor: "float | None") -> "list[str]":
+    """The --spike survival conditions over one run's recomputed
+    spike-summary (examples/shared_fleet.py analyze): closed loop
+    fired, SLO recovered, zero dropped, identity + scale_transition
+    pricing, warm donation, capacity returned."""
+    bad = []
+    su = summary.get("scale_up") or {}
+    if not su.get("applied_up"):
+        bad.append("no scale-up was applied (burn windows never "
+                   "actuated)")
+    if not su.get("donations"):
+        bad.append("training never donated a worker "
+                   "(no donate_to_serving reform)")
+    if not summary.get("slo_recovered"):
+        bad.append("p99 burn never returned under 1.0x after scale-up")
+    if not summary.get("capacity_returned"):
+        bad.append("capacity was not returned to training after the "
+                   "clear window")
+    reqs = summary.get("requests") or {}
+    if reqs.get("dropped"):
+        bad.append(f"{reqs['dropped']} request(s) DROPPED: "
+                   f"{reqs.get('missing_ids')}")
+    if not summary.get("train_warm_resume"):
+        bad.append(f"donation was not a warm resume "
+                   f"(restore tiers: {summary.get('train_restore_tiers')})")
+    priced = 0.0
+    for role, led in (summary.get("ledger") or {}).items():
+        err = led.get("identity_error_frac")
+        if err is None or err > 0.01:
+            bad.append(f"{role} ledger identity violated "
+                       f"({err if err is not None else 'no wall'})")
+        priced += (led.get("badput_s") or {}).get("scale_transition",
+                                                  0.0)
+        if goodput_floor is not None and role == "serve":
+            frac = led.get("goodput_frac") or 0.0
+            if frac < goodput_floor:
+                bad.append(f"serve goodput {frac:.1%} below the floor "
+                           f"{goodput_floor:.1%}")
+    if priced <= 0:
+        bad.append("no scale transition was priced into the "
+                   "scale_transition badput bucket")
+    return bad
+
+
+def run_spike_seed(seed: int, *, budget: int, train_workers: int,
+                   keep_dirs: bool,
+                   goodput_floor: "float | None" = None,
+                   extra_args: "list[str] | None" = None) \
+        -> tuple[bool, float]:
+    """One shared-fleet spike run (examples/shared_fleet.py); survival
+    gated on the recomputed spike summary (see ``--spike`` in the
+    module docstring)."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_spike_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "shared_fleet.py"),
+           "--seed", str(seed), "--budget", str(budget),
+           "--train-workers", str(train_workers),
+           "--telemetry-dir", run_dir, *(extra_args or [])]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if ok:
+        import json
+        try:
+            with open(os.path.join(run_dir, "spike-summary.json")) as f:
+                summary = json.load(f)
+        except (OSError, ValueError) as e:
+            summary = None
+            ok = False
+            print(f"--- seed {seed}: no spike summary ({e}) ---")
+        if summary is not None:
+            violations = _spike_gates(summary, goodput_floor)
+            if violations:
+                ok = False
+                print(f"--- seed {seed}: autoscale gates FAILED ---")
+                for v in violations:
+                    print(f"    {v}")
+            else:
+                su = summary["scale_up"]
+                print(f"    seed {seed}: scale-up "
+                      f"{su.get('scale_up_latency_s')}s after spike, "
+                      f"burn peak {summary.get('burn_peak_short')}x, "
+                      f"recovery {summary.get('slo_recovery_s')}s, "
+                      f"capacity returned")
+    if not ok and proc.returncode != 0:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-20:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -493,6 +606,14 @@ def main(argv=None) -> int:
                          "mid-load: supervisor must restart the replica, "
                          "in-flight requests must be re-served (zero "
                          "dropped), recovery visible in obs_report")
+    ap.add_argument("--spike", action="store_true",
+                    help="sweep seeded traffic spikes through a shared "
+                         "training+serving fleet: the autoscaler must "
+                         "scale serving up by donating a trainer (warm "
+                         "resume), recover the SLO, price the "
+                         "transition, and return the capacity")
+    ap.add_argument("--budget", type=int, default=3,
+                    help="--spike: fixed worker budget")
     ap.add_argument("--data", action="store_true",
                     help="sweep seed-driven SIGKILLs of INPUT WORKERS "
                          "through a supervised data-service mnist run: "
@@ -543,11 +664,19 @@ def main(argv=None) -> int:
         ap.error("--shrink requires --kill")
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
-    if sum(bool(x) for x in (args.serve, args.kill, args.data)) > 1:
-        ap.error("--kill, --serve and --data are separate sweep axes")
+    if sum(bool(x) for x in (args.serve, args.kill, args.data,
+                             args.spike)) > 1:
+        ap.error("--kill, --serve, --data and --spike are separate "
+                 "sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.data:
+        if args.spike:
+            ok, dt = run_spike_seed(s, budget=args.budget,
+                                    train_workers=args.workers,
+                                    keep_dirs=args.keep_dirs,
+                                    goodput_floor=args.goodput_floor,
+                                    extra_args=args.pytest_args)
+        elif args.data:
             ok, dt = run_data_seed(s, input_workers=args.input_workers,
                                    epochs=args.epochs,
                                    split_files=args.split_files,

@@ -72,6 +72,8 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
         slo_report = tv_slo.evaluate_records(records, slos)
 
     stalls = []
+    scale_decisions = 0
+    scale_applied = []
     for pid, events in events_by_pid.items():
         for ev in events:
             if ev.get("ev") == "stall.suspected":
@@ -79,6 +81,17 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
                                "stalled_s": ev.get("stalled_s"),
                                "suspect_worker": ev.get("suspect_worker"),
                                "badput_bucket": ev.get("badput_bucket")})
+            elif ev.get("ev") == "scale.decision":
+                scale_decisions += 1
+            elif ev.get("ev") == "scale.applied":
+                scale_applied.append({
+                    "wall": ev.get("wall"),
+                    "generation": ev.get("generation"),
+                    "direction": ev.get("direction"),
+                    "from": ev.get("from_workers"),
+                    "to": ev.get("to_workers"),
+                    "reason": ev.get("reason")})
+    scale_applied.sort(key=lambda s: s.get("wall") or 0.0)
 
     live = None
     prom = os.path.join(run_dir, tv_exporter.LIVE_METRICS_FILE)
@@ -91,6 +104,8 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
             live = None
 
     return {"ledger": ledger, "slo": slo_report, "stalls": stalls,
+            "scale": {"decisions": scale_decisions,
+                      "applied": scale_applied},
             "live_scrape": live,
             "processes": sorted(str(p) for p in events_by_pid)}
 
@@ -138,6 +153,14 @@ def render_text(report: dict) -> str:
                            f"{w['short_s']:g}s: burn {bl}/{bs} "
                            f"(max {w['max_burn']:g})"
                            + ("  FIRING" if w["firing"] else ""))
+    scale = report.get("scale") or {}
+    if scale.get("applied") or scale.get("decisions"):
+        out.append(f"autoscaling: {scale.get('decisions', 0)} "
+                   f"decision(s), {len(scale.get('applied', []))} "
+                   f"applied")
+        for s in scale.get("applied", []):
+            out.append(f"  gen{s['generation']}: {s['from']} -> "
+                       f"{s['to']} ({s['direction']}, {s['reason']})")
     for s in report["stalls"]:
         out.append(f"STALL (p{s['pid']}): {s.get('stalled_s')}s, "
                    f"suspect worker {s.get('suspect_worker')}, "
