@@ -666,7 +666,9 @@ def run_scaling(out_path: str | None = None, max_devices: int | None = None):
 
 def run_serving(out_path: str | None = None, *, qps: float | None = None,
                 n_requests: int | None = None, seed: int = 0,
-                slo_latency_ms: float | None = None):
+                slo_latency_ms: float | None = None,
+                prefix_reuse: float = 0.0, kv_dtype: str | None = None,
+                speculative_k: int = 0):
     """Request-level serving bench (ISSUE 9): p50/p99 end-to-end latency
     and generated tokens/s at a target QPS through the continuous-
     batching engine (serving/engine.py).
@@ -677,6 +679,19 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
     to the run span) and the **goodput split** of the bench wall clock
     (engine serve time = goodput, replayed tokens priced as
     preempt_replay, the rest idle).
+
+    Serving-speed columns (ISSUE 14): ``--prefix-reuse FRAC`` makes
+    FRAC of the seeded requests share one common prompt prefix — the
+    repeated-prefix traffic shape prefix caching exists for — enables
+    the engine's prefix cache, and ALSO replays the identical workload
+    through a caching-off engine in the same run: the row records both
+    sides (``baseline_nocache``) plus ``outputs_match_nocache``, the
+    byte-identical-outputs check. ``--kv-dtype {f32,bf16,int8}`` picks
+    the KV pool storage (int8 rows carry the measured
+    ``kv_quant_max_logit_err`` probe bound and the
+    ``kv_capacity_x_f32`` slots multiplier); ``--speculative K`` turns
+    on draft-verify decoding (``accepted_draft_rate`` lands in the
+    row).
 
     Arrival schedule: seeded Poisson process at ``qps`` (exponential
     interarrivals from one ``random.Random`` stream — identical
@@ -694,7 +709,8 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
 
     from distributed_tensorflow_tpu import telemetry
     from distributed_tensorflow_tpu.models.transformer import TransformerLM
-    from distributed_tensorflow_tpu.serving import InferenceEngine, Request
+    from distributed_tensorflow_tpu.serving import (
+        CacheConfig, InferenceEngine, Request, kv_quantization_probe)
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -706,6 +722,7 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
         engine_kw = dict(num_blocks=1024, block_size=16, max_slots=16,
                          max_prompt_len=128)
         prompt_range, new_range = (16, 128), (16, 64)
+        shared_len, suffix_range = 96, (8, 32)
     else:
         cfg = TransformerConfig.tiny(max_seq_len=64)
         n_requests = n_requests or 24
@@ -713,73 +730,144 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
         engine_kw = dict(num_blocks=64, block_size=8, max_slots=8,
                          max_prompt_len=16)
         prompt_range, new_range = (4, 16), (4, 12)
+        # the reuse workload models the realistic repeated-prefix shape
+        # (a long shared system prompt + a short per-user suffix): the
+        # shared prefix spans several full blocks plus a partial tail
+        # (so the copy-on-write path runs in the bench too), and
+        # prefill genuinely dominates a request's cost — what the
+        # cache exists to delete
+        shared_len, suffix_range = 40, (2, 6)
+        if prefix_reuse > 0:
+            engine_kw.update(max_prompt_len=48, num_blocks=96)
 
     model = TransformerLM(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
-    engine = InferenceEngine(cfg, params,
-                             queue_capacity=n_requests + 1, **engine_kw)
 
     rng = _random.Random(f"dtx-serve-bench:{seed}")
+    # only draw the shared prefix when reuse is on: at --prefix-reuse 0
+    # the rng stream (and so the workload + arrival schedule) is
+    # byte-identical to every earlier round's
+    shared_prefix = ([rng.randrange(cfg.vocab_size)
+                      for _ in range(shared_len)]
+                     if prefix_reuse > 0 else [])
     workload = []
     for i in range(n_requests):
-        plen = rng.randrange(*prompt_range)
+        if prefix_reuse > 0 and rng.random() < prefix_reuse:
+            toks = shared_prefix + [rng.randrange(cfg.vocab_size)
+                                    for _ in range(
+                                        rng.randrange(*suffix_range))]
+        else:
+            toks = [rng.randrange(cfg.vocab_size)
+                    for _ in range(rng.randrange(*prompt_range))]
         workload.append(Request(
-            id=f"b{i:04d}",
-            tokens=tuple(rng.randrange(cfg.vocab_size)
-                         for _ in range(plen)),
+            id=f"b{i:04d}", tokens=tuple(toks),
             max_new_tokens=rng.randrange(*new_range)))
     arrivals, t = [], 0.0
     for _ in range(n_requests):
         t += rng.expovariate(qps)
         arrivals.append(t)
 
-    # warm both compiled programs (prefill + decode) off the clock AND
-    # off the record: a warmup request's latency is compile time, which
-    # would poison the SLO stream a health_report gate evaluates (a
-    # production replica warms up before joining the balancer too)
     from distributed_tensorflow_tpu.telemetry import events as tv_events
-    tv_dir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
-    if tv_dir:
-        tv_events.shutdown()
-    engine.generate([[1, 2, 3]], max_new_tokens=2)
-    if tv_dir:
-        tv_events.configure(tv_dir)
-    stats_warm = engine.stats()
 
-    done: dict[str, dict] = {}
-    pending = list(zip(arrivals, workload))
-    t0 = time.perf_counter()
-    arrival_wall: dict[str, float] = {}
-    while len(done) < n_requests:
-        now = time.perf_counter() - t0
-        while pending and pending[0][0] <= now:
-            due, req = pending.pop(0)
-            engine.submit(req)
-            arrival_wall[req.id] = due
-        if engine.scheduler.idle:
-            if pending:                       # ahead of schedule: wait
-                time.sleep(max(0.0, pending[0][0] - now))
-            continue
-        for rec in engine.step():
-            if rec["id"] in arrival_wall:
-                # latency vs the SCHEDULED arrival (includes any lag
-                # between due time and actual submission)
-                rec["latency_s"] = ((time.perf_counter() - t0)
-                                    - arrival_wall[rec["id"]])
-                done[rec["id"]] = rec
-    span = time.perf_counter() - t0
+    def build_engine(prefix_caching: bool) -> InferenceEngine:
+        return InferenceEngine(cfg, params,
+                               queue_capacity=n_requests + 1,
+                               prefix_caching=prefix_caching,
+                               kv_dtype=kv_dtype,
+                               speculative_k=speculative_k,
+                               **engine_kw)
 
-    lats = sorted(r["latency_s"] for r in done.values())
-    ttfts = sorted(r["ttft_s"] for r in done.values()
-                   if r.get("ttft_s") is not None)
+    def drive(engine, *, record_events: bool):
+        """Warm the compiled programs off the clock AND (always) off
+        the record — a warmup request's latency is compile time, which
+        would poison the SLO stream a health_report gate evaluates (a
+        production replica warms up before joining the balancer too) —
+        then replay the seeded arrival schedule closed-loop. The
+        caching-off baseline pass sets ``record_events=False`` so the
+        run's telemetry stream describes only the headline engine."""
+        tv_dir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+        if tv_dir:
+            tv_events.shutdown()
+        engine.generate([[1, 2, 3]], max_new_tokens=2)
+        if engine.prefix_caching:
+            # also compile the cache-hit paths: suffix prefill (extend)
+            # on a full-block hit, and the copy-on-write pool copy on a
+            # partial-tail hit — otherwise the first real hit pays the
+            # compile on the latency clock
+            bs = engine.cache_cfg.block_size
+            wp = [1] * min(2 * bs, engine.max_prompt_len)
+            engine.generate([wp], max_new_tokens=2)
+            # repeat: full-block + partial-tail hit -> compiles the
+            # extend program AND the CoW pool copy
+            engine.generate([wp], max_new_tokens=2)
+        if tv_dir and record_events:
+            tv_events.configure(tv_dir)
+        stats_warm = engine.stats()
+        done: dict[str, dict] = {}
+        pending = list(zip(arrivals, workload))
+        arrival_wall: dict[str, float] = {}
+        t0 = time.perf_counter()
+        while len(done) < n_requests:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                due, req = pending.pop(0)
+                engine.submit(req)
+                arrival_wall[req.id] = due
+            if engine.scheduler.idle:
+                if pending:                   # ahead of schedule: wait
+                    time.sleep(max(0.0, pending[0][0] - now))
+                continue
+            for rec in engine.step():
+                if rec["id"] in arrival_wall:
+                    # latency vs the SCHEDULED arrival (includes any
+                    # lag between due time and actual submission)
+                    rec["latency_s"] = ((time.perf_counter() - t0)
+                                        - arrival_wall[rec["id"]])
+                    done[rec["id"]] = rec
+        span = time.perf_counter() - t0
+        if tv_dir and not record_events:
+            tv_events.configure(tv_dir)
+        return done, span, stats_warm, arrival_wall
 
     def pct(vals, q):
         return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))] \
             if vals else None
 
-    new_tokens = sum(len(r["tokens"]) for r in done.values()
-                     if r.get("tokens"))
+    def tokens_of(done):
+        return sum(len(r["tokens"]) for r in done.values()
+                   if r.get("tokens"))
+
+    # caching-off baseline first (when measuring prefix reuse), so the
+    # headline run's telemetry/SLO stream is the LAST thing written
+    baseline = None
+    base_done = None
+    if prefix_reuse > 0:
+        b_engine = build_engine(prefix_caching=False)
+        base_done, b_span, _, _ = drive(b_engine, record_events=False)
+        b_lats = sorted(r["latency_s"] for r in base_done.values())
+        baseline = {
+            "tokens_per_sec": round(tokens_of(base_done) / b_span, 1),
+            "p50_latency_ms": round(pct(b_lats, 0.50) * 1e3, 2),
+            "p99_latency_ms": round(pct(b_lats, 0.99) * 1e3, 2),
+            "span_s": round(b_span, 3),
+        }
+
+    engine = build_engine(prefix_caching=prefix_reuse > 0)
+    done, span, stats_warm, arrival_wall = drive(engine,
+                                                 record_events=True)
+
+    outputs_match = None
+    if base_done is not None:
+        outputs_match = all(
+            done[rid]["tokens"] == base_done[rid]["tokens"]
+            for rid in done)
+
+    lats = sorted(r["latency_s"] for r in done.values())
+    ttfts = sorted(r["ttft_s"] for r in done.values()
+                   if r.get("ttft_s") is not None)
+
+    new_tokens = tokens_of(done)
     stats = engine.stats()
 
     # goodput split of the measured window (warmup excluded): engine
@@ -837,6 +925,9 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
             "num_blocks": engine.cache_cfg.num_blocks,
             "block_size": engine.cache_cfg.block_size,
             "seed": seed,
+            "prefix_reuse": prefix_reuse,
+            "kv_dtype": stats.get("kv_dtype", "float32"),
+            "speculative_k": speculative_k,
             "goodput_frac": round(goodput_frac, 4),
             "badput_replay_frac": round(
                 min(1.0, serve_s * replay_frac / span), 4),
@@ -845,6 +936,47 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
             "slo": slo_extra,
         },
     }
+    # serving-speed columns (ISSUE 14), absent when the feature is off
+    extra = row["extra"]
+    pc = stats.get("prefix_cache")
+    if pc is not None:
+        # token-level hit rate over the measured window only (the
+        # warmup's own lookups subtracted out)
+        warm_pc = stats_warm.get("prefix_cache") or {}
+        hit = pc["hit_tokens"] - warm_pc.get("hit_tokens", 0)
+        look = pc["lookup_tokens"] - warm_pc.get("lookup_tokens", 0)
+        extra["cache_hit_rate"] = round(hit / look if look else 0.0, 4)
+        extra["cache_hit_tokens"] = hit
+        extra["cache_evictions"] = pc["evictions"]
+    sp = stats.get("speculative")
+    if sp is not None:
+        extra["accepted_draft_rate"] = round(sp["accepted_rate"], 4)
+        extra["drafts_proposed"] = sp["proposed"]
+    if baseline is not None:
+        extra["baseline_nocache"] = baseline
+        extra["outputs_match_nocache"] = outputs_match
+        print(f"prefix-reuse {prefix_reuse:g}: cache on "
+              f"{row['value']} tok/s p99 "
+              f"{extra['p99_latency_ms']}ms vs off "
+              f"{baseline['tokens_per_sec']} tok/s p99 "
+              f"{baseline['p99_latency_ms']}ms — outputs "
+              f"{'byte-identical' if outputs_match else 'DIVERGED'}",
+              file=sys.stderr)
+    if kv_dtype == "int8":
+        probe = kv_quantization_probe(
+            cfg, params, list(workload[0].tokens), "int8",
+            n_steps=min(24, engine.max_seq_len
+                        - len(workload[0].tokens) - 1))
+        extra["kv_quant_max_logit_err"] = round(
+            probe["max_abs_logit_err"], 6)
+        extra["kv_quant_argmax_flips"] = probe["argmax_flips"]
+    if kv_dtype in ("bf16", "int8"):
+        f32_cc = CacheConfig.for_model(
+            cfg, num_blocks=engine.cache_cfg.num_blocks,
+            block_size=engine.cache_cfg.block_size, kv_dtype="f32")
+        extra["kv_capacity_x_f32"] = round(
+            f32_cc.bytes_per_token / engine.cache_cfg.bytes_per_token,
+            2)
     firing = sorted(n for n, r in slo_extra.items() if r["firing"])
     print(f"serving SLOs: "
           + ("; ".join(f"{n} FIRING" for n in firing)
@@ -1389,6 +1521,23 @@ if __name__ == "__main__":
     parser.add_argument("--slo-latency-ms", type=float, default=None,
                         help="with --serving: p99-latency SLO threshold "
                              "(default 100 on cpu, 1000 on tpu)")
+    parser.add_argument("--prefix-reuse", type=float, default=0.0,
+                        help="with --serving: fraction of requests "
+                             "sharing one common prompt prefix; > 0 "
+                             "enables prefix caching AND replays the "
+                             "same workload caching-off as an in-row "
+                             "baseline")
+    parser.add_argument("--kv-dtype", default=None,
+                        choices=("f32", "bf16", "int8"),
+                        help="with --serving: KV-pool storage dtype "
+                             "(int8 rows carry the measured logit-"
+                             "error probe)")
+    parser.add_argument("--speculative", type=int, default=0,
+                        metavar="K",
+                        help="with --serving: draft-verify speculative "
+                             "decoding, K draft tokens per slot per "
+                             "step (default draft: the target's first "
+                             "half of layers)")
     parser.add_argument("--out", default=None,
                         help="with --scaling/--serving: also write the "
                              "full JSON (e.g. SCALING_r06.json / "
@@ -1413,7 +1562,10 @@ if __name__ == "__main__":
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
                     n_requests=args.requests, seed=args.seed,
-                    slo_latency_ms=args.slo_latency_ms)
+                    slo_latency_ms=args.slo_latency_ms,
+                    prefix_reuse=args.prefix_reuse,
+                    kv_dtype=args.kv_dtype,
+                    speculative_k=args.speculative)
     elif args.workload == "resnet50":
         run_resnet50()
     elif args.workload == "bert":

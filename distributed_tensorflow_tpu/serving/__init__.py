@@ -24,6 +24,16 @@ The serving stack over the trained sharded models (ROADMAP item 1 —
   replica exactly like a dead trainer) and re-queues in-flight requests
   across restarts via its completion log (zero dropped requests).
 
+Serving-speed optimisations (ISSUE 14, all output-invariant, all off
+by default): copy-on-write **prefix caching**
+(``InferenceEngine(prefix_caching=True)`` — committed prompt prefixes
+shared across requests, refcounted in :class:`BlockAllocator`, indexed
+by :class:`PrefixCache`), **speculative decoding**
+(``speculative_k=k`` — draft-then-verify in one cache-aware forward,
+greedy outputs exactly equal non-speculative), and a **quantized KV
+pool** (``kv_dtype="bf16"|"int8"`` — 2-3.8x the servable slots per
+chip; ``kv_quantization_probe`` measures the logit-error bound).
+
 Quick start::
 
     from distributed_tensorflow_tpu import serving
@@ -44,6 +54,7 @@ from distributed_tensorflow_tpu.serving.kv_cache import (
     BlockTable,
     CacheConfig,
     OutOfBlocksError,
+    PrefixCache,
     init_pool,
     pool_shardings,
 )
@@ -56,10 +67,14 @@ from distributed_tensorflow_tpu.serving.scheduler import (
 )
 from distributed_tensorflow_tpu.serving.decode import (
     canonical_params,
+    kv_quantization_probe,
     make_decode_fn,
+    make_draft_fn,
+    make_extend_fn,
     make_prefill_fn,
     model_forward,
     param_shardings,
+    truncated_draft,
 )
 from distributed_tensorflow_tpu.serving.replica import (
     completed_ids,
@@ -70,10 +85,11 @@ from distributed_tensorflow_tpu.serving.replica import (
 __all__ = [
     "InferenceEngine",
     "BlockAllocator", "BlockTable", "CacheConfig", "OutOfBlocksError",
-    "init_pool", "pool_shardings",
+    "PrefixCache", "init_pool", "pool_shardings",
     "AdmissionQueue", "ContinuousBatchingScheduler", "QueueOverflowError",
     "Request", "Sequence",
-    "canonical_params", "make_decode_fn", "make_prefill_fn",
-    "model_forward", "param_shardings",
+    "canonical_params", "kv_quantization_probe", "make_decode_fn",
+    "make_draft_fn", "make_extend_fn", "make_prefill_fn",
+    "model_forward", "param_shardings", "truncated_draft",
     "completed_ids", "seeded_requests", "serving_replica",
 ]

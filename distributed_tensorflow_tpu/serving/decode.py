@@ -1,6 +1,6 @@
 """Compiled incremental decode for the transformer/BERT family.
 
-Two pure, jittable programs over a trained ``TransformerLM`` parameter
+Pure, jittable programs over a trained ``TransformerLM`` parameter
 tree (stacked-layers layout) and the block-allocated KV pool
 (serving/kv_cache.py):
 
@@ -17,6 +17,20 @@ tree (stacked-layers layout) and the block-allocated KV pool
   mask is the same factored rule, greedy decode through the cache
   matches argmax over full-sequence recompute — the correctness
   contract tests/test_serving.py pins on 1 device and on dp×tp meshes.
+- :func:`make_extend_fn` — the MULTI-token cache-aware forward: E new
+  tokens per slot at explicit absolute positions, written then attended
+  against each slot's block window. This is both the prefix-cache
+  *start-offset prefill* (a prompt whose first C tokens hash-matched
+  cached blocks runs only the suffix through it) and the speculative-
+  decoding *verify* step (the target model scores the draft's k tokens
+  plus the bonus position in one forward). At E=1 it is exactly
+  :func:`make_decode_fn`.
+
+Every program takes and returns the pool as ONE dict (``{"k", "v"}``
+plus ``{"k_scale", "v_scale"}`` when the cache config is int8): writes
+quantize on the way in, gathers dequantize on the way out, so the whole
+quantisation story lives in :func:`_pool_write` / :func:`_pool_window`
+and the attention math never sees anything but the compute dtype.
 
 Everything here is plain jnp (no Pallas custom calls), so on a serving
 mesh GSPMD partitions the programs directly: slots over ``dp``,
@@ -26,8 +40,11 @@ out by ``kv_cache.pool_shardings``.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_tensorflow_tpu.models.transformer import (
     TransformerConfig, TransformerLM, mesh_axis_rules, rotary_embedding)
@@ -61,6 +78,24 @@ def canonical_params(cfg: TransformerConfig, params):
     return params
 
 
+def truncated_draft(cfg: TransformerConfig, params, n_layers=None):
+    """Self-speculation draft: the target's FIRST ``n_layers`` layers
+    plus the shared embeddings and final norm — a draft model that
+    costs nothing to obtain (LayerSkip / Draft&Verify style) and is the
+    engine's default when ``speculative_k > 0`` with no explicit draft.
+    Returns ``(draft_cfg, draft_params)`` in the canonical layout."""
+    n = n_layers if n_layers is not None else max(1, cfg.n_layers // 2)
+    if not 1 <= n <= cfg.n_layers:
+        raise ValueError(f"truncated_draft: n_layers={n} outside "
+                         f"[1, {cfg.n_layers}]")
+    p = canonical_params(cfg, params)
+    dp = dict(p)
+    dp["layers"] = jax.tree_util.tree_map(lambda a: a[:n],
+                                          dict(p["layers"]))
+    dcfg = dataclasses.replace(cfg, n_layers=n, mesh=None)
+    return dcfg, dp
+
+
 def _layer(params, l: int):
     return jax.tree_util.tree_map(lambda a: a[l], dict(params["layers"]))
 
@@ -87,6 +122,66 @@ def rotary_at(x, positions, *, base: float = 10000.0):
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                           axis=-1)
     return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pool write / gather (the quantisation seam)
+# ---------------------------------------------------------------------------
+
+def _quantize_rows(x):
+    """``(..., H, hd)`` float → int8 codes + per-(row, head) f32 scale.
+    The quantisation block is one head's ``hd``-vector of one pool row:
+    symmetric absmax scaling, so dequantisation is one multiply."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _pool_write(pool: dict, l, rows, k, v, quantized: bool) -> dict:
+    """Scatter new K/V rows (``(N, H, hd)`` compute-dtype) into layer
+    ``l`` of the pool at flat ``rows``; int8 pools quantize on write
+    and store the scales alongside."""
+    pool = dict(pool)
+    if quantized:
+        qk, sk = _quantize_rows(k)
+        qv, sv = _quantize_rows(v)
+        pool["k"] = pool["k"].at[l, rows].set(qk)
+        pool["v"] = pool["v"].at[l, rows].set(qv)
+        pool["k_scale"] = pool["k_scale"].at[l, rows].set(sk)
+        pool["v_scale"] = pool["v_scale"].at[l, rows].set(sv)
+    else:
+        pool["k"] = pool["k"].at[l, rows].set(k.astype(pool["k"].dtype))
+        pool["v"] = pool["v"].at[l, rows].set(v.astype(pool["v"].dtype))
+    return pool
+
+
+def _pool_window(pool: dict, l, window_rows, dt, quantized: bool):
+    """Gather each slot's block window from layer ``l``:
+    ``(B, W, H, hd)`` → ``(B, H, W, hd)`` compute-dtype, dequantized
+    for int8 pools."""
+    kw = pool["k"][l][window_rows]
+    vw = pool["v"][l][window_rows]
+    if quantized:
+        kw = kw.astype(jnp.float32) * pool["k_scale"][l][window_rows][..., None]
+        vw = vw.astype(jnp.float32) * pool["v_scale"][l][window_rows][..., None]
+    return (kw.transpose(0, 2, 1, 3).astype(dt),
+            vw.transpose(0, 2, 1, 3).astype(dt))
+
+
+def make_copy_fn():
+    """``copy(pool, src_rows, dst_rows)`` → pool with rows ``src_rows``
+    duplicated into ``dst_rows`` across every layer and every pool
+    array (values AND scales) — the device side of copy-on-write: the
+    engine applies it before the first divergent write into a shared
+    block."""
+
+    def copy(pool, src_rows, dst_rows):
+        return {n: a.at[:, dst_rows].set(a[:, src_rows])
+                for n, a in pool.items()}
+
+    return copy
 
 
 def model_forward(cfg: TransformerConfig, params, tokens, lengths=None,
@@ -131,17 +226,18 @@ def model_forward(cfg: TransformerConfig, params, tokens, lengths=None,
     return logits
 
 
-def make_prefill_fn(cfg: TransformerConfig):
-    """``prefill(params, pool_k, pool_v, tokens, lengths, write_rows)``
-    → ``(last_logits, pool_k, pool_v)``.
+def make_prefill_fn(cfg: TransformerConfig, cache_cfg=None):
+    """``prefill(params, pool, tokens, lengths, write_rows)``
+    → ``(last_logits, pool)``.
 
     ``tokens`` (B, S) right-padded prompts, ``lengths`` (B,) true
     lengths, ``write_rows`` (B, S) flat pool rows per position (padded
     positions point at the trash block). ``last_logits`` (B, vocab) are
     the logits at each prompt's final REAL position — the first
     generated token's distribution."""
+    quantized = cache_cfg.quantized if cache_cfg is not None else False
 
-    def prefill(params, pool_k, pool_v, tokens, lengths, write_rows):
+    def prefill(params, pool, tokens, lengths, write_rows):
         B, S = tokens.shape
         logits, (ks, vs) = model_forward(cfg, params, tokens,
                                          lengths=lengths, return_kv=True)
@@ -149,17 +245,18 @@ def make_prefill_fn(cfg: TransformerConfig):
         rows = write_rows.reshape(-1)                       # (B*S,)
         flat_k = ks.transpose(0, 1, 3, 2, 4).reshape(L, B * S, H, hd)
         flat_v = vs.transpose(0, 1, 3, 2, 4).reshape(L, B * S, H, hd)
-        pool_k = pool_k.at[:, rows].set(flat_k.astype(pool_k.dtype))
-        pool_v = pool_v.at[:, rows].set(flat_v.astype(pool_v.dtype))
+        for l in range(L):
+            pool = _pool_write(pool, l, rows, flat_k[l], flat_v[l],
+                               quantized)
         last = logits[jnp.arange(B), jnp.maximum(lengths, 1) - 1]
-        return last, pool_k, pool_v
+        return last, pool
 
     return prefill
 
 
-def make_decode_fn(cfg: TransformerConfig):
-    """``decode(params, pool_k, pool_v, tokens, positions, lengths,
-    write_rows, window_rows)`` → ``(logits, pool_k, pool_v)``.
+def make_decode_fn(cfg: TransformerConfig, cache_cfg=None):
+    """``decode(params, pool, tokens, positions, lengths, write_rows,
+    window_rows)`` → ``(logits, pool)``.
 
     One incremental step for a batch of running slots: ``tokens`` (B,)
     the token being fed, ``positions`` (B,) its absolute position,
@@ -173,9 +270,10 @@ def make_decode_fn(cfg: TransformerConfig):
         raise ValueError("incremental decode requires a causal model; "
                          "serve bidirectional (BERT) configs through the "
                          "prefill/scoring path")
+    quantized = cache_cfg.quantized if cache_cfg is not None else False
 
-    def decode(params, pool_k, pool_v, tokens, positions, lengths,
-               write_rows, window_rows):
+    def decode(params, pool, tokens, positions, lengths, write_rows,
+               window_rows):
         dt = cfg.dtype
         embed = params["embed"]
         x = embed.astype(dt)[tokens]                    # (B, D)
@@ -190,12 +288,8 @@ def make_decode_fn(cfg: TransformerConfig):
             q = rotary_at(q[:, :, None], pos_q)          # (B, H, 1, hd)
             k = rotary_at(k[:, :, None], pos_q)[:, :, 0]  # (B, H, hd)
             # write THEN gather: the query must see its own position
-            pool_k = pool_k.at[l, write_rows].set(k.astype(pool_k.dtype))
-            pool_v = pool_v.at[l, write_rows].set(v.astype(pool_v.dtype))
-            kw = pool_k[l][window_rows]                  # (B, W, H, hd)
-            vw = pool_v[l][window_rows]
-            kw = kw.transpose(0, 2, 1, 3).astype(dt)     # (B, H, W, hd)
-            vw = vw.transpose(0, 2, 1, 3).astype(dt)
+            pool = _pool_write(pool, l, write_rows, k, v, quantized)
+            kw, vw = _pool_window(pool, l, window_rows, dt, quantized)
             o = mha_reference(q, kw, vw, causal=True, lengths=lengths,
                               q_positions=positions)     # (B, H, 1, hd)
             o = jnp.einsum("bhk,hkd->bd", o[:, :, 0],
@@ -209,9 +303,163 @@ def make_decode_fn(cfg: TransformerConfig):
             x = x + jnp.einsum("bf,fd->bd", hh, mlp["wo"].astype(dt))
         x = _rms_norm(x, params["final_norm"]["scale"], dt)
         logits = jnp.einsum("bd,vd->bv", x, embed.astype(dt))
-        return logits.astype(jnp.float32), pool_k, pool_v
+        return logits.astype(jnp.float32), pool
 
     return decode
+
+
+def make_extend_fn(cfg: TransformerConfig, cache_cfg=None):
+    """``extend(params, pool, tokens, positions, lengths, write_rows,
+    window_rows)`` → ``(logits, pool)`` — E tokens per slot in one
+    cache-aware forward.
+
+    ``tokens`` (B, E) the new tokens (right-padded), ``positions``
+    (B, E) their ABSOLUTE cache positions (padded entries must point at
+    or past ``lengths`` so the factored mask zeroes them), ``lengths``
+    (B,) the post-write visible length, ``write_rows`` (B, E) flat pool
+    rows (padded entries at the trash block), ``window_rows`` (B, W)
+    the block-window gather index. Returns logits for ALL E positions
+    — row ``i`` is the next-token distribution after the token fed at
+    ``positions[:, i]``.
+
+    Two callers, one program: prefix-cache suffix prefill (positions
+    ``C..L-1`` of a prompt whose first C tokens hash-matched) and
+    speculative-decode verification (positions ``L-1..L+k-1``: the
+    banked token plus k draft proposals, scored in one step). Per-query
+    math is position-independent, so row 0 of a (B, E) extend is
+    bitwise the row a (B,) decode at the same position produces — the
+    greedy-parity contract extends to both callers."""
+    if not cfg.causal:
+        raise ValueError("extend requires a causal model; serve "
+                         "bidirectional (BERT) configs through the "
+                         "prefill/scoring path")
+    quantized = cache_cfg.quantized if cache_cfg is not None else False
+
+    def extend(params, pool, tokens, positions, lengths, write_rows,
+               window_rows):
+        dt = cfg.dtype
+        B, E = tokens.shape
+        embed = params["embed"]
+        x = embed.astype(dt)[tokens]                    # (B, E, D)
+        rows = write_rows.reshape(-1)                   # (B*E,)
+        for l in range(cfg.n_layers):
+            p = _layer(params, l)
+            h = _rms_norm(x, p["RMSNorm_0"]["scale"], dt)
+            att = p["attn"]
+            q = jnp.einsum("bsd,dhk->bhsk", h, att["query"].astype(dt))
+            k = jnp.einsum("bsd,dhk->bhsk", h, att["key"].astype(dt))
+            v = jnp.einsum("bsd,dhk->bhsk", h, att["value"].astype(dt))
+            q = rotary_at(q, positions)                  # (B, H, E, hd)
+            k = rotary_at(k, positions)
+            # write THEN gather: query i must see keys 0..i of the span
+            flat_k = k.transpose(0, 2, 1, 3).reshape(B * E, k.shape[1],
+                                                     k.shape[3])
+            flat_v = v.transpose(0, 2, 1, 3).reshape(B * E, v.shape[1],
+                                                     v.shape[3])
+            pool = _pool_write(pool, l, rows, flat_k, flat_v, quantized)
+            kw, vw = _pool_window(pool, l, window_rows, dt, quantized)
+            o = mha_reference(q, kw, vw, causal=True, lengths=lengths,
+                              q_positions=positions)     # (B, H, E, hd)
+            o = jnp.einsum("bhsk,hkd->bsd", o, att["out"].astype(dt))
+            x = x + o
+            h = _rms_norm(x, p["RMSNorm_1"]["scale"], dt)
+            mlp = p["mlp"]
+            hh = jnp.einsum("bsd,df->bsf", h, mlp["wi"].astype(dt))
+            gate, up = jnp.split(hh, 2, axis=-1)
+            hh = jax.nn.silu(gate) * up
+            x = x + jnp.einsum("bsf,fd->bsd", hh, mlp["wo"].astype(dt))
+        x = _rms_norm(x, params["final_norm"]["scale"], dt)
+        logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(dt))
+        return logits.astype(jnp.float32), pool
+
+    return extend
+
+
+def make_draft_fn(cfg: TransformerConfig):
+    """``draft(params, tokens, lengths)`` → (B,) greedy next token at
+    each sequence's end — the speculative-decoding proposal step,
+    batched over the decode slots. Full recompute (the draft model is
+    small by construction; it keeps no cache state to invalidate on
+    preemption or restart)."""
+
+    def draft(params, tokens, lengths):
+        logits = model_forward(cfg, params, tokens, lengths=lengths)
+        last = logits[jnp.arange(tokens.shape[0]),
+                      jnp.maximum(lengths, 1) - 1]
+        return jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    return jax.jit(draft)
+
+
+def kv_quantization_probe(cfg: TransformerConfig, params, prompt,
+                          kv_dtype: str = "int8", *,
+                          n_steps: int = 8, num_blocks: int = 16,
+                          block_size: int = 8) -> dict:
+    """Measured logit-error bound of a quantized KV pool vs the f32
+    reference: run the SAME prompt + greedy continuation through two
+    pools (f32 and ``kv_dtype``), feeding the f32 path's tokens to both
+    so the trajectories stay aligned, and track the worst absolute
+    logit difference and whether any argmax flipped. This is the
+    number the README's KV-dtype table documents and ``bench.py
+    --serving --kv-dtype int8`` stamps into its row."""
+    from distributed_tensorflow_tpu.serving.kv_cache import (
+        BlockAllocator, BlockTable, CacheConfig, init_pool)
+
+    prompt = [int(t) for t in prompt]
+    params = canonical_params(cfg, params)
+    params = jax.tree_util.tree_map(jnp.asarray, dict(params))
+    max_err = 0.0
+    argmax_flips = 0
+    cfgs = {
+        "ref": CacheConfig.for_model(cfg, num_blocks=num_blocks,
+                                     block_size=block_size,
+                                     kv_dtype="f32"),
+        "q": CacheConfig.for_model(cfg, num_blocks=num_blocks,
+                                   block_size=block_size,
+                                   kv_dtype=kv_dtype),
+    }
+    state = {}
+    for name, cc in cfgs.items():
+        alloc = BlockAllocator(cc.num_blocks)
+        table = BlockTable(cc, max_blocks=cc.usable_blocks)
+        table.ensure_room(len(prompt) + n_steps + 1, alloc)
+        pool = init_pool(cc)
+        prefill = jax.jit(make_prefill_fn(cfg, cc))
+        decode = jax.jit(make_decode_fn(cfg, cc))
+        toks = np.asarray([prompt], np.int32)
+        rows = table.rows(np.arange(len(prompt)))[None]
+        last, pool = prefill(params, pool, jnp.asarray(toks),
+                             jnp.asarray([len(prompt)], np.int32),
+                             jnp.asarray(rows))
+        table.length = len(prompt)
+        state[name] = (table, pool, decode, np.asarray(last[0]))
+    ref_logits = state["ref"][3]
+    q_logits = state["q"][3]
+    max_err = float(np.max(np.abs(ref_logits - q_logits)))
+    argmax_flips += int(np.argmax(ref_logits) != np.argmax(q_logits))
+    token = int(np.argmax(ref_logits))       # f32 path drives both
+    for _ in range(n_steps):
+        outs = {}
+        for name in ("ref", "q"):
+            table, pool, decode, _ = state[name]
+            pos = table.length
+            table.length += 1
+            logits, pool = decode(
+                params, pool, jnp.asarray([token], np.int32),
+                jnp.asarray([pos], np.int32),
+                jnp.asarray([pos + 1], np.int32),
+                jnp.asarray([table.row_of(pos)], np.int32),
+                jnp.asarray(table.window_rows()[None]))
+            outs[name] = np.asarray(logits[0])
+            state[name] = (table, pool, decode, outs[name])
+        max_err = max(max_err,
+                      float(np.max(np.abs(outs["ref"] - outs["q"]))))
+        argmax_flips += int(np.argmax(outs["ref"])
+                            != np.argmax(outs["q"]))
+        token = int(np.argmax(outs["ref"]))
+    return {"kv_dtype": kv_dtype, "max_abs_logit_err": max_err,
+            "argmax_flips": argmax_flips,
+            "positions_checked": n_steps + 1}
 
 
 def param_shardings(cfg: TransformerConfig, mesh):

@@ -37,6 +37,37 @@ One :class:`InferenceEngine` is one serving replica's model runtime:
   (resilience/faults.py) BEFORE mutating any scheduler state, so an
   injected failure is retryable: the replica runtime catches it and
   re-runs the step; no request is lost.
+
+Three serving-speed optimisations stack on the same step loop, each
+off by default and each OUTPUT-INVARIANT (greedy tokens are identical
+with the feature on or off — the regression contract
+tests/test_serving_speed.py pins):
+
+- **Prefix caching** (``prefix_caching=True``) — committed prompt
+  prefixes are content-indexed in the scheduler's
+  :class:`~distributed_tensorflow_tpu.serving.kv_cache.PrefixCache`;
+  a later request whose prompt hash-matches adopts the cached blocks
+  (refcounted) and prefill runs ONLY over the unmatched suffix through
+  the multi-token ``extend`` program. Shared blocks are copied-on-write
+  before any divergent append; eviction is LRU over cached blocks no
+  sequence references. Cache hits shrink the serve-step share of the
+  goodput ledger automatically (smaller prefill = less serve time for
+  the same tokens).
+- **Speculative decoding** (``speculative_k=k`` with a small draft
+  model, default the target's own first half of layers —
+  ``decode.truncated_draft``) — the draft proposes k greedy tokens per
+  slot, the target verifies all k+1 positions in ONE cache-aware
+  ``extend`` forward, the longest agreeing prefix commits (plus the
+  target's own next token), and the first rejection truncates. Greedy
+  outputs equal non-speculative decode exactly; ``accepted_draft_rate``
+  in :meth:`stats` says how much of the draft's work survived.
+- **Quantized KV cache** (``kv_dtype="bf16"``/``"int8"``) — the pool
+  stores quantized K/V (int8 with per-(row, head) f32 scales),
+  quantize-on-write/dequantize-on-gather inside the compiled programs,
+  multiplying servable slots per chip
+  (``CacheConfig.bytes_per_token``); greedy parity holds on short
+  sequences, with a measured logit-error bound
+  (``decode.kv_quantization_probe``) documented in the README.
 """
 
 from __future__ import annotations
@@ -77,7 +108,15 @@ class InferenceEngine:
     prefill width, ``num_blocks``/``block_size`` size the KV pool, and
     ``token_budget`` caps prefill+decode tokens per step (Orca-style
     iteration-level fairness). ``max_seq_len`` bounds prompt+generation
-    per sequence (default: the model's ``max_seq_len``)."""
+    per sequence (default: the model's ``max_seq_len``).
+
+    Serving-speed knobs (module docstring has the semantics; all
+    output-invariant): ``prefix_caching=True`` shares committed prompt
+    prefixes across requests; ``speculative_k=k`` drafts k tokens per
+    slot and verifies them in one forward (``draft_params``/
+    ``draft_cfg`` override the default truncated-target draft);
+    ``kv_dtype`` in {"f32", "bf16", "int8"} picks the pool's storage
+    dtype (``cache_dtype`` remains the raw-dtype spelling)."""
 
     def __init__(self, cfg: TransformerConfig, params, *, mesh=None,
                  num_blocks: int = 64, block_size: int = 16,
@@ -86,7 +125,10 @@ class InferenceEngine:
                  max_seq_len: int | None = None,
                  queue_capacity: int = 256,
                  queue_policy: str = "reject",
-                 cache_dtype=None):
+                 cache_dtype=None, kv_dtype: str | None = None,
+                 prefix_caching: bool = False,
+                 speculative_k: int = 0,
+                 draft_params=None, draft_cfg=None):
         if cfg.mesh is not None:
             import dataclasses
             cfg = dataclasses.replace(cfg, mesh=None)
@@ -101,15 +143,38 @@ class InferenceEngine:
                                              + self.max_prompt_len)
         cache_cfg = CacheConfig.for_model(cfg, num_blocks=num_blocks,
                                           block_size=block_size,
-                                          dtype=cache_dtype)
+                                          dtype=cache_dtype,
+                                          kv_dtype=kv_dtype)
         max_blocks_per_seq = cache_cfg.blocks_for(self.max_seq_len)
         self.cache_cfg = cache_cfg
         self.window = max_blocks_per_seq * block_size
+        self.prefix_caching = bool(prefix_caching)
         self.scheduler = ContinuousBatchingScheduler(
             cache_cfg, max_slots=max_slots,
             max_blocks_per_seq=max_blocks_per_seq,
             token_budget=self.token_budget,
-            queue=AdmissionQueue(queue_capacity, queue_policy))
+            queue=AdmissionQueue(queue_capacity, queue_policy),
+            prefix_caching=self.prefix_caching)
+
+        if speculative_k and not cfg.causal:
+            raise ValueError("speculative decoding requires a causal "
+                             "model")
+        self.spec_k = int(speculative_k)
+        if self.spec_k:
+            if draft_params is None:
+                # default draft: the target's own first half of layers
+                # (free self-speculation; pass an explicit small model
+                # for a real distilled draft)
+                draft_cfg, draft_params = decode_lib.truncated_draft(
+                    cfg, params)
+            elif draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            self._draft_cfg = draft_cfg
+            self._draft_params = jax.tree_util.tree_map(
+                jnp.asarray,
+                dict(decode_lib.canonical_params(draft_cfg,
+                                                 draft_params)))
+            self._draft = decode_lib.make_draft_fn(draft_cfg)
 
         params = decode_lib.canonical_params(cfg, params)
         if mesh is not None:
@@ -122,33 +187,68 @@ class InferenceEngine:
         self.params = params
         self.pool = init_pool(cache_cfg, mesh)
 
-        prefill = decode_lib.make_prefill_fn(cfg)
-        decode = decode_lib.make_decode_fn(cfg) if cfg.causal else None
+        prefill = decode_lib.make_prefill_fn(cfg, cache_cfg)
+        decode = (decode_lib.make_decode_fn(cfg, cache_cfg)
+                  if cfg.causal else None)
+        extend = (decode_lib.make_extend_fn(cfg, cache_cfg)
+                  if cfg.causal else None)
+        copy_fn = decode_lib.make_copy_fn()
         if mesh is not None:
             # jit under the mesh context so GSPMD partitions over it;
             # inputs arrive host-side and get sharded by in_shardings
             from jax.sharding import NamedSharding, PartitionSpec as P
             dp = "dp" if "dp" in mesh.shape else None
-            pool_sh = pool_shardings(mesh)
+            pool_sh = pool_shardings(mesh, cache_cfg)
             rep = NamedSharding(mesh, P())
             slotv = NamedSharding(mesh, P(dp))
+            slotm = NamedSharding(mesh, P(dp, None))
             self._prefill = jax.jit(
                 prefill,
-                in_shardings=(shardings, pool_sh, pool_sh, rep, rep, rep),
-                out_shardings=(rep, pool_sh, pool_sh),
-                donate_argnums=safe_donate_argnums((1, 2)))
+                in_shardings=(shardings, pool_sh, rep, rep, rep),
+                out_shardings=(rep, pool_sh),
+                donate_argnums=safe_donate_argnums((1,)))
             self._decode = jax.jit(
                 decode,
-                in_shardings=(shardings, pool_sh, pool_sh, slotv, slotv,
-                              slotv, slotv,
-                              NamedSharding(mesh, P(dp, None))),
-                out_shardings=(NamedSharding(mesh, P(dp, None)),
-                               pool_sh, pool_sh),
-                donate_argnums=safe_donate_argnums((1, 2))) if decode is not None else None
+                in_shardings=(shardings, pool_sh, slotv, slotv,
+                              slotv, slotv, slotm),
+                out_shardings=(slotm, pool_sh),
+                donate_argnums=safe_donate_argnums((1,))) \
+                if decode is not None else None
+            # the extend program serves two batch shapes: suffix
+            # prefill is (1, E) — too narrow to shard over dp, so it
+            # runs replicated like prefill — and speculative verify is
+            # (max_slots, k+1), sharded over dp like decode
+            self._extend_prefill = jax.jit(
+                extend,
+                in_shardings=(shardings, pool_sh, rep, rep, rep, rep,
+                              rep),
+                out_shardings=(rep, pool_sh),
+                donate_argnums=safe_donate_argnums((1,))) \
+                if extend is not None else None
+            self._extend_spec = jax.jit(
+                extend,
+                in_shardings=(shardings, pool_sh, slotm, slotm, slotv,
+                              slotm, slotm),
+                out_shardings=(NamedSharding(mesh, P(dp, None, None)),
+                               pool_sh),
+                donate_argnums=safe_donate_argnums((1,))) \
+                if extend is not None else None
+            self._copy = jax.jit(
+                copy_fn, in_shardings=(pool_sh, rep, rep),
+                out_shardings=pool_sh,
+                donate_argnums=safe_donate_argnums((0,)))
         else:
-            self._prefill = jax.jit(prefill, donate_argnums=safe_donate_argnums((1, 2)))
-            self._decode = (jax.jit(decode, donate_argnums=safe_donate_argnums((1, 2)))
-                            if decode is not None else None)
+            self._prefill = jax.jit(
+                prefill, donate_argnums=safe_donate_argnums((1,)))
+            self._decode = (jax.jit(
+                decode, donate_argnums=safe_donate_argnums((1,)))
+                if decode is not None else None)
+            self._extend_prefill = (jax.jit(
+                extend, donate_argnums=safe_donate_argnums((1,)))
+                if extend is not None else None)
+            self._extend_spec = self._extend_prefill
+            self._copy = jax.jit(
+                copy_fn, donate_argnums=safe_donate_argnums((0,)))
 
         # shared inference namespace (Model.predict reports here too)
         reg = telemetry.get_registry()
@@ -169,10 +269,26 @@ class InferenceEngine:
         self._m_queued = reg.gauge("serving/requests_queued")
         self._m_blocks_free = reg.gauge("serving/blocks_free")
         self._m_preempt = reg.counter("serving/preemptions")
+        self._m_cached_tokens = reg.counter(
+            "serving/prefix_cached_tokens",
+            "prompt tokens served from the prefix cache (prefill "
+            "skipped)")
+        self._m_prompt_tokens = reg.counter(
+            "serving/prefix_prompt_tokens",
+            "prompt tokens submitted to prefix-cache lookup")
+        self._m_cache_blocks = reg.gauge("serving/prefix_cache_blocks")
+        self._m_spec_proposed = reg.counter(
+            "serving/draft_tokens_proposed")
+        self._m_spec_accepted = reg.counter(
+            "serving/draft_tokens_accepted")
 
         self._step_idx = 0
         self._submitted: dict[str, float] = {}      # id -> wall arrival
         self._submit_mono: dict[str, float] = {}    # id -> mono arrival
+        # instance-local speculation tallies (the registry counters
+        # above are process-wide and shared across engines)
+        self._spec_proposed_n = 0
+        self._spec_accepted_n = 0
 
     # -- weights -----------------------------------------------------------
     @classmethod
@@ -252,38 +368,99 @@ class InferenceEngine:
                         queued=len(self.scheduler.queue))
         return evicted
 
+    def _apply_copies(self, copies):
+        """Execute BlockTable.ensure_writable's copy-on-write
+        instructions on the device pool (values AND quantisation
+        scales) BEFORE the divergent write they protect."""
+        if not copies:
+            return
+        src = np.concatenate([np.arange(s, s + n, dtype=np.int32)
+                              for s, _, n in copies])
+        dst = np.concatenate([np.arange(d, d + n, dtype=np.int32)
+                              for _, d, n in copies])
+        self.pool = self._copy(self.pool, jnp.asarray(src),
+                               jnp.asarray(dst))
+
     def _prefill_one(self, seq: Sequence):
         """Run one admitted sequence's prompt through the compiled
-        prefill (fixed (1, max_seq_len) shape — wider than
-        max_prompt_len so a PREEMPTED sequence's replayed prompt, which
-        includes its already-generated tokens, always fits) and bank its
-        first greedy token."""
+        prefill and bank its first greedy token.
+
+        Cold path: the full prompt through ``prefill`` (fixed
+        (1, max_seq_len) shape — wider than max_prompt_len so a
+        PREEMPTED sequence's replayed prompt, which includes its
+        already-generated tokens, always fits). Prefix-cache hit: only
+        the unmatched suffix runs, through the multi-token ``extend``
+        program at a power-of-two bucket width (bounded recompiles),
+        attending the cached blocks through the normal block-window
+        gather — the start-offset path that turns repeated-prefix
+        traffic into O(suffix) prefill."""
         rid = seq.request.id
         submit_mono = self._submit_mono.get(rid)
         queue_wait = (seq.admitted_s - submit_mono
                       if submit_mono is not None else None)
+        C = seq.cached_tokens
         with telemetry.span(
                 "serve.prefill", id=rid, span_id=request_span_id(rid),
                 prompt_tokens=seq.prompt_len,
+                cached_tokens=C or None,
                 queue_wait_s=(round(queue_wait, 6)
                               if queue_wait is not None else None),
                 replayed=len(seq.request.generated_prefix) or None):
-            P = self.max_seq_len
-            toks = np.zeros((1, P), np.int32)
-            toks[0, :seq.prompt_len] = seq.request.tokens
-            rows = seq.table.rows(np.arange(P))[None]       # (1, P)
-            lengths = np.asarray([seq.prompt_len], np.int32)
-            last, self.pool["k"], self.pool["v"] = self._prefill(
-                self.params, self.pool["k"], self.pool["v"],
-                jnp.asarray(toks), jnp.asarray(lengths),
-                jnp.asarray(rows))
+            if C:
+                S = seq.prompt_len - C              # suffix to compute
+                E = min(self.max_seq_len,
+                        1 << max(3, (S - 1).bit_length()))
+                # a partially-matched tail block is SHARED: copy it
+                # before the suffix writes into it (and before the row
+                # indices below are derived from the table)
+                self._apply_copies(seq.table.ensure_writable(
+                    C, seq.prompt_len, self.scheduler.allocator))
+                toks = np.zeros((1, E), np.int32)
+                toks[0, :S] = seq.request.tokens[C:]
+                pos = np.full((1, E), self.window, np.int32)
+                pos[0, :S] = np.arange(C, seq.prompt_len)
+                rows = np.zeros((1, E), np.int32)   # pad -> trash row
+                rows[0, :S] = seq.table.rows(np.arange(C,
+                                                       seq.prompt_len))
+                win = seq.table.window_rows()[None]
+                lengths = np.asarray([seq.prompt_len], np.int32)
+                logits, self.pool = self._extend_prefill(
+                    self.params, self.pool, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(lengths),
+                    jnp.asarray(rows), jnp.asarray(win))
+                last = logits[0, S - 1]
+            else:
+                P = self.max_seq_len
+                toks = np.zeros((1, P), np.int32)
+                toks[0, :seq.prompt_len] = seq.request.tokens
+                rows = seq.table.rows(np.arange(P))[None]   # (1, P)
+                lengths = np.asarray([seq.prompt_len], np.int32)
+                last, self.pool = self._prefill(
+                    self.params, self.pool, jnp.asarray(toks),
+                    jnp.asarray(lengths), jnp.asarray(rows))
+                last = last[0]
             self.scheduler.commit_prefill(seq)
-            first = int(np.asarray(jnp.argmax(last[0])))
+            first = int(np.asarray(jnp.argmax(last)))
+        self._m_prompt_tokens.increment(seq.prompt_len)
+        if C:
+            self._m_cached_tokens.increment(C)
         if seq.request.max_new_tokens > 0:
             self.scheduler.append_token(seq, first)
         else:
             seq.first_token_s = time.monotonic()
             seq.score_token = first                    # scoring request
+
+    def _emit_token(self, seq: Sequence):
+        # per-token decode breadcrumb on the request's span: index
+        # counts generated tokens ACROSS preemptions (the replayed
+        # prefix included), so a re-served request's token trail lines
+        # up generation-to-generation
+        rid = seq.request.id
+        telemetry.event(
+            "serve.token", id=rid, span_id=request_span_id(rid),
+            index=(len(seq.request.generated_prefix)
+                   + len(seq.generated)),
+            step=self._step_idx)
 
     def _decode_batch(self, batch: list[Sequence]):
         """One incremental token for every running sequence. The decode
@@ -297,6 +474,13 @@ class InferenceEngine:
         window_rows = np.zeros((B, W), np.int32)
         for seq in batch:
             s = seq.slot
+            if self.prefix_caching:
+                # the write at position length-1 must not land in a
+                # block a prefix-cache sibling shares: copy-on-write
+                # first (without a cache no block is ever shared)
+                self._apply_copies(seq.table.ensure_writable(
+                    seq.length - 1, seq.length,
+                    self.scheduler.allocator))
             # feed the last banked token at position length-1 (it was
             # appended by the previous prefill/decode step)
             tokens[s] = seq.last_token
@@ -304,8 +488,8 @@ class InferenceEngine:
             lengths[s] = seq.length
             write_rows[s] = seq.table.row_of(seq.length - 1)
             window_rows[s] = seq.table.window_rows()
-        logits, self.pool["k"], self.pool["v"] = self._decode(
-            self.params, self.pool["k"], self.pool["v"],
+        logits, self.pool = self._decode(
+            self.params, self.pool,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(lengths), jnp.asarray(write_rows),
             jnp.asarray(window_rows))
@@ -314,17 +498,93 @@ class InferenceEngine:
         for seq in batch:
             self.scheduler.append_token(seq, int(nxt[seq.slot]))
             if emit:
-                # per-token decode breadcrumb on the request's span:
-                # index counts generated tokens ACROSS preemptions (the
-                # replayed prefix included), so a re-served request's
-                # token trail lines up generation-to-generation
-                rid = seq.request.id
-                telemetry.event(
-                    "serve.token", id=rid,
-                    span_id=request_span_id(rid),
-                    index=(len(seq.request.generated_prefix)
-                           + len(seq.generated)),
-                    step=self._step_idx)
+                self._emit_token(seq)
+
+    # -- speculative decoding ---------------------------------------------
+    def _spec_span(self, seq: Sequence) -> int:
+        """How many draft tokens speculating on ``seq`` can possibly
+        commit this step: capped by k, by the request's remaining
+        output budget (committing j drafts + 1 target token needs
+        remaining >= j + 1), and by the sequence-length ceiling."""
+        remaining = seq.request.max_new_tokens - len(seq.generated)
+        return max(0, min(self.spec_k, remaining - 1,
+                          self.max_seq_len - seq.length))
+
+    def _speculative_batch(self, batch: list[Sequence]) -> int:
+        """Draft-then-verify for the whole decode batch (Leviathan et
+        al.): the draft proposes up to k greedy tokens per slot, the
+        target scores all k+1 positions in ONE cache-aware extend
+        forward, and each slot commits the longest prefix on which the
+        draft agreed with the target — plus the target's own next
+        token (the bonus on full acceptance, the correction on the
+        first rejection). Every committed token is the target's argmax
+        in its true greedy context, so outputs are EXACTLY the
+        non-speculative ones. Returns tokens committed."""
+        k, B, W = self.spec_k, self.max_slots, self.window
+        E, S = k + 1, self.max_seq_len
+        spans = {seq.slot: self._spec_span(seq) for seq in batch}
+
+        # 1. draft proposals: k batched greedy steps, full recompute
+        toks = np.zeros((B, S), np.int32)
+        lens = np.zeros(B, np.int32)
+        for seq in batch:
+            hist = list(seq.request.tokens) + seq.generated
+            toks[seq.slot, :len(hist)] = hist
+            lens[seq.slot] = len(hist)
+        proposals = np.zeros((B, k), np.int32)
+        for i in range(k):
+            nxt = np.asarray(self._draft(self._draft_params,
+                                         jnp.asarray(toks),
+                                         jnp.asarray(lens)))
+            proposals[:, i] = nxt
+            can = lens < S
+            toks[np.arange(B)[can], lens[can]] = nxt[can]
+            lens[can] += 1
+
+        # 2. verify all k+1 positions in one extend forward
+        tokens = np.zeros((B, E), np.int32)
+        positions = np.full((B, E), W, np.int32)   # pad -> masked query
+        lengths = np.zeros(B, np.int32)
+        write_rows = np.zeros((B, E), np.int32)    # pad -> trash row
+        window_rows = np.zeros((B, W), np.int32)
+        for seq in batch:
+            s, L, ke = seq.slot, seq.length, spans[seq.slot]
+            if self.prefix_caching:
+                self._apply_copies(seq.table.ensure_writable(
+                    L - 1, L + ke, self.scheduler.allocator))
+            tokens[s, 0] = seq.last_token
+            tokens[s, 1:ke + 1] = proposals[s, :ke]
+            positions[s, :ke + 1] = np.arange(L - 1, L + ke)
+            lengths[s] = L + ke
+            write_rows[s, :ke + 1] = [seq.table.row_of(p)
+                                      for p in range(L - 1, L + ke)]
+            window_rows[s] = seq.table.window_rows()
+        logits, self.pool = self._extend_spec(
+            self.params, self.pool, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(lengths),
+            jnp.asarray(write_rows), jnp.asarray(window_rows))
+        target_next = np.asarray(jnp.argmax(logits, axis=-1))  # (B, E)
+
+        # 3. commit the agreeing prefix + the target's next token
+        emit = telemetry.enabled()
+        committed_total = 0
+        for seq in batch:
+            s, ke = seq.slot, spans[seq.slot]
+            j = 0
+            while j < ke and proposals[s, j] == target_next[s, j]:
+                j += 1
+            self._m_spec_proposed.increment(ke)
+            self._m_spec_accepted.increment(j)
+            self._spec_proposed_n += ke
+            self._spec_accepted_n += j
+            for t in target_next[s, :j + 1]:
+                self.scheduler.append_token(seq, int(t))
+                committed_total += 1
+                if emit:
+                    self._emit_token(seq)
+                if seq.done:
+                    break
+        return committed_total
 
     def step(self) -> list[dict]:
         """One continuous-batching iteration; returns completion records
@@ -345,14 +605,34 @@ class InferenceEngine:
             # scoring requests (max_new_tokens=0) finish at prefill
             for seq in list(sched.finished()):
                 finished.append(self._complete(seq))
-            batch = sched.grow_for_decode() if self._decode else []
-            if batch:
-                self._decode_batch(batch)
+            if self._decode is None:
+                batch = []
+            elif self.spec_k:
+                spec_before = self._spec_proposed_n
+                acc_before = self._spec_accepted_n
+                batch = sched.grow_for_decode(
+                    lambda s: self._spec_span(s) + 1)
+                if batch:
+                    self._speculative_batch(batch)
+                sp["proposed_drafts"] = (self._spec_proposed_n
+                                         - spec_before)
+                sp["accepted_drafts"] = (self._spec_accepted_n
+                                         - acc_before)
+            else:
+                batch = sched.grow_for_decode()
+                if batch:
+                    self._decode_batch(batch)
             sp["admitted"] = len(admitted)
             sp["decoded"] = len(batch)
             sp["finished"] = len(finished)
             sp["queued"] = len(sched.queue)
             sp["blocks_free"] = sched.allocator.num_free
+            if admitted:
+                cached = sum(s.cached_tokens for s in admitted)
+                if cached:
+                    sp["cached_tokens"] = cached
+            if sched.prefix_cache is not None:
+                self._m_cache_blocks.set(len(sched.prefix_cache))
         self._step_idx += 1
         step_s = time.monotonic() - t0
         self._m_step.record(step_s)
@@ -440,7 +720,7 @@ class InferenceEngine:
 
     def stats(self) -> dict:
         sched = self.scheduler
-        return {
+        out = {
             "steps": self._step_idx,
             "running": len(sched.running),
             "queued": len(sched.queue),
@@ -453,4 +733,17 @@ class InferenceEngine:
             "tokens_generated": self._m_tokens.value,
             "tokens_replayed": self._m_replayed.value,
             "serve_time_s": self._m_step.export().get("sum", 0.0),
+            "kv_dtype": str(jnp.dtype(self.cache_cfg.dtype).name),
         }
+        if sched.prefix_cache is not None:
+            out["prefix_cache"] = sched.prefix_cache.stats()
+        if self.spec_k:
+            prop = self._spec_proposed_n
+            out["speculative"] = {
+                "k": self.spec_k,
+                "proposed": prop,
+                "accepted": self._spec_accepted_n,
+                "accepted_rate": (self._spec_accepted_n / prop
+                                  if prop else 0.0),
+            }
+        return out

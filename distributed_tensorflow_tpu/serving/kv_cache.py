@@ -12,9 +12,12 @@ Two layers:
 
 - **Host side** — :class:`BlockAllocator` (the free list; physical
   block 0 is reserved as the *trash block*: padded positions of every
-  sequence write there and reads from it are always masked) and
+  sequence write there and reads from it are always masked),
   :class:`BlockTable` (a sequence's logical-position → physical-block
-  map plus the flat pool indices the device gather/scatter consume).
+  map plus the flat pool indices the device gather/scatter consume) and
+  :class:`PrefixCache` (cross-request block SHARING: committed prompt
+  prefixes indexed by content so later requests with the same prefix
+  adopt the blocks instead of recomputing prefill — see below).
 - **Device side** — the pool itself, ``(n_layers, num_blocks *
   block_size, n_heads, head_dim)`` per K and V (:func:`init_pool`),
   flat over the block dimension so position ``p`` of a sequence maps to
@@ -23,6 +26,25 @@ Two layers:
   training shards heads on) and the pool is replicated over ``dp`` —
   ``dp`` shards the decode batch's slots, and every slot's gather may
   touch any block (:func:`pool_shardings`).
+
+**Sharing & refcounts.** Blocks are reference-counted:
+:meth:`BlockAllocator.alloc` hands out blocks at refcount 1,
+:meth:`BlockAllocator.incref` adds an owner, and
+:meth:`BlockAllocator.free` DECREFS — a block only returns to the free
+list when its last owner lets go, so freeing a shared block is safe by
+construction (and freeing an unowned block still raises). The
+:class:`PrefixCache` holds one reference per cached block; sequences
+that hash-match a prefix hold their own. A shared block is never
+written in place: :meth:`BlockTable.ensure_writable` copies it first
+(copy-on-write), so a request diverging after a shared prefix cannot
+corrupt its siblings' cache.
+
+**Quantized pools.** ``CacheConfig(kv_dtype=)`` selects the pool's
+storage dtype: ``"f32"`` (reference), ``"bf16"`` (plain cast, 2x the
+slots) or ``"int8"`` (quantize-on-write with one f32 scale per
+quantisation block — a block here is one head's ``head_dim`` vector of
+one pool row — dequantize-on-gather; 2-3.8x the slots depending on
+``head_dim``, see :meth:`CacheConfig.bytes_per_token`).
 """
 
 from __future__ import annotations
@@ -39,6 +61,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 #: scatter here and masked attention never reads it.
 TRASH_BLOCK = 0
 
+#: CacheConfig(kv_dtype=) spellings -> storage dtype.
+KV_DTYPES = {
+    "f32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
 
 class OutOfBlocksError(RuntimeError):
     """The pool cannot satisfy an allocation (admission must wait or a
@@ -47,7 +76,12 @@ class OutOfBlocksError(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class CacheConfig:
-    """Shape of the device-side KV pool."""
+    """Shape of the device-side KV pool.
+
+    ``kv_dtype`` (``"f32"``/``"bf16"``/``"int8"``) overrides ``dtype``
+    by name; ``"int8"`` switches the pool to quantized storage with
+    per-(row, head) f32 scales (:func:`init_pool` adds ``k_scale`` /
+    ``v_scale`` arrays)."""
 
     n_layers: int
     n_heads: int
@@ -55,6 +89,7 @@ class CacheConfig:
     num_blocks: int
     block_size: int = 16
     dtype: object = jnp.float32
+    kv_dtype: str | None = None
 
     def __post_init__(self):
         if self.num_blocks < 2:
@@ -62,6 +97,16 @@ class CacheConfig:
                              "reserved trash block)")
         if self.block_size < 1:
             raise ValueError("block_size must be >= 1")
+        if self.kv_dtype is not None:
+            if self.kv_dtype not in KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype={self.kv_dtype!r}; expected one of "
+                    f"{sorted(KV_DTYPES)}")
+            object.__setattr__(self, "dtype", KV_DTYPES[self.kv_dtype])
+
+    @property
+    def quantized(self) -> bool:
+        return jnp.dtype(self.dtype) == jnp.dtype(jnp.int8)
 
     @property
     def usable_blocks(self) -> int:
@@ -72,35 +117,61 @@ class CacheConfig:
         """Cache capacity in tokens (across all sequences)."""
         return self.usable_blocks * self.block_size
 
+    @property
+    def bytes_per_token(self) -> int:
+        """Pool bytes one cached token costs (K + V, scales included
+        for quantized dtypes) — the slots-per-chip arithmetic behind
+        the README's KV-dtype table."""
+        per = 2 * self.n_heads * self.head_dim \
+            * jnp.dtype(self.dtype).itemsize
+        if self.quantized:
+            per += 2 * self.n_heads * 4          # f32 scale per head
+        return per
+
+    def blocks_for_budget(self, pool_bytes: int) -> int:
+        """Usable blocks (+1 trash) a device-memory budget affords at
+        this dtype — how ``kv_dtype="int8"`` turns into 2x+ servable
+        slots at an equal byte budget."""
+        per_block = self.block_size * self.bytes_per_token
+        return max(0, pool_bytes // per_block)
+
     def blocks_for(self, n_tokens: int) -> int:
         return max(1, math.ceil(n_tokens / self.block_size))
 
     @classmethod
     def for_model(cls, model_cfg, *, num_blocks: int,
-                  block_size: int = 16, dtype=None) -> "CacheConfig":
+                  block_size: int = 16, dtype=None,
+                  kv_dtype: str | None = None) -> "CacheConfig":
         """Pool sized for a TransformerConfig-shaped model config."""
         return cls(n_layers=model_cfg.n_layers, n_heads=model_cfg.n_heads,
                    head_dim=model_cfg.head_dim, num_blocks=num_blocks,
                    block_size=block_size,
-                   dtype=dtype if dtype is not None else model_cfg.dtype)
+                   dtype=dtype if dtype is not None else model_cfg.dtype,
+                   kv_dtype=kv_dtype)
 
 
 class BlockAllocator:
-    """Free-list over the physical blocks of one pool.
+    """Refcounted free-list over the physical blocks of one pool.
 
     Blocks are interchangeable fixed-size units, so there is no external
     fragmentation by construction — any free block satisfies any
     request; the only waste is internal (the tail of a sequence's last
     block), bounded by ``block_size - 1`` tokens per sequence.
     Allocation is lowest-id-first so reuse is deterministic
-    (test- and replay-friendly)."""
+    (test- and replay-friendly).
+
+    Every owner of a block — the sequence that allocated it, each later
+    sequence sharing it, the prefix cache — holds one reference:
+    :meth:`free` decrefs and only the LAST owner's free returns the
+    block to the pool. Freeing a block nobody owns is still a
+    programming error and raises."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved)")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, TRASH_BLOCK, -1))
-        self._allocated: set[int] = set()
+        self._refs: dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
@@ -108,10 +179,15 @@ class BlockAllocator:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        return len(self._refs)
+
+    def refcount(self, block: int) -> int:
+        """Live references on ``block`` (0 = free). Refcount > 1 means
+        SHARED: writers must copy first (BlockTable.ensure_writable)."""
+        return self._refs.get(block, 0)
 
     def alloc(self, n: int) -> list[int]:
-        """``n`` blocks, lowest ids first; raises
+        """``n`` blocks at refcount 1, lowest ids first; raises
         :class:`OutOfBlocksError` (allocating nothing) when fewer than
         ``n`` are free."""
         if n < 0:
@@ -121,23 +197,36 @@ class BlockAllocator:
                 f"need {n} blocks, {len(self._free)} free "
                 f"(of {self.num_blocks - 1} usable)")
         out = [self._free.pop() for _ in range(n)]
-        self._allocated.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
+    def incref(self, block: int) -> None:
+        """Add an owner to an allocated block (prefix-cache sharing)."""
+        if block not in self._refs:
+            raise ValueError(f"incref of unallocated block {block}")
+        self._refs[block] += 1
+
     def free(self, blocks) -> None:
-        """Return blocks to the pool. Double-free and freeing the trash
-        block are programming errors and raise."""
+        """Drop one reference per block; a block whose LAST reference
+        is dropped returns to the pool. Freeing an unowned block (true
+        double-free) and freeing the trash block raise."""
         blocks = list(blocks)
         for b in blocks:
             if b == TRASH_BLOCK:
                 raise ValueError("cannot free the reserved trash block")
-            if b not in self._allocated:
+            if b not in self._refs:
                 raise ValueError(f"double free of block {b}")
-        for b in sorted(blocks, reverse=True):
-            self._allocated.remove(b)
-            self._free.append(b)
-        # keep lowest-id-first allocation order deterministic
-        self._free.sort(reverse=True)
+        released = []
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                released.append(b)
+        if released:
+            self._free.extend(released)
+            # keep lowest-id-first allocation order deterministic
+            self._free.sort(reverse=True)
 
 
 class BlockTable:
@@ -171,6 +260,30 @@ class BlockTable:
                 f"{self.max_blocks}")
         self.blocks.extend(allocator.alloc(grow))
 
+    def ensure_writable(self, start: int, end: int,
+                        allocator: BlockAllocator) -> list[tuple]:
+        """Copy-on-write: every block covering logical positions
+        ``[start, end)`` that is SHARED (refcount > 1 — a prefix-cache
+        entry or a sibling sequence also owns it) is swapped for a
+        private fresh block. Returns ``(src_row0, dst_row0, n_rows)``
+        device copy instructions the engine must apply to the pool
+        BEFORE writing — the copy preserves the shared prefix content
+        that precedes the divergent write inside the block."""
+        if end <= start or not self.blocks:
+            return []
+        bs = self.cfg.block_size
+        lo = start // bs
+        hi = min(len(self.blocks) - 1, (end - 1) // bs)
+        copies = []
+        for bi in range(lo, hi + 1):
+            b = self.blocks[bi]
+            if allocator.refcount(b) > 1:
+                new = allocator.alloc(1)[0]
+                copies.append((b * bs, new * bs, bs))
+                self.blocks[bi] = new
+                allocator.free([b])          # drop OUR ref; others keep it
+        return copies
+
     def row_of(self, position: int) -> int:
         """Flat pool row of logical ``position``."""
         bs = self.cfg.block_size
@@ -199,24 +312,205 @@ class BlockTable:
         self.length = 0
 
 
+class _CacheEntry:
+    __slots__ = ("key", "parent", "block", "tokens", "last_used")
+
+    def __init__(self, key, parent, block, tokens, last_used):
+        self.key = key
+        self.parent = parent
+        self.block = block
+        self.tokens = tokens
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Content index over committed prompt-prefix blocks (cross-request
+    KV reuse — the vLLM "automatic prefix caching" idea on this pool).
+
+    **Granularity.** The index key of a block is the CHAIN
+    ``(parent_key, block_tokens)``: a hit certifies the entire prefix
+    up to and including that block, not just the block's own
+    ``block_size`` tokens, so matching is a plain walk down the chain.
+    The last hop may be a *partial* match — a cached block whose tokens
+    merely START with the remaining prompt — which is what makes
+    copy-on-write real: the matching sequence will later write its own
+    tokens into that block's tail, and ``BlockTable.ensure_writable``
+    copies the block first.
+
+    **References.** The cache holds ONE allocator reference per entry;
+    :meth:`match` bumps each returned block once more (the caller —
+    the admitting sequence — owns those refs and drops them via the
+    normal ``BlockTable.release``). Eviction (:meth:`evict`) is LRU
+    over entries with NO references beyond the cache's own
+    (refcount == 1) and only over chain LEAVES, so an entry a running
+    sequence shares — or one a cached longer chain still hangs off —
+    is never reclaimed out from under its users.
+
+    At most ``len(prompt) - 1`` tokens ever match: prefill must compute
+    at least the final prompt position to produce the first generated
+    token's logits."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = block_size
+        self._entries: dict[tuple, _CacheEntry] = {}
+        self._children: dict[object, set] = {}
+        self._clock = 0
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.hit_requests = 0
+        self.lookups = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, tokens) -> tuple[int, list[int]]:
+        """``(n_cached_tokens, blocks)`` — the longest cached chain
+        over ``tokens[:-1]``. Full blocks match by chain key; one final
+        partial hop may match a cached block whose tokens extend the
+        prompt's sub-block tail. Every returned block's refcount is
+        bumped; the caller owns (and must eventually free) those refs.
+        """
+        tokens = tuple(int(t) for t in tokens)
+        limit = len(tokens) - 1
+        self._clock += 1
+        self.lookups += 1
+        self.lookup_tokens += max(0, limit)
+        bs = self.block_size
+        key = None
+        blocks: list[int] = []
+        n = 0
+        while n + bs <= limit:
+            k = (key, tokens[n:n + bs])
+            e = self._entries.get(k)
+            if e is None:
+                break
+            e.last_used = self._clock
+            self._alloc.incref(e.block)
+            blocks.append(e.block)
+            key = k
+            n += bs
+        if 0 < limit - n < bs:
+            rest = tokens[n:limit]
+            best = None
+            for ck in self._children.get(key, ()):
+                e = self._entries[ck]
+                if e.tokens[:len(rest)] == rest and (
+                        best is None or e.last_used > best.last_used):
+                    best = e
+            if best is not None:
+                best.last_used = self._clock
+                self._alloc.incref(best.block)
+                blocks.append(best.block)
+                n += len(rest)
+        if n:
+            self.hit_tokens += n
+            self.hit_requests += 1
+        return n, blocks
+
+    def register(self, tokens, blocks) -> int:
+        """Index every FULL block of a just-prefilled prompt
+        (``blocks`` = the sequence's BlockTable blocks, which hold
+        exactly these tokens' K/V — shared hits included, and
+        post-copy-on-write for a partially-matched tail). Newly
+        inserted entries gain one cache-owned reference. Returns the
+        number of new entries."""
+        tokens = tuple(int(t) for t in tokens)
+        self._clock += 1
+        bs = self.block_size
+        key = None
+        added = 0
+        for i in range(len(tokens) // bs):
+            btoks = tokens[i * bs:(i + 1) * bs]
+            k = (key, btoks)
+            e = self._entries.get(k)
+            if e is None:
+                self._alloc.incref(blocks[i])
+                e = _CacheEntry(k, key, blocks[i], btoks, self._clock)
+                self._entries[k] = e
+                self._children.setdefault(key, set()).add(k)
+                added += 1
+            else:
+                e.last_used = self._clock
+            key = k
+        return added
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` pool blocks by dropping
+        least-recently-used UNREFERENCED leaf entries (allocator
+        refcount 1 — only the cache's own reference — and no cached
+        children). Entries referenced by running sequences are never
+        evicted. Returns how many blocks actually went back to the
+        pool."""
+        freed = 0
+        while freed < n_blocks:
+            victim = None
+            for e in self._entries.values():
+                if self._children.get(e.key):
+                    continue                 # interior of a cached chain
+                if self._alloc.refcount(e.block) != 1:
+                    continue                 # a sequence still shares it
+                if victim is None or e.last_used < victim.last_used:
+                    victim = e
+            if victim is None:
+                break
+            del self._entries[victim.key]
+            kids = self._children.get(victim.parent)
+            if kids is not None:
+                kids.discard(victim.key)
+                if not kids:
+                    del self._children[victim.parent]
+            self._alloc.free([victim.block])
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "lookups": self.lookups,
+            "hit_requests": self.hit_requests,
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "hit_rate": (self.hit_tokens / self.lookup_tokens
+                         if self.lookup_tokens else 0.0),
+            "evictions": self.evictions,
+        }
+
+
 def init_pool(cache_cfg: CacheConfig, mesh=None):
-    """Zero-initialized ``{"k", "v"}`` pools, placed with
-    :func:`pool_shardings` when a mesh is given."""
-    shape = (cache_cfg.n_layers,
-             cache_cfg.num_blocks * cache_cfg.block_size,
-             cache_cfg.n_heads, cache_cfg.head_dim)
+    """Zero-initialized ``{"k", "v"}`` pools (plus ``k_scale`` /
+    ``v_scale`` per-(row, head) f32 scales when the config is int8-
+    quantized), placed with :func:`pool_shardings` when a mesh is
+    given."""
+    rows = cache_cfg.num_blocks * cache_cfg.block_size
+    shape = (cache_cfg.n_layers, rows, cache_cfg.n_heads,
+             cache_cfg.head_dim)
     pool = {"k": jnp.zeros(shape, cache_cfg.dtype),
             "v": jnp.zeros(shape, cache_cfg.dtype)}
+    if cache_cfg.quantized:
+        sshape = (cache_cfg.n_layers, rows, cache_cfg.n_heads)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
     if mesh is not None:
-        sh = pool_shardings(mesh)
-        pool = {n: jax.device_put(a, sh) for n, a in pool.items()}
+        sh = pool_shardings(mesh, cache_cfg)
+        pool = {n: jax.device_put(a, sh[n]) for n, a in pool.items()}
     return pool
 
 
-def pool_shardings(mesh) -> NamedSharding:
-    """Cache layout on a serving mesh: heads over ``tp`` (matching the
-    training-side head sharding), rows replicated — ``dp`` shards the
-    decode batch's SLOTS, and any slot's block gather may touch any
-    physical row, so the row axis stays unsharded."""
+def pool_shardings(mesh, cache_cfg: CacheConfig | None = None) -> dict:
+    """Cache layout on a serving mesh, one NamedSharding per pool
+    array: heads over ``tp`` (matching the training-side head
+    sharding), rows replicated — ``dp`` shards the decode batch's
+    SLOTS, and any slot's block gather may touch any physical row, so
+    the row axis stays unsharded. Quantisation scales follow their
+    pool's head axis."""
     head_axis = "tp" if "tp" in mesh.shape else None
-    return NamedSharding(mesh, P(None, None, head_axis, None))
+    kv = NamedSharding(mesh, P(None, None, head_axis, None))
+    out = {"k": kv, "v": kv}
+    if cache_cfg is not None and cache_cfg.quantized:
+        sc = NamedSharding(mesh, P(None, None, head_axis))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out

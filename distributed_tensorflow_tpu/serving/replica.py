@@ -160,7 +160,10 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                     engine_kwargs: dict | None = None,
                     ckpt_dir: str | None = None,
                     step_delay_s: float = 0.0,
-                    spike: dict | None = None):
+                    spike: dict | None = None,
+                    prefix_caching: bool = False,
+                    speculative_k: int = 0,
+                    kv_dtype: str | None = None):
     """One generation of one supervised serving replica.
 
     Serves the seeded workload to completion, heartbeating every engine
@@ -172,8 +175,17 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     open-loop timed workload: requests are submitted when their arrival
     time passes (relative to the shared :func:`run_epoch`), latency is
     measured from the true arrival, and the supervisor's drain flag is
-    honored every step (drain-before-stop). Returns ``(task_index,
-    n_served_this_generation, n_total_completed)``."""
+    honored every step (drain-before-stop).
+
+    ``prefix_caching`` / ``speculative_k`` / ``kv_dtype`` switch on the
+    engine's serving-speed optimisations. All three are OUTPUT-
+    invariant for greedy decode (speculation exactly, prefix caching
+    byte-identically, int8 within the probed bound — see the README
+    KV-dtype table), so the cross-generation byte-identical-duplicates
+    gate holds with them enabled, and a restarted incarnation simply
+    rebuilds its prefix cache cold: correctness never depends on cache
+    state. Returns ``(task_index, n_served_this_generation,
+    n_total_completed)``."""
     from distributed_tensorflow_tpu.cluster import bootstrap, elastic
 
     # join the distributed runtime exactly like an elastic trainer:
@@ -234,7 +246,10 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     cfg = TransformerConfig.tiny(max_seq_len=64)
     kwargs = dict(num_blocks=48, block_size=8, max_slots=4,
                   max_prompt_len=16,
-                  queue_capacity=len(workload) + 1)
+                  queue_capacity=len(workload) + 1,
+                  prefix_caching=prefix_caching,
+                  speculative_k=speculative_k,
+                  kv_dtype=kv_dtype)
     kwargs.update(engine_kwargs or {})
     if ckpt_dir:
         engine = InferenceEngine.from_checkpoint(cfg, ckpt_dir, **kwargs)

@@ -12,12 +12,26 @@ STEP boundaries, not request boundaries. Each engine step the scheduler
    prompt cannot stall every running sequence's next token;
 3. hands the engine the prefill list + the decode batch.
 
-Cache pressure is handled by preemption, newest-first: when a running
-sequence cannot grow into a new block (pool exhausted), the
-most-recently admitted sequence is pushed back to the FRONT of the
-admission queue with its blocks freed (its generated tokens are kept
-and replayed as part of the prompt on re-admission), so the oldest
-requests always finish first and the engine never deadlocks.
+Cache pressure is handled in two stages: first the prefix cache (when
+one is attached) evicts unreferenced cached blocks LRU-first, then
+preemption kicks in, newest-first: when a running sequence cannot grow
+into a new block (pool exhausted), the most-recently admitted sequence
+is pushed back to the FRONT of the admission queue with its blocks
+freed (its generated tokens are kept and replayed as part of the
+prompt on re-admission), so the oldest requests always finish first
+and the engine never deadlocks.
+
+**Prefix caching** (``prefix_caching=True``): at admission each
+request's prompt is hash-matched against the
+:class:`~distributed_tensorflow_tpu.serving.kv_cache.PrefixCache`;
+matched blocks are adopted (refcounted — the engine then prefills only
+the unmatched suffix), and at prefill commit the prompt's full blocks
+are registered for later requests. A preempted sequence's cached
+prompt blocks survive its release (the cache keeps its reference), so
+replay after preemption usually re-admits straight onto warm blocks.
+Correctness never depends on cache state: a cold cache just means full
+prefill, and shared blocks are copy-on-written before any divergent
+append (kv_cache.BlockTable.ensure_writable).
 
 :class:`AdmissionQueue` is bounded; on overflow it either rejects the
 new request (``policy="reject"``) or evicts the oldest WAITING request
@@ -40,7 +54,8 @@ from typing import Iterable
 
 from distributed_tensorflow_tpu import telemetry
 from distributed_tensorflow_tpu.serving.kv_cache import (
-    BlockAllocator, BlockTable, CacheConfig, OutOfBlocksError)
+    BlockAllocator, BlockTable, CacheConfig, OutOfBlocksError,
+    PrefixCache)
 
 
 class QueueOverflowError(RuntimeError):
@@ -74,10 +89,13 @@ class Sequence:
     """Runtime state of one admitted request."""
 
     def __init__(self, request: Request, slot: int,
-                 table: BlockTable):
+                 table: BlockTable, cached_tokens: int = 0):
         self.request = request
         self.slot = slot
         self.table = table
+        #: leading prompt tokens adopted from the prefix cache — the
+        #: engine prefills only positions cached_tokens..prompt_len-1
+        self.cached_tokens = cached_tokens
         self.generated: list[int] = []
         self.prefilled = False
         self.admitted_s = time.monotonic()
@@ -174,7 +192,8 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, cache_cfg: CacheConfig, *, max_slots: int,
                  max_blocks_per_seq: int, token_budget: int,
-                 queue: AdmissionQueue | None = None):
+                 queue: AdmissionQueue | None = None,
+                 prefix_caching: bool = False):
         self.cache_cfg = cache_cfg
         self.allocator = BlockAllocator(cache_cfg.num_blocks)
         self.queue = queue if queue is not None else AdmissionQueue()
@@ -184,36 +203,55 @@ class ContinuousBatchingScheduler:
         self.running: dict[int, Sequence] = {}      # slot -> sequence
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self.preemptions = 0
+        self.prefix_cache = (PrefixCache(self.allocator,
+                                         cache_cfg.block_size)
+                             if prefix_caching else None)
 
     # -- admission --------------------------------------------------------
     def admit(self) -> list[Sequence]:
         """Admit queued requests for this step under the token budget:
         budget = token_budget - (1 decode token per running seq); each
-        admission consumes its prompt length. Stops at the first request
-        that does not fit (FIFO order is preserved — no starvation of
-        big prompts behind small ones)."""
+        admission consumes the prompt tokens prefill will actually
+        COMPUTE — the unmatched suffix when the prefix cache hits, so
+        cache hits also stretch admission throughput. Stops at the
+        first request that does not fit (FIFO order is preserved — no
+        starvation of big prompts behind small ones)."""
         budget = self.token_budget - len(self.running)
         admitted: list[Sequence] = []
         while self._free_slots and self.queue.peek() is not None:
             req = self.queue.peek()
-            need = len(req.tokens)
+            cached, cblocks = (self.prefix_cache.match(req.tokens)
+                               if self.prefix_cache is not None
+                               else (0, []))
+            need = len(req.tokens) - cached     # prefill computes this
             if need > budget and (admitted or self.running):
+                if cblocks:                 # hand the match refs back
+                    self.allocator.free(cblocks)
                 break                       # never starves: alone it runs
-            blocks_needed = self.cache_cfg.blocks_for(need + 1)
+            blocks_needed = self.cache_cfg.blocks_for(len(req.tokens) + 1)
             if blocks_needed > self.max_blocks_per_seq:
                 # can never fit: fail the request rather than wedge FIFO
+                if cblocks:
+                    self.allocator.free(cblocks)
                 self.queue.pop()
                 raise OutOfBlocksError(
-                    f"request {req.id}: prompt of {need} tokens needs "
-                    f"{blocks_needed} blocks > max_blocks_per_seq="
-                    f"{self.max_blocks_per_seq}")
-            if blocks_needed > self.allocator.num_free:
-                break                       # wait for blocks to free up
+                    f"request {req.id}: prompt of {len(req.tokens)} "
+                    f"tokens needs {blocks_needed} blocks > "
+                    f"max_blocks_per_seq={self.max_blocks_per_seq}")
+            grow = blocks_needed - len(cblocks)
+            if grow > self.allocator.num_free:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.evict(grow - self.allocator.num_free)
+                if grow > self.allocator.num_free:
+                    if cblocks:
+                        self.allocator.free(cblocks)
+                    break                   # wait for blocks to free up
             self.queue.pop()
             slot = self._free_slots.pop()
             table = BlockTable(self.cache_cfg, self.max_blocks_per_seq)
-            table.ensure_room(need + 1, self.allocator)
-            seq = Sequence(req, slot, table)
+            table.blocks = list(cblocks)    # match()'s refs transfer here
+            table.ensure_room(len(req.tokens) + 1, self.allocator)
+            seq = Sequence(req, slot, table, cached_tokens=cached)
             self.running[slot] = seq
             admitted.append(seq)
             budget -= need
@@ -223,18 +261,52 @@ class ContinuousBatchingScheduler:
     def commit_prefill(self, seq: Sequence):
         seq.table.length = seq.prompt_len
         seq.prefilled = True
+        if self.prefix_cache is not None:
+            # index the prompt's full blocks for later requests; the
+            # table holds post-copy-on-write private blocks, so every
+            # registered block really contains these tokens' K/V
+            self.prefix_cache.register(seq.request.tokens,
+                                       seq.table.blocks)
 
-    def grow_for_decode(self) -> list[Sequence]:
-        """Make room for ONE more token in every running prefilled
-        sequence; a sequence that cannot grow triggers newest-first
-        preemption until the growth fits. Returns the decode batch."""
+    def _ensure_room(self, table: BlockTable, n_tokens: int):
+        """``table.ensure_room`` with prefix-cache pressure relief:
+        when the pool is short, evict unreferenced cached blocks before
+        giving up (the caller then falls back to preemption)."""
+        need = self.cache_cfg.blocks_for(table.length + n_tokens)
+        while True:
+            try:
+                table.ensure_room(n_tokens, self.allocator)
+                return
+            except OutOfBlocksError:
+                grow = need - len(table.blocks)
+                if (need <= table.max_blocks
+                        and self.prefix_cache is not None
+                        and grow > self.allocator.num_free
+                        and self.prefix_cache.evict(
+                            grow - self.allocator.num_free) > 0):
+                    continue
+                raise
+
+    def grow_for_decode(self, n_tokens=1) -> list[Sequence]:
+        """Make room for ``n_tokens`` more tokens (an int, or a
+        callable(seq) -> int — speculative decode reserves k+1 per
+        sequence) in every running prefilled sequence; a sequence that
+        cannot grow evicts unreferenced cached blocks first, then
+        triggers newest-first preemption until the growth fits. Returns
+        the decode batch."""
         batch = [s for s in self.running.values() if s.prefilled
                  and not s.done]
         batch.sort(key=lambda s: s.slot)
         for seq in list(batch):
+            if seq not in batch:
+                # preempted by an EARLIER grower this very step: its
+                # table is released — growing it would leak blocks
+                # into a zombie table (regression-tested)
+                continue
+            n = n_tokens(seq) if callable(n_tokens) else n_tokens
             while True:
                 try:
-                    seq.table.ensure_room(1, self.allocator)
+                    self._ensure_room(seq.table, n)
                     break
                 except OutOfBlocksError:
                     victim = self._preempt_newest(exclude=seq)

@@ -45,17 +45,21 @@ def run_local(args):
     if args.telemetry_dir:
         telemetry.configure(args.telemetry_dir)
     cfg = TransformerConfig.tiny(max_seq_len=64)
+    speed_kw = dict(prefix_caching=args.prefix_cache,
+                    speculative_k=args.speculative,
+                    kv_dtype=args.kv_dtype)
     if args.ckpt_dir:
         engine = InferenceEngine.from_checkpoint(
             cfg, args.ckpt_dir, num_blocks=64, block_size=8,
             max_slots=4, max_prompt_len=16,
-            queue_capacity=args.requests + 1)
+            queue_capacity=args.requests + 1, **speed_kw)
     else:
         params = TransformerLM(cfg).init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
         engine = InferenceEngine(cfg, params, num_blocks=64, block_size=8,
                                  max_slots=4, max_prompt_len=16,
-                                 queue_capacity=args.requests + 1)
+                                 queue_capacity=args.requests + 1,
+                                 **speed_kw)
     reqs = seeded_requests(args.seed, args.requests, cfg.vocab_size)
     for r in reqs:
         engine.submit(r)
@@ -118,7 +122,10 @@ def run_elastic(args):
         serving_replica, num_workers=args.workers,
         args=(run_dir, args.requests, args.seed),
         kwargs={"ckpt_dir": args.ckpt_dir,
-                "step_delay_s": args.step_delay},
+                "step_delay_s": args.step_delay,
+                "prefix_caching": args.prefix_cache,
+                "speculative_k": args.speculative,
+                "kv_dtype": args.kv_dtype},
         max_restarts=args.restart_budget, kill_plan=kill_plan,
         generation_timeout_s=args.generation_timeout,
         telemetry_dir=args.telemetry_dir)
@@ -166,6 +173,18 @@ def main():
     ap.add_argument("--step-delay", type=float, default=0.05,
                     help="elastic: per-step pacing seconds (gives "
                          "step-targeted chaos kills a window to land)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable copy-on-write prefix caching "
+                         "(cross-request KV reuse; outputs invariant, "
+                         "restarted replicas rebuild the cache cold)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="speculative decoding: K draft tokens per "
+                         "slot per step (greedy outputs exactly equal "
+                         "non-speculative)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("f32", "bf16", "int8"),
+                    help="KV-pool storage dtype (int8: quantized, "
+                         "2x+ slots per chip)")
     args = ap.parse_args()
 
     if args.write_ckpt:

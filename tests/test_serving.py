@@ -89,6 +89,27 @@ class TestBlockAllocator:
         with pytest.raises(ValueError):
             a.free([TRASH_BLOCK])
 
+    def test_free_of_shared_block_decrefs_not_releases(self):
+        """ISSUE 14 satellite: freeing a SHARED (refcount > 1) block
+        must drop one reference, not return the block to the free list
+        — and double-free detection stays refcount-aware: only freeing
+        past the last reference raises."""
+        a = BlockAllocator(4)
+        [b] = a.alloc(1)
+        a.incref(b)                           # a second owner
+        assert a.refcount(b) == 2
+        free_before = a.num_free
+        a.free([b])                           # first owner lets go
+        assert a.num_free == free_before      # NOT back in the pool
+        assert a.refcount(b) == 1
+        a.free([b])                           # last owner lets go
+        assert a.num_free == free_before + 1
+        assert a.refcount(b) == 0
+        with pytest.raises(ValueError):       # now it IS a double free
+            a.free([b])
+        with pytest.raises(ValueError):       # incref of a free block
+            a.incref(b)
+
     def test_block_table_rows(self):
         cc = CacheConfig(n_layers=1, n_heads=2, head_dim=4,
                          num_blocks=8, block_size=4)

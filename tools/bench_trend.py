@@ -19,10 +19,21 @@ Series:
   (value + mfu/step-time extras when present);
 - ``scaling/<workload>/<metric>/dev<NN>[/sched]`` — every row of each
   ``SCALING_r*.json`` keyed like tools/scaling_sweep.py's row_key;
-- ``serving/<metric>`` + ``serving/p50_latency_ms`` /
-  ``serving/p99_latency_ms`` — the ``SERVING_r*.json`` request-level
-  rows (tools/serve_sweep.py); the latency series gate INVERTED
-  (growth past the fraction fails);
+- ``serving/<metric>/<point>`` + ``serving/p50_latency_ms/<point>`` /
+  ``serving/p99_latency_ms/<point>`` — the ``SERVING_r*.json``
+  request-level rows (tools/serve_sweep.py); the latency series gate
+  INVERTED (growth past the fraction fails). ``<point>`` is the
+  measurement point (``q<qps>r<requests>`` plus any serving-speed
+  config: ``pr<reuse>``/``kv<dtype>``/``sp<k>``), because a round may
+  now carry rows at several traffic points and a p99 at q1000 must
+  never be gated against a p99 at q40 — only same-point rows compare
+  across rounds (r01-era rows, which predate the config fields, key as
+  their plain ``q<qps>r<requests>`` point). Serving-speed columns
+  (ISSUE 14): ``serving/cache_hit_rate/<point>`` and
+  ``serving/accepted_draft_rate/<point>`` gate NON-inverted (a cache
+  or draft that stops earning its keep fails), tolerating their
+  absence in SERVING_r01-era files (the series just starts at the
+  first round that carries them);
 - ``fleet/ops_per_sec/nNNNN`` + ``fleet/detect_ms/nNNNN`` /
   ``fleet/mttr_ms/nNNNN`` — the ``FLEET_r*.json`` simulated-fleet
   control-plane rows per worker count (bench.py --fleet /
@@ -124,10 +135,30 @@ def load_scaling_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     return series
 
 
+def _serving_point(extra: dict) -> str:
+    """The row's measurement point: traffic shape + serving-speed
+    config. Rows only regression-gate against SAME-point rows of other
+    rounds — a p99 measured at q1000 saturation must never be compared
+    with one measured at q40 light load, and a speculative or int8 row
+    is its own series, not a 'regression' of the plain one. r01-era
+    rows (no config fields) key as their plain traffic point."""
+    point = (f"q{extra.get('qps_target', 0):g}"
+             f"r{extra.get('n_requests', 0)}")
+    if extra.get("prefix_reuse"):
+        point += f"pr{extra['prefix_reuse']:g}"
+    kd = extra.get("kv_dtype")
+    if kd and kd not in ("f32", "float32"):
+        point += f"kv{kd}"
+    if extra.get("speculative_k"):
+        point += f"sp{extra['speculative_k']}"
+    return point
+
+
 def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
     """``{series: {round: row}}`` from SERVING_r*.json (ISSUE 9): the
-    throughput row plus latency series carrying ``lower_is_better`` so
-    the regression gate inverts (a p99 that GROWS >10% fails)."""
+    throughput rows plus latency series carrying ``lower_is_better`` so
+    the regression gate inverts (a p99 that GROWS >10% fails), each
+    keyed by its measurement point (:func:`_serving_point`)."""
     series: dict = {}
     for path in sorted(glob.glob(os.path.join(repo, "SERVING_r*.json"))):
         rnd = _round_of(path)
@@ -138,30 +169,41 @@ def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
             continue
         for row in data.get("rows", []):
             extra = row.get("extra") or {}
-            series.setdefault(f"serving/{row.get('metric')}", {})[rnd] = {
+            pt = _serving_point(extra)
+            series.setdefault(f"serving/{row.get('metric')}/{pt}",
+                              {})[rnd] = {
                 "value": row.get("value"),
                 "unit": row.get("unit"),
                 "qps_achieved": extra.get("qps_achieved"),
             }
             for lat in ("p50_latency_ms", "p99_latency_ms"):
                 if isinstance(extra.get(lat), (int, float)):
-                    series.setdefault(f"serving/{lat}", {})[rnd] = {
+                    series.setdefault(f"serving/{lat}/{pt}", {})[rnd] = {
                         "value": extra[lat], "lower_is_better": True}
+            # serving-speed columns (ISSUE 14): hit/acceptance rates
+            # gate NON-inverted; r01-era rows without them simply
+            # don't extend the series
+            for rate in ("cache_hit_rate", "accepted_draft_rate"):
+                if isinstance(extra.get(rate), (int, float)):
+                    series.setdefault(f"serving/{rate}/{pt}",
+                                      {})[rnd] = {
+                        "value": extra[rate]}
             # goodput/badput columns (ISSUE 10) — new rows carry them,
             # historical r01-era files simply don't grow the series
             if isinstance(extra.get("goodput_frac"), (int, float)):
-                series.setdefault("serving/goodput_frac", {})[rnd] = {
+                series.setdefault(f"serving/goodput_frac/{pt}",
+                                  {})[rnd] = {
                     "value": extra["goodput_frac"]}
             if isinstance(extra.get("badput_replay_frac"), (int, float)):
-                series.setdefault("serving/badput_replay_frac",
+                series.setdefault(f"serving/badput_replay_frac/{pt}",
                                   {})[rnd] = {
                     "value": extra["badput_replay_frac"],
                     "lower_is_better": True}
             slo = extra.get("slo")
             p99 = (slo or {}).get("p99_latency") or {}
             if isinstance(p99.get("budget_consumed"), (int, float)):
-                series.setdefault("serving/slo_p99_budget_consumed",
-                                  {})[rnd] = {
+                series.setdefault(
+                    f"serving/slo_p99_budget_consumed/{pt}", {})[rnd] = {
                     "value": p99["budget_consumed"],
                     "lower_is_better": True}
     return series

@@ -28,14 +28,17 @@ seeded goodput fraction.
 
 ``--serve`` sweeps the SERVING replica axis (ISSUE 9): each seed runs a
 supervised serving job (examples/serve_transformer.py --elastic) whose
-replica is SIGKILLed mid-load on a seed-derived schedule. A seed
-survives only when the job completes, ``obs_report --check --require``
-confirms the recovery timeline (``recovery.restart`` +
-``recovery.run_complete``) AND serving traffic (``serve.step``,
-``serve.request``), and the completion logs prove ZERO dropped
-requests: the union of ``served-*.jsonl`` ids equals the full seeded
-request set, with any cross-generation duplicates having generated
-IDENTICAL tokens (deterministic re-serve).
+replica is SIGKILLed mid-load on a seed-derived schedule — with the
+serving-speed features ON (ISSUE 14: ``--prefix-cache --speculative
+2``), so the kill also proves the restarted incarnation rebuilds its
+prefix cache COLD and re-drafts from scratch without changing a single
+token. A seed survives only when the job completes, ``obs_report
+--check --require`` confirms the recovery timeline
+(``recovery.restart`` + ``recovery.run_complete``) AND serving traffic
+(``serve.step``, ``serve.request``), and the completion logs prove
+ZERO dropped requests: the union of ``served-*.jsonl`` ids equals the
+full seeded request set, with any cross-generation duplicates having
+generated IDENTICAL tokens (deterministic re-serve).
 
 ``--data`` sweeps the DISAGGREGATED-INPUT axis (ISSUE 12): each seed
 runs a supervised data-service mnist job (examples/train_mnist.py
@@ -440,6 +443,12 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
            "--requests", str(requests), "--seed", str(seed),
            "--kill-seed", str(seed),
            "--restart-budget", str(budget),
+           # serving-speed features ON under chaos (ISSUE 14): the
+           # SIGKILLed replica restarts with a COLD prefix cache and a
+           # fresh draft, and the zero-dropped / byte-identical-
+           # duplicate gates below prove correctness never depended on
+           # cache or speculation state
+           "--prefix-cache", "--speculative", "2",
            "--run-dir", run_dir, "--telemetry-dir", run_dir]
     t0 = time.monotonic()
     proc = subprocess.run(cmd, cwd=REPO, env=env,

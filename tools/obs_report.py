@@ -110,6 +110,10 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     serve_latency: list[float] = []
     serve_steps = 0
     serve_tokens = 0
+    serve_prompt_tokens = 0
+    serve_cached_tokens = 0
+    serve_drafts_proposed = 0
+    serve_drafts_accepted = 0
 
     # the supervisor writes under pid "supervisor": sort keys as strings
     for pid, events in sorted(events_by_pid.items(), key=lambda kv:
@@ -173,6 +177,19 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                     serve_tokens += int(nt)
             elif name == "serve.step":
                 serve_steps += 1
+                p = ev.get("proposed_drafts")
+                if isinstance(p, (int, float)):
+                    serve_drafts_proposed += int(p)
+                a = ev.get("accepted_drafts")
+                if isinstance(a, (int, float)):
+                    serve_drafts_accepted += int(a)
+            elif name == "serve.prefill":
+                pt = ev.get("prompt_tokens")
+                if isinstance(pt, (int, float)):
+                    serve_prompt_tokens += int(pt)
+                ct = ev.get("cached_tokens")
+                if isinstance(ct, (int, float)):
+                    serve_cached_tokens += int(ct)
             elif name == "stall.suspected":
                 stalls.append({k: ev.get(k) for k in
                                ("pid", "stalled_s", "median_step_s",
@@ -260,6 +277,18 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
             "steps": serve_steps,
             "request_latency": _percentiles(serve_latency),
             "tokens_generated": serve_tokens,
+            # serving-speed telemetry (ISSUE 14): absent fields mean
+            # the feature never fired in this run
+            "prompt_tokens": serve_prompt_tokens,
+            "cache_hit_tokens": serve_cached_tokens,
+            "cache_hit_rate": (round(serve_cached_tokens
+                                     / serve_prompt_tokens, 4)
+                               if serve_prompt_tokens else None),
+            "drafts_proposed": serve_drafts_proposed,
+            "drafts_accepted": serve_drafts_accepted,
+            "accepted_draft_rate": (round(serve_drafts_accepted
+                                          / serve_drafts_proposed, 4)
+                                    if serve_drafts_proposed else None),
         } if (serve_latency or serve_steps) else None,
         "phases": phases_report,
         "goodput": goodput_report,
@@ -451,6 +480,18 @@ def render_text(report: dict, rollup: dict) -> str:
                        f"p95 {_fmt_ms(lat['p95'])}  "
                        f"p99 {_fmt_ms(lat['p99'])}  "
                        f"max {_fmt_ms(lat['max'])}")
+        if sv.get("cache_hit_rate") is not None \
+                and sv.get("cache_hit_tokens"):
+            out.append(f"prefix cache  hit rate "
+                       f"{sv['cache_hit_rate']:.1%} "
+                       f"({sv['cache_hit_tokens']}/"
+                       f"{sv['prompt_tokens']} prompt tokens served "
+                       f"from cache)")
+        if sv.get("drafts_proposed"):
+            out.append(f"speculation   accepted rate "
+                       f"{sv['accepted_draft_rate']:.1%} "
+                       f"({sv['drafts_accepted']}/"
+                       f"{sv['drafts_proposed']} draft tokens)")
     _render_phase_table(report, out)
     gp = report.get("goodput")
     if gp:

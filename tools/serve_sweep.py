@@ -17,7 +17,13 @@ Row contract (what downstream tooling depends on):
 - every benched request completed: ``qps_achieved`` spans exactly
   ``n_requests`` completions (the bench loop cannot exit otherwise,
   so this is implied by the row existing — the gate checks the fields
-  that would expose a silent truncation).
+  that would expose a silent truncation);
+- serving-speed fields (ISSUE 14), when present: ``cache_hit_rate``
+  and ``accepted_draft_rate`` in [0, 1]; a row carrying the same-run
+  caching-off baseline (``baseline_nocache``) must show the WIN — more
+  tokens/s and lower p99 than the baseline — and byte-identical
+  outputs (``outputs_match_nocache``); an int8 row's measured
+  ``kv_quant_max_logit_err`` must be a finite non-negative number.
 
 Usage::
 
@@ -66,6 +72,33 @@ def validate_row(row: dict) -> list[str]:
     if isinstance(p50, (int, float)) and isinstance(p99, (int, float)) \
             and p50 > p99:
         bad.append(f"p50 {p50} > p99 {p99}")
+    for k in ("cache_hit_rate", "accepted_draft_rate"):
+        x = extra.get(k)
+        if x is not None and not (isinstance(x, (int, float))
+                                  and 0.0 <= x <= 1.0):
+            bad.append(f"extra.{k}={x!r} not in [0, 1]")
+    base = extra.get("baseline_nocache")
+    if base is not None:
+        # the acceptance gate: caching must WIN against its same-run
+        # caching-off baseline, and outputs must be byte-identical
+        if extra.get("outputs_match_nocache") is not True:
+            bad.append("outputs_match_nocache is not true — caching "
+                       "changed greedy outputs")
+        bt = base.get("tokens_per_sec")
+        if isinstance(bt, (int, float)) and isinstance(v, (int, float)) \
+                and v <= bt:
+            bad.append(f"cache-on tokens/s {v} <= caching-off "
+                       f"baseline {bt}")
+        bp = base.get("p99_latency_ms")
+        if isinstance(bp, (int, float)) and isinstance(p99, (int, float)) \
+                and p99 >= bp:
+            bad.append(f"cache-on p99 {p99}ms >= caching-off "
+                       f"baseline {bp}ms")
+    err = extra.get("kv_quant_max_logit_err")
+    if err is not None and not (isinstance(err, (int, float))
+                                and 0.0 <= err < float("inf")):
+        bad.append(f"extra.kv_quant_max_logit_err={err!r} not a "
+                   f"finite non-negative number")
     return bad
 
 
@@ -86,7 +119,8 @@ def validate_file(path: str) -> list[str]:
     return bad
 
 
-def run_bench(out_path: str, qps, requests, seed, telemetry_dir) -> int:
+def run_bench(out_path: str, qps, requests, seed, telemetry_dir, *,
+              prefix_reuse=None, kv_dtype=None, speculative=None) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["DTX_TELEMETRY_DIR"] = telemetry_dir
@@ -96,6 +130,12 @@ def run_bench(out_path: str, qps, requests, seed, telemetry_dir) -> int:
         cmd += ["--qps", str(qps)]
     if requests is not None:
         cmd += ["--requests", str(requests)]
+    if prefix_reuse:
+        cmd += ["--prefix-reuse", str(prefix_reuse)]
+    if kv_dtype:
+        cmd += ["--kv-dtype", kv_dtype]
+    if speculative:
+        cmd += ["--speculative", str(speculative)]
     proc = subprocess.run(cmd, cwd=REPO, env=env,
                           stdout=subprocess.PIPE,
                           stderr=subprocess.STDOUT)
@@ -114,6 +154,14 @@ def main(argv=None) -> int:
     ap.add_argument("--qps", type=float, default=None)
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prefix-reuse", type=float, default=None,
+                    help="forward to bench.py --serving: shared-prefix "
+                         "workload fraction (enables prefix caching + "
+                         "the same-run caching-off baseline gate)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("f32", "bf16", "int8"))
+    ap.add_argument("--speculative", type=int, default=None,
+                    metavar="K")
     args = ap.parse_args(argv)
 
     if args.check:
@@ -128,7 +176,10 @@ def main(argv=None) -> int:
 
     tmp = tempfile.mkdtemp(prefix="dtx_serve_sweep_")
     out_path = args.out or os.path.join(tmp, "serving.json")
-    rc = run_bench(out_path, args.qps, args.requests, args.seed, tmp)
+    rc = run_bench(out_path, args.qps, args.requests, args.seed, tmp,
+                   prefix_reuse=args.prefix_reuse,
+                   kv_dtype=args.kv_dtype,
+                   speculative=args.speculative)
     if rc != 0:
         print(f"serve_sweep: bench.py --serving failed (rc={rc})",
               file=sys.stderr)
