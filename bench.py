@@ -1259,6 +1259,217 @@ def run_data_service(out_path: str | None = None, *,
     return rows
 
 
+def run_online(out_path: str | None = None, *, seed: int = 0,
+               total_events: int = 6144):
+    """Online streaming-training bench (ISSUE 15), two phases over the
+    SAME pre-written seeded Zipf event log:
+
+    - **ingest throughput**: drain the log through the real
+      OnlineTrainer (stream tail -> dynamic-table translate -> jit'd
+      grad/apply -> periodic atomic cursor commits), dynamic tables
+      (bounded rows, admission/eviction/growth) vs the conventional
+      STATIC baseline (one vocab-sized hash table per id space) —
+      the claim under test: dynamic sustains equal-or-better events/s
+      with ~2 orders of magnitude fewer rows, and eviction actually
+      fires under the seeded id distribution;
+    - **freshness**: re-run the dynamic config against a PACED producer
+      (60% of measured drain rate) with a live evaluator thread
+      restoring every commit — update→servable p50/p99 seconds and
+      consumer lag (produced - servable offset) percentiles, the
+      numbers the freshness SLO (telemetry/slo.default_online_slos)
+      gates in chaos runs.
+
+    Emits one row per table mode; ``--out`` writes ONLINE_r*.json for
+    tools/bench_trend.py (freshness p50/p99 and lag p99 gated INVERTED,
+    events/s gated normally).
+    """
+    import tempfile
+    import threading
+
+    from distributed_tensorflow_tpu.input import stream as stream_lib
+    from distributed_tensorflow_tpu.models import online_dlrm as od
+
+    # the millions-of-users shape: id universes far beyond any static
+    # table budget; the Zipf head (~300 ids crossing the admission
+    # threshold at this event count) is universe-size-invariant, so
+    # bounded dynamic tables see the same admission/eviction pressure
+    # a production stream produces
+    cfg = od.OnlineConfig(
+        batch_size=16, initial_capacity=64, max_capacity=256,
+        admission_threshold=2, ttl_steps=128, seed=seed,
+        n_users=500_000, n_items=100_000)
+    base = tempfile.mkdtemp(prefix="bench_online_")
+    log = os.path.join(base, stream_lib.LOG_NAME)
+    writer = stream_lib.StreamWriter.open(log)
+    while writer.next_offset < total_events:
+        n = min(512, total_events - writer.next_offset)
+        stream_lib.append_chunk(writer, stream_lib.seeded_events(
+            seed, writer.next_offset, n, n_users=cfg.n_users,
+            n_items=cfg.n_items, n_dense=cfg.n_dense,
+            zipf_a=cfg.zipf_a))
+    writer.close()
+
+    def drain(static: bool, tag: str) -> dict:
+        trainer = od.OnlineTrainer(
+            cfg, log, os.path.join(base, f"ckpt_{tag}"),
+            commit_every=24, static_tables=static)
+        trainer.restore()
+        summary = trainer.run(total_events, idle_timeout_s=30.0)
+        summary["rows_total"] = (trainer.user_table.capacity
+                                 + trainer.item_table.capacity)
+        return summary
+
+    dyn = drain(False, "dyn")
+    static_cfg_rows = cfg.n_users + cfg.n_items
+    # the conventional baseline: vocab-sized static hash tables (one
+    # row budget per possible id, the pre-dynamic-table answer)
+    from distributed_tensorflow_tpu.embedding.dynamic import (
+        StaticHashTable)
+    stat_trainer = od.OnlineTrainer(
+        cfg, log, os.path.join(base, "ckpt_static"),
+        commit_every=24, static_tables=True)
+    stat_trainer.user_table = StaticHashTable(
+        cfg.embed_dim, cfg.n_users, seed=seed, name="user")
+    stat_trainer.item_table = StaticHashTable(
+        cfg.embed_dim, cfg.n_items, seed=seed + 1, name="item")
+    stat_trainer.restore()
+    stat = stat_trainer.run(total_events, idle_timeout_s=30.0)
+    stat["rows_total"] = (stat_trainer.user_table.capacity
+                          + stat_trainer.item_table.capacity)
+
+    # -- freshness phase: paced producer + live evaluator -----------------
+    fresh_base = os.path.join(base, "fresh")
+    os.makedirs(fresh_base, exist_ok=True)
+    flog = os.path.join(fresh_base, stream_lib.LOG_NAME)
+    fckpt = os.path.join(fresh_base, "ckpt")
+    pace_eps = max(200.0, 0.6 * (dyn["events_per_sec"] or 1000.0))
+    fresh_events = min(total_events, 2048)
+    chunk = 64
+
+    def producer():
+        w = stream_lib.StreamWriter.open(flog)
+        while w.next_offset < fresh_events:
+            n = min(chunk, fresh_events - w.next_offset)
+            stream_lib.append_chunk(w, stream_lib.seeded_events(
+                seed, w.next_offset, n, n_users=cfg.n_users,
+                n_items=cfg.n_items, n_dense=cfg.n_dense,
+                zipf_a=cfg.zipf_a))
+            time.sleep(n / pace_eps)
+        w.close()
+
+    fresh_samples: list = []
+    lag_samples: list = []
+    stop_eval = threading.Event()
+
+    def evaluator():
+        import numpy as np
+
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint, CheckpointCorruptError, latest_checkpoint)
+        ckpt = Checkpoint(single_writer=True,
+                          online=od.checkpoint_template(cfg))
+        seen: set = set()
+        while not stop_eval.is_set():
+            path = latest_checkpoint(fckpt, "online")
+            if path is None or path in seen:
+                time.sleep(0.02)
+                continue
+            seen.add(path)
+            try:
+                flat = ckpt.restore(path)
+            except (OSError, KeyError, ValueError,
+                    CheckpointCorruptError):
+                continue
+            state = od.unpack_restored(flat)
+            offset = int(np.asarray(state["offset"]))
+            commit_wall = float(np.asarray(state["commit_wall"]))
+            fresh_samples.append(time.time() - commit_wall)
+            lag_samples.append(
+                stream_lib.count_records(flog) - offset)
+            if offset >= fresh_events:
+                return
+
+    prod = threading.Thread(target=producer, daemon=True)
+    ev = threading.Thread(target=evaluator, daemon=True)
+    prod.start()
+    ev.start()
+    fresh_trainer = od.OnlineTrainer(cfg, flog, fckpt, commit_every=8)
+    fresh_trainer.restore()
+    fresh_summary = fresh_trainer.run(fresh_events, idle_timeout_s=30.0)
+    prod.join(timeout=30)
+    ev.join(timeout=30)
+    stop_eval.set()
+
+    def pct(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(round(q / 100 * (len(s) - 1))))]
+
+    shared = {
+        "seed": seed, "events": total_events, "batch_size":
+        cfg.batch_size, "commit_every": 24,
+        "fresh_events": fresh_events,
+        "fresh_pace_eps": round(pace_eps, 1),
+    }
+    rows = []
+    for mode, summary, vs in (("dynamic", dyn,
+                               (dyn["events_per_sec"] or 0)
+                               / max(stat["events_per_sec"] or 1, 1e-9)),
+                              ("static", stat, None)):
+        extra = dict(shared)
+        extra.update({
+            "mode": mode,
+            "rows_total": summary["rows_total"],
+            "loss_last": round(summary["loss_last"], 5),
+            "commits": summary["commits"],
+            "tables": summary["tables"],
+        })
+        if mode == "dynamic":
+            evictions = sum(t["evictions"]
+                            for t in summary["tables"].values())
+            extra.update({
+                "static_rows_total": static_cfg_rows,
+                "eviction_fired": evictions > 0,
+                "admissions": sum(t["admissions"]
+                                  for t in summary["tables"].values()),
+                "evictions": evictions,
+                "grows": sum(t["grows"]
+                             for t in summary["tables"].values()),
+                "freshness_p50_s": (round(pct(fresh_samples, 50), 4)
+                                    if fresh_samples else None),
+                "freshness_p99_s": (round(pct(fresh_samples, 99), 4)
+                                    if fresh_samples else None),
+                "lag_p50_events": pct(lag_samples, 50),
+                "lag_p99_events": pct(lag_samples, 99),
+                "snapshots": len(fresh_samples),
+                "fresh_events_per_sec": round(
+                    fresh_summary["events_per_sec"] or 0, 1),
+            })
+        row = {"metric": "online_events_per_sec",
+               "value": round(summary["events_per_sec"] or 0, 1),
+               "unit": "events/s",
+               "vs_baseline": (round(vs, 3) if vs is not None
+                               else None),
+               "extra": extra}
+        rows.append(row)
+        print(json.dumps(row))
+    from distributed_tensorflow_tpu import telemetry
+    telemetry.event(
+        "online.row", seed=seed,
+        dynamic_eps=rows[0]["value"], static_eps=rows[1]["value"],
+        freshness_p99_s=rows[0]["extra"].get("freshness_p99_s"),
+        evictions=rows[0]["extra"].get("evictions"))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "online", "host_cpus": os.cpu_count(),
+                       "seed": seed, "rows": rows}, f, indent=1)
+            f.write("\n")
+    import shutil
+    shutil.rmtree(base, ignore_errors=True)
+    return rows
+
+
 def run_autoscale(out_path: str | None = None, *, seed: int = 0,
                   keep_dir: bool = False):
     """Closed-loop autoscaling bench (ISSUE 13): one seeded traffic
@@ -1478,7 +1689,8 @@ if __name__ == "__main__":
     parser.add_argument("--workload", default="all",
                         choices=["all", "transformer", "resnet50", "bert",
                                  "input_pipeline", "scaling", "serving",
-                                 "fleet", "data_service", "autoscale"],
+                                 "fleet", "data_service", "autoscale",
+                                 "online"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -1506,6 +1718,15 @@ if __name__ == "__main__":
     parser.add_argument("--data-workers", default=None,
                         help="with --data-service: comma-separated "
                              "input-worker counts (default 1,2,4)")
+    parser.add_argument("--online", action="store_true",
+                        help="run the online streaming-training bench "
+                             "(dynamic vs vocab-sized static tables: "
+                             "ingest events/s, update->servable "
+                             "freshness p50/p99, consumer lag, "
+                             "admission/eviction rates)")
+    parser.add_argument("--events", type=int, default=None,
+                        help="with --online: stream events for the "
+                             "throughput phase (default 6144)")
     parser.add_argument("--autoscale", action="store_true",
                         help="run the closed-loop autoscaling bench "
                              "(seeded spike through a shared "
@@ -1559,6 +1780,9 @@ if __name__ == "__main__":
                          seed=args.seed)
     elif args.autoscale or args.workload == "autoscale":
         run_autoscale(out_path=args.out, seed=args.seed)
+    elif args.online or args.workload == "online":
+        run_online(out_path=args.out, seed=args.seed,
+                   total_events=args.events or 6144)
     elif args.serving or args.workload == "serving":
         run_serving(out_path=args.out, qps=args.qps,
                     n_requests=args.requests, seed=args.seed,

@@ -18,3 +18,9 @@ from distributed_tensorflow_tpu.embedding.embedding import (  # noqa: F401
     create_state,
     lookup,
 )
+from distributed_tensorflow_tpu.embedding.dynamic import (  # noqa: F401
+    CountMinSketch,
+    DynamicTable,
+    DynamicTableConfig,
+    StaticHashTable,
+)

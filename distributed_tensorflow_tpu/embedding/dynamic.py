@@ -1,0 +1,496 @@
+"""Dynamic embedding tables: hash-free id→row membership with
+frequency-capped admission, LFU+TTL eviction, and table growth.
+
+Static :class:`~distributed_tensorflow_tpu.embedding.embedding.
+TableConfig` tables assume the vocabulary is known up front. An online
+recommender's id space is unbounded and Zipf-shaped: most ids are seen
+once or twice and never again, a small head carries most of the
+traffic. :class:`DynamicTable` gives that workload a bounded-memory
+table (ROADMAP item 2):
+
+- **admission** — an id earns a dedicated row only after the
+  frequency sketch has seen it ``admission_threshold`` times; colder
+  ids share the reserved COLD row (row 0), which still trains (it is
+  the learned prior for rare ids).
+- **eviction (LFU+TTL)** — when the table is full, TTL-expired rows
+  (idle longer than ``ttl_steps``) are evicted least-frequent-first;
+  with nothing expired, the LFU row is evicted only when the admission
+  candidate's frequency beats it (no thrash between equals).
+- **growth** — when the mapped load factor crosses
+  ``growth_load_factor`` and ``max_capacity`` allows, the row count
+  DOUBLES; trained rows and their optimizer slot values are preserved
+  bit-for-bit, new rows join the free list.
+
+The row/slot math reuses the per-table optimizers of
+``embedding/embedding.py`` (SGD/Adagrad/Adam/FTRL) applied ROW-SPARSE:
+only the rows a batch touched are gathered, updated, and scattered
+back — untouched rows' slot state is bit-identical afterwards (no
+spurious Adam moment decay, the same contract
+``embedding.apply_gradients`` documents for zero-lookup tables).
+
+Membership IS state: :meth:`DynamicTable.state_dict` packs the id→row
+map, frequency sketch, per-row LFU/TTL bookkeeping and counters next
+to the row/slot arrays under FIXED leaf names, so the table rides the
+existing :class:`~distributed_tensorflow_tpu.checkpoint.checkpoint.
+Checkpoint` / peer-snapshot machinery unchanged and a recovered
+trainer restores *membership*, not just weights.
+
+Row 0 is the shared COLD row; it is never mapped to an id. The jit'd
+sparse apply pads its unique-row index buffer with ``capacity`` — out
+of bounds, so XLA's scatter drops the padding updates (and the paired
+gather clips harmlessly): padding can never perturb a real row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.embedding.embedding import (
+    SGD,
+    Adagrad,
+    _Optimizer,
+)
+
+#: Row 0: shared cold row (sub-threshold ids). Never mapped to an id.
+COLD_ROW = 0
+RESERVED_ROWS = 1
+
+
+class CountMinSketch:
+    """Fixed-memory frequency estimator (conservative overcount): the
+    admission filter's memory stays O(width × depth) no matter how many
+    distinct ids the stream produces."""
+
+    def __init__(self, width: int = 2048, depth: int = 4, seed: int = 0):
+        if width <= 0 or depth <= 0:
+            raise ValueError(f"sketch width/depth must be positive, got "
+                             f"{width}x{depth}")
+        self.width = int(width)
+        self.depth = int(depth)
+        self.seed = int(seed)
+        rng = np.random.default_rng([seed, 0xC0FFEE])
+        # odd multipliers -> full-period multiplicative hashing
+        self._mul = (rng.integers(1, 2**63, size=depth,
+                                  dtype=np.uint64) | np.uint64(1))
+        self._add = rng.integers(0, 2**63, size=depth, dtype=np.uint64)
+        self.counts = np.zeros((depth, self.width), dtype=np.uint32)
+
+    def _slots(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.uint64)
+        out = np.empty((self.depth, len(ids)), dtype=np.int64)
+        for d in range(self.depth):
+            h = ids * self._mul[d] + self._add[d]       # mod 2^64
+            out[d] = ((h >> np.uint64(31))
+                      % np.uint64(self.width)).astype(np.int64)
+        return out
+
+    def add(self, ids: np.ndarray):
+        slots = self._slots(ids)
+        for d in range(self.depth):
+            np.add.at(self.counts[d], slots[d], 1)
+
+    def estimate(self, ids: np.ndarray) -> np.ndarray:
+        slots = self._slots(np.atleast_1d(ids))
+        ests = np.stack([self.counts[d][slots[d]]
+                         for d in range(self.depth)])
+        return ests.min(axis=0).astype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicTableConfig:
+    """One dynamic table. Validation is loud at construction (the
+    static TableConfig discipline): mis-sized tables must not surface
+    as shape errors deep inside a jitted step."""
+
+    dim: int
+    initial_capacity: int = 256
+    max_capacity: int | None = None          # default: 4x initial
+    admission_threshold: int = 2
+    ttl_steps: int = 512
+    growth_load_factor: float = 0.85
+    optimizer: _Optimizer | None = None      # default Adagrad(0.05)
+    name: str = "table"
+    seed: int = 0
+    sketch_width: int = 2048
+    sketch_depth: int = 4
+
+    def __post_init__(self):
+        if self.dim <= 0:
+            raise ValueError(f"table {self.name!r}: dim must be "
+                             f"positive, got {self.dim}")
+        if self.initial_capacity <= RESERVED_ROWS:
+            raise ValueError(
+                f"table {self.name!r}: initial_capacity must exceed "
+                f"the {RESERVED_ROWS} reserved rows, got "
+                f"{self.initial_capacity}")
+        cap = self.max_capacity
+        if cap is not None and cap < self.initial_capacity:
+            raise ValueError(
+                f"table {self.name!r}: max_capacity {cap} < "
+                f"initial_capacity {self.initial_capacity}")
+        if self.admission_threshold < 1:
+            raise ValueError(
+                f"table {self.name!r}: admission_threshold must be "
+                f">= 1, got {self.admission_threshold}")
+        if self.ttl_steps < 1:
+            raise ValueError(f"table {self.name!r}: ttl_steps must be "
+                             f">= 1, got {self.ttl_steps}")
+        if not 0.0 < self.growth_load_factor <= 1.0:
+            raise ValueError(
+                f"table {self.name!r}: growth_load_factor must be in "
+                f"(0, 1], got {self.growth_load_factor}")
+
+    @property
+    def capacity_limit(self) -> int:
+        return (self.max_capacity if self.max_capacity is not None
+                else 4 * self.initial_capacity)
+
+
+#: Fixed pad width for the jitted re-init scatter: pending admissions
+#: flush in chunks of this many rows, so the program compiles once per
+#: table shape instead of once per admission count.
+_REINIT_PAD = 32
+
+
+@jax.jit
+def _jit_gather(table, idx):
+    return table[idx]
+
+
+@functools.lru_cache(maxsize=32)
+def _reinit_fn(opt: _Optimizer):
+    """One fused jitted program re-initializing a chunk of admitted
+    rows AND their optimizer slots (slot init values are constants
+    folded into the program)."""
+
+    @jax.jit
+    def reinit(table, slots, idx, fresh):
+        table = table.at[idx].set(fresh)
+        fresh_slots = opt.init_slots(fresh)
+        slots = {k: slots[k].at[idx].set(fresh_slots[k])
+                 for k in slots}
+        return table, slots
+
+    return reinit
+
+
+@functools.lru_cache(maxsize=32)
+def _sparse_apply_fn(opt: _Optimizer):
+    """Jit'd row-sparse optimizer update, one program per optimizer
+    (shape changes — batch pad width, table growth — retrace under the
+    same jit). ``idx`` entries must be unique except for the padding
+    value (the table's row count — out of bounds, so the scatter drops
+    those updates and the gather clips)."""
+
+    @jax.jit
+    def apply(table, slots, idx, grads, step):
+        rows = table[idx]
+        row_slots = {k: v[idx] for k, v in slots.items()}
+        new_rows, new_slots = opt.apply(rows, grads, row_slots, step)
+        table = table.at[idx].set(new_rows)
+        slots = {k: slots[k].at[idx].set(new_slots[k]) for k in slots}
+        return table, slots
+
+    return apply
+
+
+class DynamicTable:
+    """Bounded-memory id→row embedding table (see module docstring).
+
+    Host-side membership (dict + numpy bookkeeping) decides WHICH row
+    an id resolves to; device-side math (jnp rows/slots, jit'd sparse
+    apply) trains only the rows a batch touched.
+    """
+
+    def __init__(self, cfg: DynamicTableConfig):
+        self.cfg = cfg
+        self.capacity = cfg.initial_capacity
+        self._opt = cfg.optimizer or Adagrad(0.05)
+        self.rows = self._init_rows(0, self.capacity)
+        self.slots = {k: jnp.asarray(v) for k, v in
+                      self._opt.init_slots(self.rows).items()}
+        self.sketch = CountMinSketch(cfg.sketch_width, cfg.sketch_depth,
+                                     seed=cfg.seed)
+        self.id_to_row: dict[int, int] = {}
+        self.row_id = np.full(self.capacity, -1, dtype=np.int64)
+        self.row_freq = np.zeros(self.capacity, dtype=np.int64)
+        self.row_last = np.zeros(self.capacity, dtype=np.int64)
+        self._free = list(range(self.capacity - 1, RESERVED_ROWS - 1, -1))
+        self.step = 0
+        self.admissions = 0
+        self.evictions = 0
+        self.grows = 0
+        self.declined = 0
+
+    # -- init helpers -----------------------------------------------------
+    def _init_rows(self, start: int, n: int) -> jnp.ndarray:
+        """Deterministic truncated-normal-ish init for rows
+        ``start..start+n-1`` (seeded per row block, so growth and
+        re-admission re-initialize reproducibly)."""
+        rng = np.random.default_rng([self.cfg.seed, start, n])
+        return jnp.asarray(rng.normal(
+            0.0, 0.02, size=(n, self.cfg.dim)).astype(np.float32))
+
+    def _flush_reinits(self, pending: "list[tuple[int, int]]"):
+        """Re-initialize all rows admitted by ONE translate call
+        through the JITTED scatter, padded to :data:`_REINIT_PAD` so
+        the program compiles once per table shape (per-admission eager
+        scatters were the dominant cost of the ingest hot path; OOB
+        padding rows are dropped by the scatter)."""
+        if not pending:
+            return
+        for i in range(0, len(pending), _REINIT_PAD):
+            chunk = pending[i:i + _REINIT_PAD]
+            idx = np.full(_REINIT_PAD, self.capacity, dtype=np.int32)
+            idx[:len(chunk)] = [r for r, _ in chunk]
+            fresh = np.zeros((_REINIT_PAD, self.cfg.dim), np.float32)
+            for j, (row, adm) in enumerate(chunk):
+                fresh[j] = np.random.default_rng(
+                    [self.cfg.seed, 0xAD417, row, adm]).normal(
+                    0.0, 0.02, size=self.cfg.dim)
+            self.rows, self.slots = _reinit_fn(self._opt)(
+                self.rows, self.slots, jnp.asarray(idx),
+                jnp.asarray(fresh))
+
+    # -- membership -------------------------------------------------------
+    @property
+    def mapped(self) -> int:
+        return len(self.id_to_row)
+
+    @property
+    def load_factor(self) -> float:
+        return self.mapped / max(1, self.capacity - RESERVED_ROWS)
+
+    def translate(self, ids: np.ndarray, *, train: bool = True
+                  ) -> np.ndarray:
+        """id -> row index for one batch. With ``train``, feeds the
+        frequency sketch, admits ids crossing the threshold (growing or
+        evicting as configured), and updates LFU/TTL bookkeeping.
+        Unmapped ids resolve to the shared COLD row."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if train:
+            self.sketch.add(ids)
+        uniq, counts = np.unique(ids, return_counts=True)
+        row_of: dict[int, int] = {}
+        ests = self.sketch.estimate(uniq) if train else None
+        pending: list[tuple[int, int]] = []
+        for j, uid in enumerate(uniq.tolist()):
+            row = self.id_to_row.get(uid)
+            if row is None and train \
+                    and int(ests[j]) >= self.cfg.admission_threshold:
+                row = self._admit(uid, int(ests[j]), pending)
+            if row is None:
+                row = COLD_ROW
+            elif train:
+                self.row_freq[row] += int(counts[j])
+                self.row_last[row] = self.step
+            row_of[uid] = row
+        self._flush_reinits(pending)
+        return np.asarray([row_of[int(i)] for i in ids], dtype=np.int32)
+
+    def _admit(self, uid: int, est: int,
+               pending: "list[tuple[int, int]]") -> int | None:
+        if not self._free and self.load_factor \
+                >= self.cfg.growth_load_factor:
+            self._grow()
+        if self._free:
+            row = self._free.pop()
+        else:
+            row = self._evict_for(est)
+            if row is None:
+                self.declined += 1
+                return None
+        pending.append((row, self.admissions))
+        self.id_to_row[uid] = row
+        self.row_id[row] = uid
+        self.row_freq[row] = est
+        self.row_last[row] = self.step
+        self.admissions += 1
+        return row
+
+    def _evict_for(self, candidate_est: int) -> int | None:
+        mapped_rows = np.flatnonzero(self.row_id >= 0)
+        if len(mapped_rows) == 0:
+            return None
+        expired = mapped_rows[
+            self.row_last[mapped_rows] < self.step - self.cfg.ttl_steps]
+        pool = expired if len(expired) else mapped_rows
+        victim = int(pool[np.argmin(self.row_freq[pool])])
+        if not len(expired) \
+                and int(self.row_freq[victim]) >= candidate_est:
+            return None          # LFU victim is hotter: decline, no thrash
+        del self.id_to_row[int(self.row_id[victim])]
+        self.row_id[victim] = -1
+        self.row_freq[victim] = 0
+        self.evictions += 1
+        return victim
+
+    def _grow(self):
+        new_cap = self.capacity * 2
+        if new_cap > self.cfg.capacity_limit:
+            return
+        add = new_cap - self.capacity
+        self.rows = jnp.concatenate(
+            [self.rows, self._init_rows(self.capacity, add)])
+        grown = self._opt.init_slots(
+            jnp.zeros((add, self.cfg.dim), jnp.float32))
+        self.slots = {k: jnp.concatenate([v, jnp.asarray(grown[k])])
+                      for k, v in self.slots.items()}
+        self.row_id = np.concatenate(
+            [self.row_id, np.full(add, -1, dtype=np.int64)])
+        self.row_freq = np.concatenate(
+            [self.row_freq, np.zeros(add, dtype=np.int64)])
+        self.row_last = np.concatenate(
+            [self.row_last, np.zeros(add, dtype=np.int64)])
+        self._free = list(range(new_cap - 1, self.capacity - 1, -1)) \
+            + self._free
+        self.capacity = new_cap
+        self.grows += 1
+
+    # -- device math ------------------------------------------------------
+    def gather(self, row_idx: np.ndarray) -> jnp.ndarray:
+        return _jit_gather(self.rows, jnp.asarray(row_idx))
+
+    def apply_row_grads(self, row_idx: np.ndarray, grads: np.ndarray,
+                        *, pad_to: int | None = None):
+        """Row-sparse optimizer update: ``grads[i]`` is the PER-EXAMPLE
+        gradient for ``row_idx[i]``; duplicate rows are summed here,
+        then the unique rows are updated through the table's optimizer
+        and scattered back. Untouched rows (weights AND slots) are
+        bit-identical afterwards. ``pad_to`` fixes the unique-row
+        buffer width so the jit'd program compiles once per width."""
+        row_idx = np.asarray(row_idx)
+        uniq, inv = np.unique(row_idx, return_inverse=True)
+        agg = np.zeros((len(uniq), self.cfg.dim), dtype=np.float32)
+        np.add.at(agg, inv, np.asarray(grads, dtype=np.float32))
+        width = pad_to or len(uniq)
+        if len(uniq) > width:
+            raise ValueError(f"pad_to={width} < {len(uniq)} unique rows")
+        # pad with an OUT-OF-BOUNDS row: XLA drops the scatter updates
+        # for it, so padding never perturbs a real row (not even slot
+        # decay) — works for dynamic AND static tables alike
+        idx = np.full(width, self.capacity, dtype=np.int32)
+        idx[:len(uniq)] = uniq
+        pad_g = np.zeros((width, self.cfg.dim), dtype=np.float32)
+        pad_g[:len(uniq)] = agg
+        self.rows, self.slots = _sparse_apply_fn(self._opt)(
+            self.rows, self.slots, jnp.asarray(idx), jnp.asarray(pad_g),
+            jnp.asarray(self.step, jnp.int32))
+        self.step += 1
+
+    def end_step(self):
+        """Advance the TTL clock without an optimizer update (eval-only
+        batches)."""
+        self.step += 1
+
+    # -- checkpoint state (fixed leaf names) ------------------------------
+    def state_dict(self) -> dict:
+        """Two fixed-name leaves: ``rows`` (the trained table) and
+        ``aux`` (a packed uint8 array holding slots + MEMBERSHIP —
+        id→row map, sketch counts, LFU/TTL bookkeeping, counters), so
+        the table checkpoints under any optimizer without the leaf-name
+        set changing."""
+        aux = {
+            "slots": {k: np.asarray(v) for k, v in self.slots.items()},
+            "capacity": self.capacity,
+            "id_to_row": self.id_to_row,
+            "row_id": self.row_id,
+            "row_freq": self.row_freq,
+            "row_last": self.row_last,
+            "free": list(self._free),
+            "sketch_counts": self.sketch.counts,
+            "step": self.step,
+            "counters": (self.admissions, self.evictions, self.grows,
+                         self.declined),
+        }
+        return {"rows": np.asarray(self.rows),
+                "aux": np.frombuffer(pickle.dumps(aux, protocol=4),
+                                     dtype=np.uint8).copy()}
+
+    def load_state_dict(self, state: dict):
+        rows = np.asarray(state["rows"])
+        aux = pickle.loads(np.asarray(state["aux"],
+                                      dtype=np.uint8).tobytes())
+        self.capacity = int(aux["capacity"])
+        if rows.shape != (self.capacity, self.cfg.dim):
+            raise ValueError(
+                f"table {self.cfg.name!r}: restored rows "
+                f"{rows.shape} != (capacity {self.capacity}, dim "
+                f"{self.cfg.dim})")
+        self.rows = jnp.asarray(rows)
+        self.slots = {k: jnp.asarray(v)
+                      for k, v in aux["slots"].items()}
+        self.id_to_row = {int(k): int(v)
+                          for k, v in aux["id_to_row"].items()}
+        self.row_id = np.asarray(aux["row_id"], dtype=np.int64)
+        self.row_freq = np.asarray(aux["row_freq"], dtype=np.int64)
+        self.row_last = np.asarray(aux["row_last"], dtype=np.int64)
+        self._free = [int(x) for x in aux["free"]]
+        self.sketch.counts = np.asarray(aux["sketch_counts"],
+                                        dtype=np.uint32)
+        self.step = int(aux["step"])
+        (self.admissions, self.evictions, self.grows,
+         self.declined) = (int(x) for x in aux["counters"])
+
+
+class StaticHashTable:
+    """The conventional baseline: a FIXED table with hash-bucketed
+    id→row mapping (collisions and all) — no membership, no admission,
+    no eviction, no growth. Same interface as :class:`DynamicTable`
+    (``translate``/``gather``/``apply_row_grads``/``state_dict``) so
+    the online bench can swap it in for the same-run baseline row."""
+
+    _MIX = np.uint64(0x9E3779B97F4A7C15)
+
+    def __init__(self, dim: int, capacity: int, *,
+                 optimizer: _Optimizer | None = None, seed: int = 0,
+                 name: str = "static"):
+        if dim <= 0 or capacity <= 0:
+            raise ValueError(f"table {name!r}: dim and capacity must "
+                             f"be positive, got {dim}/{capacity}")
+        self.cfg = DynamicTableConfig(
+            dim=dim, initial_capacity=max(capacity, RESERVED_ROWS + 1),
+            name=name, seed=seed, optimizer=optimizer)
+        self.capacity = capacity
+        self._opt = optimizer or SGD(0.05)
+        rng = np.random.default_rng([seed, capacity])
+        self.rows = jnp.asarray(rng.normal(
+            0.0, 0.02, size=(capacity, dim)).astype(np.float32))
+        self.slots = {k: jnp.asarray(v) for k, v in
+                      self._opt.init_slots(self.rows).items()}
+        self.step = 0
+        self.admissions = self.evictions = self.grows = 0
+        self.mapped = capacity
+
+    def translate(self, ids: np.ndarray, *, train: bool = True
+                  ) -> np.ndarray:
+        ids = np.asarray(ids, dtype=np.uint64)
+        return ((ids * self._MIX) >> np.uint64(33)).astype(np.int64) \
+            % self.capacity
+
+    gather = DynamicTable.gather
+    apply_row_grads = DynamicTable.apply_row_grads
+    end_step = DynamicTable.end_step
+
+    def state_dict(self) -> dict:
+        aux = {"slots": {k: np.asarray(v)
+                         for k, v in self.slots.items()},
+               "capacity": self.capacity, "step": self.step}
+        return {"rows": np.asarray(self.rows),
+                "aux": np.frombuffer(pickle.dumps(aux, protocol=4),
+                                     dtype=np.uint8).copy()}
+
+    def load_state_dict(self, state: dict):
+        aux = pickle.loads(np.asarray(state["aux"],
+                                      dtype=np.uint8).tobytes())
+        self.capacity = int(aux["capacity"])
+        self.rows = jnp.asarray(np.asarray(state["rows"]))
+        self.slots = {k: jnp.asarray(v)
+                      for k, v in aux["slots"].items()}
+        self.step = int(aux["step"])

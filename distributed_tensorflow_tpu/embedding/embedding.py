@@ -155,6 +155,19 @@ class TableConfig:
     name: str | None = None
 
     def __post_init__(self):
+        # Loud validation AT CONSTRUCTION (≙ the reference's
+        # TableConfig argument checks, tpu_embedding_v2_utils.py:1205):
+        # a non-positive vocab/dim would otherwise surface as an opaque
+        # XLA shape error deep inside a jitted lookup.
+        if not isinstance(self.vocabulary_size, (int, np.integer)) \
+                or self.vocabulary_size <= 0:
+            raise ValueError(
+                f"table {self.name or '<unnamed>'}: vocabulary_size "
+                f"must be a positive int, got {self.vocabulary_size!r}")
+        if not isinstance(self.dim, (int, np.integer)) or self.dim <= 0:
+            raise ValueError(
+                f"table {self.name or '<unnamed>'}: dim must be a "
+                f"positive int, got {self.dim!r}")
         if self.combiner not in ("sum", "mean", "sqrtn"):
             raise ValueError(f"combiner {self.combiner!r} not in "
                              f"sum/mean/sqrtn")
@@ -166,6 +179,18 @@ class FeatureConfig:
     table: TableConfig
     max_sequence_length: int = 0       # 0 = combiner-reduced output
     name: str | None = None
+
+    def __post_init__(self):
+        if not isinstance(self.table, TableConfig):
+            raise ValueError(
+                f"feature {self.name or '<unnamed>'}: table must be a "
+                f"TableConfig, got {type(self.table).__name__}")
+        if not isinstance(self.max_sequence_length, (int, np.integer)) \
+                or self.max_sequence_length < 0:
+            raise ValueError(
+                f"feature {self.name or '<unnamed>'}: "
+                f"max_sequence_length must be a non-negative int, got "
+                f"{self.max_sequence_length!r}")
 
 
 def _table_name(table: TableConfig, idx: int) -> str:
@@ -327,7 +352,18 @@ def apply_gradients(state: dict, grads: Mapping[str, jax.Array],
                     ) -> dict:
     """Pure per-table update (≙ TPUEmbedding.apply_gradients,
     tpu_embedding_v2.py:754): ``grads`` maps table name -> dense gradient
-    (autodiff through ``lookup`` produces exactly this)."""
+    (autodiff through ``lookup`` produces exactly this).
+
+    **Zero-lookup tables are a no-op, by contract.** A table absent
+    from ``grads`` (or mapped to None) — e.g. a feature that received
+    no lookups this step — keeps its weights AND its optimizer slot
+    state bit-identical: no Adam moment decay, no FTRL accumulator
+    drift on untouched tables. (Rows of a *touched* table follow the
+    optimizer's dense semantics, where a zero gradient still decays
+    Adam momenta — the reference's behavior; row-sparse no-decay
+    updates live in embedding/dynamic.py.) The step counter still
+    advances: it is the global step, shared by every table's bias
+    correction."""
     uniq = _unique_tables(feature_config)
     tables, slots = dict(state["tables"]), dict(state["slots"])
     for i, tc in enumerate(uniq):

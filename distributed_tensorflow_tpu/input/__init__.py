@@ -16,6 +16,12 @@ from distributed_tensorflow_tpu.input.data_service import (
     DataServiceDispatcher,
 )
 from distributed_tensorflow_tpu.input.split_provider import SplitProvider
+from distributed_tensorflow_tpu.input.stream import (
+    StreamCorruptError,
+    StreamDataset,
+    StreamReader,
+    StreamWriter,
+)
 from distributed_tensorflow_tpu.input.example_parser import (
     FixedLenFeature,
     VarLenFeature,
@@ -29,7 +35,8 @@ __all__ = [
     "AUTOTUNE", "AutoShardPolicy", "DataInputWorker", "DataServiceClient",
     "DataServiceConfig", "DataServiceDispatcher", "Dataset",
     "DistributedDataset", "InputContext", "InputOptions",
-    "FixedLenFeature", "SplitProvider", "VarLenFeature",
+    "FixedLenFeature", "SplitProvider", "StreamCorruptError",
+    "StreamDataset", "StreamReader", "StreamWriter", "VarLenFeature",
     "encode_example", "example_reader", "image_ops", "parse_example",
     "parse_single_example",
 ]

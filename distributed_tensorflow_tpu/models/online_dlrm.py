@@ -1,0 +1,556 @@
+"""Online recommender: streaming DLRM over dynamic embedding tables.
+
+The workload that makes BASELINE config #4 *online* (ROADMAP item 2):
+an unbounded click stream (input/stream.py) feeds a small
+Wide&Deep-style model whose user/item tables are
+:class:`~distributed_tensorflow_tpu.embedding.dynamic.DynamicTable`
+instances — frequency-capped admission, LFU+TTL eviction, growth —
+trained continuously with **exactly-once** event application:
+
+    ingestor --append--> stream.log --tail--> trainer --commit-->
+    checkpoint{model, membership, OFFSET} --poll--> evaluator
+                                                    (fresh snapshots)
+
+The exactly-once rule is structural, not best-effort: the trainer's
+stream cursor (next unapplied offset) is a LEAF of the same checkpoint
+the model state commits through, so cursor and state can only move
+together (the index-last commit protocol of checkpoint/checkpoint.py
+makes the pair atomic). A trainer killed between apply and commit
+replays exactly the uncommitted records into the last committed state
+— applying each log record to the surviving lineage exactly once, by
+construction. ``tools/chaos_sweep.py --online`` audits this from the
+run's ``stream.*`` telemetry; tests/test_stream.py kills a trainer
+between apply and commit and proves bit-equal convergence.
+
+Gradients flow through the async-PS path when a
+:class:`~distributed_tensorflow_tpu.coordinator.cluster_coordinator.
+ClusterCoordinator` is supplied (closures on remote grad workers via
+coordinator/remote_dispatch.py — the reference's config-#4 transport),
+or a local jit program otherwise (bench/tests). Either way the
+TRAINER owns the server copy: tables, membership, dense params, and
+the cursor all live here, and commits happen here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.embedding.dynamic import (
+    DynamicTable,
+    DynamicTableConfig,
+    StaticHashTable,
+)
+from distributed_tensorflow_tpu.embedding.embedding import Adagrad
+from distributed_tensorflow_tpu.input import stream as stream_lib
+from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+
+@dataclasses.dataclass(frozen=True)
+class OnlineConfig:
+    """The online job's model + table + stream shape (hashable: the
+    worker-side grad program caches per config)."""
+
+    embed_dim: int = 8
+    n_dense: int = 4
+    hidden: tuple = (32, 16)
+    dense_lr: float = 0.05
+    table_lr: float = 0.05
+    batch_size: int = 16
+    # dynamic-table knobs (shared by the user and item tables)
+    initial_capacity: int = 256
+    max_capacity: int = 1024
+    admission_threshold: int = 2
+    ttl_steps: int = 2048
+    # seeded event stream shape
+    n_users: int = 50_000
+    n_items: int = 10_000
+    zipf_a: float = 1.2
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got "
+                             f"{self.batch_size}")
+
+    def table_config(self, name: str, seed: int) -> DynamicTableConfig:
+        return DynamicTableConfig(
+            dim=self.embed_dim,
+            initial_capacity=self.initial_capacity,
+            max_capacity=self.max_capacity,
+            admission_threshold=self.admission_threshold,
+            ttl_steps=self.ttl_steps,
+            optimizer=Adagrad(self.table_lr),
+            name=name, seed=seed)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(embed_dim=4, hidden=(16,), initial_capacity=32,
+                        max_capacity=64, n_users=500, n_items=200)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Dense tower (explicit param dict — no framework state to thread
+# through pickled closures) + the worker-side grad program.
+# ---------------------------------------------------------------------------
+
+def init_dense(cfg: OnlineConfig, seed: int = 0) -> dict:
+    rng = np.random.default_rng([cfg.seed, seed, 0xDE45E])
+    dims = (2 * cfg.embed_dim + cfg.n_dense,) + tuple(cfg.hidden) + (1,)
+    params = {}
+    for i in range(len(dims) - 1):
+        scale = 1.0 / np.sqrt(dims[i])
+        params[f"w{i}"] = rng.normal(
+            0, scale, size=(dims[i], dims[i + 1])).astype(np.float32)
+        params[f"b{i}"] = np.zeros(dims[i + 1], dtype=np.float32)
+    return params
+
+
+def _forward(cfg: OnlineConfig, params, user_rows, item_rows, dense):
+    x = jnp.concatenate([user_rows, item_rows, dense], axis=-1)
+    n_layers = len(cfg.hidden) + 1
+    for i in range(n_layers):
+        x = jnp.dot(x, params[f"w{i}"]) + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x[:, 0]
+
+
+@functools.lru_cache(maxsize=8)
+def _grad_program(cfg: OnlineConfig):
+    """Compiled loss+grads, one per config per process (≙ the async-PS
+    worker's per-process function library, wide_deep._ps_grad_program).
+    Differentiates w.r.t. dense params AND the gathered embedding rows
+    (the row grads scatter back through DynamicTable's sparse apply)."""
+
+    def loss_fn(params, user_rows, item_rows, dense, labels):
+        logits = _forward(cfg, params, user_rows, item_rows, dense)
+        labels = labels.astype(jnp.float32)
+        # sigmoid binary cross entropy
+        return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    return jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+
+
+def worker_grads(cfg: OnlineConfig, dense_params, user_rows, item_rows,
+                 dense, labels):
+    """Runs on a grad worker (scheduled closure over remote_dispatch)
+    OR locally: returns ``(loss, dense_grads, user_row_grads,
+    item_row_grads)`` as host arrays."""
+    loss, (dgrads, ugrads, igrads) = _grad_program(cfg)(
+        dense_params, jnp.asarray(user_rows), jnp.asarray(item_rows),
+        jnp.asarray(dense), jnp.asarray(labels))
+    host = lambda t: jax.tree_util.tree_map(np.asarray, t)  # noqa: E731
+    return host(loss), host(dgrads), host(ugrads), host(igrads)
+
+
+@functools.lru_cache(maxsize=8)
+def _dense_apply_fn(lr: float):
+    @jax.jit
+    def apply(params, grads, accum):
+        # adagrad, mirroring the table optimizer family
+        new_acc = {k: accum[k] + jnp.square(grads[k]) for k in params}
+        new_p = {k: params[k] - lr * grads[k]
+                 * jax.lax.rsqrt(new_acc[k] + 1e-12) for k in params}
+        return new_p, new_acc
+
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint layout (fixed leaf names — required by Checkpoint restore)
+# ---------------------------------------------------------------------------
+
+def checkpoint_template(cfg: OnlineConfig) -> dict:
+    """The leaf-name structure of an online checkpoint. Shapes are
+    placeholders (restore is name-driven); the EVALUATOR registers this
+    template to read a trainer's checkpoints without sharing live
+    objects."""
+    dense = init_dense(cfg)
+    table = {"rows": np.zeros((1, cfg.embed_dim), np.float32),
+             "aux": np.zeros(1, np.uint8)}
+    return {
+        "offset": np.zeros((), np.int64),
+        "step": np.zeros((), np.int64),
+        "commit_wall": np.zeros((), np.float64),
+        "dense": {"params": dense,
+                  "accum": {k: np.zeros_like(v)
+                            for k, v in dense.items()}},
+        "user": dict(table),
+        "item": {k: v.copy() for k, v in table.items()},
+    }
+
+
+def unpack_restored(flat: dict, prefix: str = "online") -> dict:
+    """Rebuild the nested online state from a flat restored mapping
+    (``{"online/user/rows": arr, ...}`` -> nested dict)."""
+    out: dict = {}
+    pre = prefix + "/"
+    for key, val in flat.items():
+        if not key.startswith(pre):
+            continue
+        node = out
+        parts = key[len(pre):].split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The trainer loop
+# ---------------------------------------------------------------------------
+
+class OnlineTrainer:
+    """Continuous streaming trainer with exactly-once event application.
+
+    One instance is one trainer incarnation: construct, :meth:`restore`
+    (cursor + model + MEMBERSHIP come back together), then :meth:`run`
+    until ``total_events`` are applied and committed. Gradients are
+    computed locally, or asynchronously through ``coordinator``
+    (ClusterCoordinator over remote grad workers) with up to
+    ``max_in_flight`` scheduled closures; results are applied in
+    schedule order, so the committed cursor is always the contiguous
+    applied prefix.
+    """
+
+    def __init__(self, cfg: OnlineConfig, stream_path: str,
+                 ckpt_dir: str, *, commit_every: int = 5,
+                 coordinator=None, max_in_flight: int = 2,
+                 static_tables: bool = False,
+                 local_dir: str | None = None,
+                 manager_kwargs: dict | None = None,
+                 agent=None):
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint, CheckpointManager)
+        self.cfg = cfg
+        self.stream_path = stream_path
+        self.commit_every = commit_every
+        self.coordinator = coordinator
+        self.max_in_flight = max(1, max_in_flight)
+        self.agent = agent
+        if static_tables:
+            self.user_table = StaticHashTable(
+                cfg.embed_dim, cfg.max_capacity,
+                optimizer=Adagrad(cfg.table_lr), seed=cfg.seed,
+                name="user")
+            self.item_table = StaticHashTable(
+                cfg.embed_dim, cfg.max_capacity,
+                optimizer=Adagrad(cfg.table_lr), seed=cfg.seed + 1,
+                name="item")
+        else:
+            self.user_table = DynamicTable(
+                cfg.table_config("user", cfg.seed))
+            self.item_table = DynamicTable(
+                cfg.table_config("item", cfg.seed + 1))
+        self.dense_params = {k: jnp.asarray(v)
+                             for k, v in init_dense(cfg).items()}
+        self.dense_accum = {k: jnp.zeros_like(v)
+                            for k, v in self.dense_params.items()}
+        self.offset = 0          # next unapplied stream offset
+        self.step = 0            # applied batches (the training step)
+        self.events_applied = 0
+        self.commits = 0
+        # single_writer: the trainer alone owns the online state — the
+        # ingestor/evaluator/grad workers are cluster members that
+        # never checkpoint (the data_service discipline)
+        self._ckpt = Checkpoint(single_writer=True,
+                                online=checkpoint_template(cfg))
+        self._mgr = CheckpointManager(
+            self._ckpt, ckpt_dir, checkpoint_name="online",
+            local_dir=local_dir, **(manager_kwargs or {}))
+
+    # -- state <-> checkpoint ---------------------------------------------
+    def _state_nested(self) -> dict:
+        return {
+            "offset": np.asarray(self.offset, np.int64),
+            "step": np.asarray(self.step, np.int64),
+            "commit_wall": np.asarray(time.time(), np.float64),
+            "dense": {
+                "params": {k: np.asarray(v)
+                           for k, v in self.dense_params.items()},
+                "accum": {k: np.asarray(v)
+                          for k, v in self.dense_accum.items()}},
+            "user": self.user_table.state_dict(),
+            "item": self.item_table.state_dict(),
+        }
+
+    def restore(self) -> int:
+        """Restore cursor + model + membership from the freshest intact
+        checkpoint tier; returns the resume offset (0 = cold start)."""
+        res = self._mgr.restore_latest()
+        if res is None:
+            tv_events.event("stream.resume", offset=0, tier="none")
+            return 0
+        tier, number, restored = res
+        state = unpack_restored(restored)
+        self.load_state(state)
+        # resume the commit numbering where the lineage left it, so the
+        # next save never collides with an existing checkpoint dir
+        self.commits = int(number)
+        tv_events.event("stream.resume", offset=self.offset, tier=tier,
+                        step=self.step)
+        return self.offset
+
+    def load_state(self, state: dict):
+        self.offset = int(np.asarray(state["offset"]))
+        self.step = int(np.asarray(state["step"]))
+        self.dense_params = {k: jnp.asarray(v) for k, v in
+                             state["dense"]["params"].items()}
+        self.dense_accum = {k: jnp.asarray(v) for k, v in
+                            state["dense"]["accum"].items()}
+        self.user_table.load_state_dict(state["user"])
+        self.item_table.load_state_dict(state["item"])
+
+    def commit(self):
+        """Atomically commit model + membership + CURSOR: one
+        checkpoint save (index written last = the commit point). The
+        committed offset is also advertised on the coordination KV for
+        cheap cross-process reads; the checkpoint remains the single
+        source of truth."""
+        self._ckpt._objects["online"] = self._state_nested()
+        # SYNCHRONOUS commit, even with a local tier configured: the
+        # cursor advertised below must never outrun the bytes on disk —
+        # an async pipeline would let a SIGKILL land after the
+        # stream.commit event but before any tier actually committed,
+        # and the next incarnation would (correctly) replay events this
+        # event claimed were applied (chaos_sweep --online catches
+        # exactly that as REPLAYS COMMITTED)
+        self._mgr.save(checkpoint_number=self.commits + 1,
+                       async_write=False)
+        self.commits += 1
+        if self.agent is not None:
+            try:
+                self.agent.key_value_set("dtx_online/committed_offset",
+                                         str(self.offset),
+                                         allow_overwrite=True)
+            except Exception:
+                pass             # advisory only
+        tv_events.event("stream.commit", offset=self.offset,
+                        step=self.step, commit=self.commits)
+
+    # -- the loop ---------------------------------------------------------
+    def _batches(self, total_events: int, idle_timeout_s: float):
+        """Yield fixed-size batches of events from the cursor; the tail
+        batch may be short only when the stream ends exactly there."""
+        ds = stream_lib.StreamDataset(self.stream_path,
+                                      start_offset=self.offset)
+        buf: list = []
+        lo = self.offset
+        for off, ev in ds.events(end_offset=total_events,
+                                 idle_timeout_s=idle_timeout_s):
+            buf.append(ev)
+            if len(buf) == self.cfg.batch_size:
+                yield lo, off + 1, buf
+                buf, lo = [], off + 1
+        if buf:
+            yield lo, lo + len(buf), buf
+
+    @staticmethod
+    def _stack(events: list) -> dict:
+        return {"user": np.asarray([e["user"] for e in events],
+                                   np.int64),
+                "item": np.asarray([e["item"] for e in events],
+                                   np.int64),
+                "dense": np.stack([e["dense"] for e in events]),
+                "label": np.asarray([e["label"] for e in events],
+                                    np.int32)}
+
+    def _pad(self, batch: dict) -> tuple[dict, int]:
+        """Fixed-shape batches for the jit'd grad program: a short tail
+        batch repeats its last event. The padded entries' ROW grads are
+        dropped (and the mean rescaled) before apply; the dense-tower
+        grad keeps the duplicates — a small tail-batch bias accepted
+        for a single compiled program (totals divisible by batch_size,
+        the configured norm, avoid it entirely)."""
+        n = len(batch["label"])
+        b = self.cfg.batch_size
+        if n == b:
+            return batch, n
+        pad = {k: np.concatenate(
+            [v, np.repeat(v[-1:], b - n, axis=0)]) for k, v in
+            batch.items()}
+        return pad, n
+
+    def _compute_grads(self, urows_idx, irows_idx, batch):
+        urows = self.user_table.gather(urows_idx)
+        irows = self.item_table.gather(irows_idx)
+        args = (self.cfg,
+                {k: np.asarray(v) for k, v in self.dense_params.items()},
+                np.asarray(urows), np.asarray(irows),
+                batch["dense"], batch["label"])
+        if self.coordinator is not None:
+            return self.coordinator.schedule(worker_grads, args=args)
+        return worker_grads(*args)
+
+    def _apply(self, urows_idx, irows_idx, n_real, result):
+        loss, dgrads, ugrads, igrads = result
+        if n_real < self.cfg.batch_size:
+            # drop padded rows' grads entirely; rescale the mean
+            scale = self.cfg.batch_size / n_real
+            ugrads = ugrads[:n_real] * scale
+            igrads = igrads[:n_real] * scale
+            dgrads = {k: v * scale for k, v in dgrads.items()}
+            urows_idx = urows_idx[:n_real]
+            irows_idx = irows_idx[:n_real]
+        self.user_table.apply_row_grads(urows_idx, ugrads,
+                                        pad_to=self.cfg.batch_size)
+        self.item_table.apply_row_grads(irows_idx, igrads,
+                                        pad_to=self.cfg.batch_size)
+        self.dense_params, self.dense_accum = _dense_apply_fn(
+            self.cfg.dense_lr)(self.dense_params,
+                               {k: jnp.asarray(v)
+                                for k, v in dgrads.items()},
+                               self.dense_accum)
+        return float(loss)
+
+    def run(self, total_events: int, *, idle_timeout_s: float = 60.0,
+            heartbeat_fn=None, on_batch=None,
+            crash_after_batches: int | None = None) -> dict:
+        """Apply stream records ``[restore offset, total_events)`` and
+        commit every ``commit_every`` batches plus once at the end.
+        ``crash_after_batches`` raises AFTER apply but BEFORE the next
+        commit — the kill-between-apply-and-commit regression hook.
+        Returns summary counters."""
+        losses: list = []
+        in_flight: list = []
+        batches_done = 0
+        t_first = None
+
+        def apply_one():
+            nonlocal batches_done, t_first
+            lo, hi, uidx, iidx, n_real, t0, rv = in_flight.pop(0)
+            result = rv.fetch() if hasattr(rv, "fetch") else rv
+            loss = self._apply(uidx, iidx, n_real, result)
+            jax.block_until_ready(self.dense_params["w0"])
+            dur = time.perf_counter() - t0
+            if t_first is None:
+                t_first = time.perf_counter() - dur
+            self.offset = hi
+            self.events_applied += n_real
+            self.step += 1
+            batches_done += 1
+            losses.append(loss)
+            tv_events.event("train.step", step=self.step, loss=loss,
+                            dur_s=round(dur, 6))
+            tv_events.event("stream.batch_applied", lo=lo, hi=hi,
+                            n=n_real, step=self.step,
+                            loss=round(loss, 5))
+            if heartbeat_fn is not None:
+                heartbeat_fn(batches_done)
+            if on_batch is not None:
+                on_batch(self)
+            if crash_after_batches is not None \
+                    and batches_done >= crash_after_batches:
+                raise _InjectedCrash(
+                    f"injected crash after {batches_done} applied "
+                    f"batches (before commit)")
+            if self.step % self.commit_every == 0:
+                self.commit()
+
+        for lo, hi, events in self._batches(total_events,
+                                            idle_timeout_s):
+            batch = self._stack(events)
+            batch, n_real = self._pad(batch)
+            uidx = self.user_table.translate(batch["user"])
+            iidx = self.item_table.translate(batch["item"])
+            t0 = time.perf_counter()
+            rv = self._compute_grads(uidx, iidx, batch)
+            in_flight.append((lo, hi, uidx, iidx, n_real, t0, rv))
+            # apply in schedule order: the committed cursor is always
+            # the contiguous applied prefix, even with a pipeline of
+            # in-flight closures
+            while len(in_flight) >= (self.max_in_flight
+                                     if self.coordinator is not None
+                                     else 1):
+                apply_one()
+        while in_flight:
+            apply_one()
+        if self.offset < total_events:
+            raise TimeoutError(
+                f"stream went idle at offset {self.offset} before "
+                f"reaching {total_events} events")
+        if self.step % self.commit_every != 0 or self.commits == 0:
+            self.commit()
+        wall = (time.perf_counter() - t_first) if t_first else 0.0
+        return {
+            "offset": self.offset,
+            "steps": self.step,
+            "events_applied": self.events_applied,
+            "commits": self.commits,
+            "loss_last": losses[-1] if losses else None,
+            "events_per_sec": (self.events_applied / wall
+                               if wall > 0 else None),
+            "tables": {
+                name: {"capacity": t.capacity, "mapped": t.mapped,
+                       "admissions": t.admissions,
+                       "evictions": t.evictions, "grows": t.grows}
+                for name, t in (("user", self.user_table),
+                                ("item", self.item_table))},
+        }
+
+    def sync(self):
+        self._ckpt.sync()
+
+
+class _InjectedCrash(RuntimeError):
+    """Raised by ``crash_after_batches`` (tests only)."""
+
+
+def table_stats_event(trainer: OnlineTrainer):
+    """Emit the per-table admission/eviction/growth counters as one
+    ``embed.update`` event (the obs_report 'online' section's feed)."""
+    for name, t in (("user", trainer.user_table),
+                    ("item", trainer.item_table)):
+        tv_events.event("embed.update", table=name,
+                        capacity=t.capacity, mapped=t.mapped,
+                        admissions=t.admissions, evictions=t.evictions,
+                        grows=t.grows, step=trainer.step)
+
+
+# ---------------------------------------------------------------------------
+# Evaluator side: restore fresh snapshots, stamp their stream offset
+# ---------------------------------------------------------------------------
+
+def eval_snapshot(cfg: OnlineConfig, state: dict, *, n_eval: int = 64,
+                  eval_seed: int = 0xEA1) -> float:
+    """Held-out loss of a restored snapshot: rebuild the tables
+    (membership included) read-only and score a seeded eval batch —
+    the 'servable' proof that a snapshot is a working model, not just
+    bytes."""
+    user = DynamicTable(cfg.table_config("user", cfg.seed)) \
+        if _is_dynamic(state["user"]) else StaticHashTable(
+            cfg.embed_dim, cfg.max_capacity, seed=cfg.seed)
+    item = DynamicTable(cfg.table_config("item", cfg.seed + 1)) \
+        if _is_dynamic(state["item"]) else StaticHashTable(
+            cfg.embed_dim, cfg.max_capacity, seed=cfg.seed + 1)
+    user.load_state_dict(state["user"])
+    item.load_state_dict(state["item"])
+    batch = stream_lib.seeded_events(
+        eval_seed, 0, n_eval, n_users=cfg.n_users, n_items=cfg.n_items,
+        n_dense=cfg.n_dense, zipf_a=cfg.zipf_a)
+    uidx = user.translate(batch["user"], train=False)
+    iidx = item.translate(batch["item"], train=False)
+    params = {k: jnp.asarray(v)
+              for k, v in state["dense"]["params"].items()}
+    logits = _forward(cfg, params, user.gather(uidx), item.gather(iidx),
+                      jnp.asarray(batch["dense"]))
+    labels = jnp.asarray(batch["label"], jnp.float32)
+    loss = jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return float(loss)
+
+
+def _is_dynamic(table_state: dict) -> bool:
+    import pickle as _pickle
+    aux = _pickle.loads(np.asarray(table_state["aux"],
+                                   dtype=np.uint8).tobytes())
+    return "id_to_row" in aux

@@ -58,6 +58,24 @@ def default_serving_slos(*, latency_s: float = 0.5,
     ]
 
 
+def default_online_slos(*, freshness_s: float = 5.0,
+                        windows: tuple = DEFAULT_BURN_WINDOWS) -> list:
+    """The online-training objective set (ROADMAP item 2, mirrored by
+    the README's online SLO table): 90% of published snapshots must be
+    servable within ``freshness_s`` of their checkpoint commit
+    (update→servable latency — the online counterpart of request
+    latency), and 99.9% of snapshot publications succeed. The feed is
+    ``stream.snapshot_published`` events
+    (:func:`freshness_records_from_events`); the burn math is shared
+    with the serving SLOs unchanged."""
+    return [
+        SLO("freshness_p90", "freshness", objective=0.90,
+            threshold_s=freshness_s, windows=windows),
+        SLO("snapshot_availability", "availability", objective=0.999,
+            windows=windows),
+    ]
+
+
 def windows_for_span(span_s: float) -> tuple:
     """Scale :data:`DEFAULT_BURN_WINDOWS` to a short run: the longest
     window becomes the observed span, every window keeps its shape
@@ -75,9 +93,10 @@ class SLO:
     """One declarative objective.
 
     ``metric``: ``"latency"`` (request dur vs ``threshold_s``),
-    ``"ttft"`` (time-to-first-token vs ``threshold_s``), or
-    ``"availability"`` (request completed ok). ``objective`` is the
-    target good fraction (0.99 → 1% error budget).
+    ``"ttft"`` (time-to-first-token vs ``threshold_s``),
+    ``"freshness"`` (online training's update→servable seconds vs
+    ``threshold_s``), or ``"availability"`` (request completed ok).
+    ``objective`` is the target good fraction (0.99 → 1% error budget).
     """
 
     name: str
@@ -86,7 +105,9 @@ class SLO:
     threshold_s: float | None = None
     windows: tuple = DEFAULT_BURN_WINDOWS
 
-    _METRICS = ("latency", "ttft", "availability")
+    _METRICS = ("latency", "ttft", "availability", "freshness")
+    _METRIC_KEYS = {"latency": "latency_s", "ttft": "ttft_s",
+                    "freshness": "freshness_s"}
 
     def __post_init__(self):
         if self.metric not in self._METRICS:
@@ -107,8 +128,7 @@ class SLO:
         """Does one completion record violate the condition?"""
         if self.metric == "availability":
             return not record.get("ok", True)
-        key = "latency_s" if self.metric == "latency" else "ttft_s"
-        v = record.get(key)
+        v = record.get(self._METRIC_KEYS[self.metric])
         if not isinstance(v, (int, float)):
             # a generation request with no TTFT measurement etc. —
             # treat missing data as bad only for availability
@@ -214,6 +234,27 @@ def records_from_events(events_by_pid: "dict") -> "list[dict]":
                 "wall": ev.get("wall"),
                 "latency_s": ev.get("dur_s"),
                 "ttft_s": ev.get("ttft_s"),
+                "ok": not ev.get("error"),
+            })
+    records.sort(key=lambda r: r.get("wall") or 0.0)
+    return records
+
+
+def freshness_records_from_events(events_by_pid: "dict") -> "list[dict]":
+    """Freshness records from ``stream.snapshot_published`` events (the
+    online evaluator's stamp per served snapshot): the feed
+    :func:`default_online_slos` evaluates, rendered by
+    ``tools/health_report.py`` and gated by ``chaos_sweep --online``."""
+    records = []
+    for events in events_by_pid.values():
+        for ev in events:
+            if ev.get("ev") != "stream.snapshot_published":
+                continue
+            records.append({
+                "wall": ev.get("wall"),
+                "freshness_s": ev.get("freshness_s"),
+                "lag_events": ev.get("lag_events"),
+                "offset": ev.get("offset"),
                 "ok": not ev.get("error"),
             })
     records.sort(key=lambda r: r.get("wall") or 0.0)

@@ -1,0 +1,197 @@
+"""Dynamic embedding tables (embedding/dynamic.py): frequency-capped
+admission, LFU+TTL eviction, growth that preserves trained rows and
+slots, row-sparse optimizer parity, and membership checkpointing."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.embedding import dynamic as dyn
+from distributed_tensorflow_tpu.embedding.embedding import (
+    FTRL,
+    Adagrad,
+    Adam,
+    SGD,
+)
+
+
+def _cfg(**kw):
+    defaults = dict(dim=4, initial_capacity=8, max_capacity=16,
+                    admission_threshold=2, ttl_steps=4,
+                    optimizer=SGD(0.1))
+    defaults.update(kw)
+    return dyn.DynamicTableConfig(**defaults)
+
+
+def test_config_validation_is_loud():
+    with pytest.raises(ValueError, match="dim"):
+        dyn.DynamicTableConfig(dim=0)
+    with pytest.raises(ValueError, match="initial_capacity"):
+        dyn.DynamicTableConfig(dim=4, initial_capacity=1)
+    with pytest.raises(ValueError, match="max_capacity"):
+        dyn.DynamicTableConfig(dim=4, initial_capacity=8,
+                               max_capacity=4)
+    with pytest.raises(ValueError, match="admission_threshold"):
+        dyn.DynamicTableConfig(dim=4, admission_threshold=0)
+    with pytest.raises(ValueError, match="growth_load_factor"):
+        dyn.DynamicTableConfig(dim=4, growth_load_factor=1.5)
+
+
+def test_admission_threshold_and_cold_row():
+    t = dyn.DynamicTable(_cfg())
+    # first sight: below threshold -> shared cold row
+    rows = t.translate(np.array([42]))
+    assert rows.tolist() == [dyn.COLD_ROW]
+    assert t.mapped == 0
+    # second sight crosses the threshold -> admitted to a real row
+    rows = t.translate(np.array([42]))
+    assert rows[0] != dyn.COLD_ROW
+    assert t.mapped == 1 and t.admissions == 1
+    # an id crossing the threshold WITHIN one batch admits immediately
+    rows = t.translate(np.array([7, 7, 7]))
+    assert rows[0] != dyn.COLD_ROW
+    assert (rows == rows[0]).all()
+
+
+def test_lfu_ttl_eviction_and_thrash_guard():
+    cfg = _cfg(initial_capacity=4, max_capacity=4, ttl_steps=2)
+    t = dyn.DynamicTable(cfg)       # 3 usable rows (cold reserved)
+    for uid in (1, 2, 3):
+        t.translate(np.array([uid, uid] * (uid + 1)))   # freqs differ
+    assert t.mapped == 3 and not t._free
+    # a cold candidate with LOWER frequency than every victim is
+    # declined (no thrash), and rides the cold row
+    rows = t.translate(np.array([9, 9]))
+    assert rows.tolist() == [dyn.COLD_ROW] * 2
+    assert t.declined >= 1
+    # age the table past the TTL: now the expired LFU row is evicted
+    for _ in range(4):
+        t.end_step()
+    hot = np.array([9] * 1)
+    rows = t.translate(hot)
+    assert rows[0] != dyn.COLD_ROW
+    assert t.evictions == 1
+    assert 1 not in t.id_to_row        # id 1 (least frequent) evicted
+
+
+def test_growth_preserves_rows_and_slots():
+    cfg = _cfg(initial_capacity=4, max_capacity=16,
+               optimizer=Adam(0.1), growth_load_factor=0.5)
+    t = dyn.DynamicTable(cfg)
+    t.translate(np.array([5, 5]))
+    idx = t.translate(np.array([5] * 4))
+    t.apply_row_grads(idx, np.ones((4, 4), np.float32), pad_to=4)
+    trained_row = int(t.id_to_row[5])
+    before_row = np.asarray(t.rows[trained_row]).copy()
+    before_m = np.asarray(t.slots["momenta"][trained_row]).copy()
+    cap0 = t.capacity
+    # admit ids until growth fires
+    uid = 100
+    while t.grows == 0:
+        t.translate(np.array([uid, uid]))
+        uid += 1
+    assert t.capacity == cap0 * 2
+    # trained row and its optimizer slots survived the doubling
+    np.testing.assert_array_equal(np.asarray(t.rows[trained_row]),
+                                  before_row)
+    np.testing.assert_array_equal(
+        np.asarray(t.slots["momenta"][trained_row]), before_m)
+    # growth is capped at max_capacity
+    while uid < 200:
+        t.translate(np.array([uid, uid]))
+        uid += 1
+    assert t.capacity <= cfg.capacity_limit
+
+
+@pytest.mark.parametrize("opt", [Adam(0.1), FTRL(0.1), Adagrad(0.1)])
+def test_sparse_apply_parity_and_untouched_rows(opt):
+    """Row-sparse apply == the optimizer's dense math restricted to the
+    touched rows; untouched rows' weights AND slots are bit-identical
+    (no spurious Adam moment decay / FTRL accumulator drift)."""
+    cfg = _cfg(initial_capacity=8, optimizer=opt)
+    t = dyn.DynamicTable(cfg)
+    for uid in (1, 2, 3):
+        t.translate(np.array([uid, uid]))
+    idx = t.translate(np.array([1, 2, 1, 1]))
+    rng = np.random.default_rng(0)
+    grads = rng.normal(size=(4, 4)).astype(np.float32)
+    table0 = np.asarray(t.rows).copy()
+    slots0 = {k: np.asarray(v).copy() for k, v in t.slots.items()}
+    t.apply_row_grads(idx, grads, pad_to=4)
+    # reference: aggregate per unique row, apply the optimizer math
+    uniq, inv = np.unique(idx, return_inverse=True)
+    agg = np.zeros((len(uniq), 4), np.float32)
+    np.add.at(agg, inv, grads)
+    import jax.numpy as jnp
+    ref_rows, ref_slots = opt.apply(
+        jnp.asarray(table0[uniq]), jnp.asarray(agg),
+        {k: jnp.asarray(v[uniq]) for k, v in slots0.items()},
+        jnp.asarray(0))
+    np.testing.assert_allclose(np.asarray(t.rows)[uniq],
+                               np.asarray(ref_rows), rtol=1e-6)
+    for k in slots0:
+        np.testing.assert_allclose(np.asarray(t.slots[k])[uniq],
+                                   np.asarray(ref_slots[k]), rtol=1e-6)
+    # untouched rows: weights and slot state BIT-identical
+    untouched = np.setdiff1d(np.arange(t.capacity), uniq)
+    np.testing.assert_array_equal(np.asarray(t.rows)[untouched],
+                                  table0[untouched])
+    for k in slots0:
+        np.testing.assert_array_equal(np.asarray(t.slots[k])[untouched],
+                                      slots0[k][untouched])
+
+
+def test_state_dict_roundtrip_restores_membership():
+    cfg = _cfg(optimizer=FTRL(0.1))
+    t = dyn.DynamicTable(cfg)
+    for uid in (10, 20, 30):
+        t.translate(np.array([uid, uid]))
+    idx = t.translate(np.array([10, 20, 30, 10]))
+    t.apply_row_grads(idx, np.ones((4, 4), np.float32), pad_to=4)
+    sd = t.state_dict()
+    t2 = dyn.DynamicTable(cfg)
+    t2.load_state_dict(sd)
+    assert t2.id_to_row == t.id_to_row
+    assert t2.step == t.step and t2.admissions == t.admissions
+    np.testing.assert_array_equal(np.asarray(t2.rows),
+                                  np.asarray(t.rows))
+    for k in t.slots:
+        np.testing.assert_array_equal(np.asarray(t2.slots[k]),
+                                      np.asarray(t.slots[k]))
+    np.testing.assert_array_equal(t2.sketch.counts, t.sketch.counts)
+    # restored membership translates identically — including the
+    # admission decision for an id the sketch had seen once
+    t.translate(np.array([77]))
+    t2.translate(np.array([77]))
+    np.testing.assert_array_equal(t.translate(np.array([77, 10])),
+                                  t2.translate(np.array([77, 10])))
+
+
+def test_sketch_bounded_and_conservative():
+    s = dyn.CountMinSketch(width=64, depth=4, seed=1)
+    ids = np.arange(1000)
+    s.add(ids)
+    s.add(ids[:10])
+    est = s.estimate(ids[:10])
+    assert (est >= 2).all()             # never undercounts
+    assert s.counts.nbytes == 64 * 4 * 4
+
+
+def test_static_hash_table_baseline():
+    t = dyn.StaticHashTable(4, 32, optimizer=Adagrad(0.1), seed=3)
+    ids = np.array([5, 123456789, 5])
+    rows = t.translate(ids)
+    assert rows[0] == rows[2] and 0 <= rows.min()
+    assert rows.max() < 32
+    before = np.asarray(t.rows).copy()
+    t.apply_row_grads(rows, np.ones((3, 4), np.float32), pad_to=4)
+    changed = np.unique(rows)
+    untouched = np.setdiff1d(np.arange(32), changed)
+    assert not np.array_equal(np.asarray(t.rows)[changed],
+                              before[changed])
+    np.testing.assert_array_equal(np.asarray(t.rows)[untouched],
+                                  before[untouched])
+    sd = t.state_dict()
+    t2 = dyn.StaticHashTable(4, 32, optimizer=Adagrad(0.1), seed=3)
+    t2.load_state_dict(sd)
+    np.testing.assert_array_equal(np.asarray(t2.rows),
+                                  np.asarray(t.rows))

@@ -128,6 +128,131 @@ def test_stateful_wrapper_api(devices):
     assert not np.allclose(layer.embedding_tables["t0"], before)
 
 
+def test_config_validation_is_loud_at_construction():
+    """Bad table/feature configs must fail at construction with a
+    clear ValueError, not as shape errors deep inside a jitted
+    lookup (≙ the reference's TableConfig argument checks)."""
+    with pytest.raises(ValueError, match="vocabulary_size"):
+        emb.TableConfig(0, 4)
+    with pytest.raises(ValueError, match="vocabulary_size"):
+        emb.TableConfig(-3, 4, name="neg")
+    with pytest.raises(ValueError, match="dim"):
+        emb.TableConfig(16, 0)
+    with pytest.raises(ValueError, match="dim"):
+        emb.TableConfig(16, 4.5)        # non-int dim
+    with pytest.raises(ValueError, match="combiner"):
+        emb.TableConfig(16, 4, combiner="max")
+    table = emb.TableConfig(16, 4)
+    with pytest.raises(ValueError, match="table"):
+        emb.FeatureConfig("not_a_table")
+    with pytest.raises(ValueError, match="max_sequence_length"):
+        emb.FeatureConfig(table, max_sequence_length=-1)
+
+
+@pytest.mark.parametrize("opt", [emb.Adam(0.1), emb.FTRL(0.1)])
+def test_zero_lookup_table_is_a_noop(opt):
+    """A table that received zero lookups this step (absent or None
+    grad) keeps weights AND slot state bit-identical — no spurious
+    Adam moment decay / FTRL accumulator drift — while the touched
+    table matches a per-table reference update."""
+    quiet = emb.TableConfig(8, 4, name="quiet", optimizer=opt)
+    busy = emb.TableConfig(8, 4, name="busy", optimizer=opt)
+    fcs = (emb.FeatureConfig(quiet), emb.FeatureConfig(busy))
+    state = emb.create_state(fcs, rng=jax.random.PRNGKey(11))
+    # evolve slot state so a decay would be visible
+    g = jnp.ones((8, 4))
+    state = emb.apply_gradients(state, {"quiet": g, "busy": g}, fcs)
+    q_table = np.asarray(state["tables"]["quiet"]).copy()
+    q_slots = {k: np.asarray(v).copy()
+               for k, v in state["slots"]["quiet"].items()}
+    # reference for the busy table: a standalone single-table update
+    ref_table, ref_slots = opt.apply(
+        state["tables"]["busy"], g, state["slots"]["busy"],
+        state["step"])
+
+    for grads in ({"busy": g}, {"busy": g, "quiet": None}):
+        new = emb.apply_gradients(state, grads, fcs)
+        np.testing.assert_array_equal(
+            np.asarray(new["tables"]["quiet"]), q_table)
+        for k, v in new["slots"]["quiet"].items():
+            np.testing.assert_array_equal(np.asarray(v), q_slots[k])
+        np.testing.assert_allclose(np.asarray(new["tables"]["busy"]),
+                                   np.asarray(ref_table), rtol=1e-6)
+        for k in ref_slots:
+            np.testing.assert_allclose(
+                np.asarray(new["slots"]["busy"][k]),
+                np.asarray(ref_slots[k]), rtol=1e-6)
+        assert int(new["step"]) == int(state["step"]) + 1
+
+
+def test_dedup_duplicate_ids_across_shard_boundaries(devices):
+    """_dedup_gather correctness when duplicate ids straddle the tp
+    shard boundary of a 2-device mesh: dedup'd and plain gathers must
+    agree exactly, sharded and unsharded alike."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    table, fc = _simple_config(vocab=8, dim=4)
+    state = emb.create_state(fc, mesh=mesh, shard_axis="tp",
+                             rng=jax.random.PRNGKey(12))
+    # rows 0..3 live on shard 0, rows 4..7 on shard 1; duplicates of
+    # both sides interleaved, plus a boundary-adjacent pair (3, 4)
+    ids = jnp.array([1, 5, 1, 5, 3, 4, 7, 3, 4, 1])
+    plain = emb.lookup(state["tables"], fc, ids)
+    dedup = emb.lookup(state["tables"], fc, ids, dedup=True)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(dedup))
+    # a capped unique buffer that still covers the distinct ids
+    capped = emb.lookup(state["tables"], fc, ids, dedup=True,
+                        unique_size=6)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(capped))
+    # 2-D multivalent ids with cross-shard duplicates and padding
+    ids2 = jnp.array([[1, 5, -1], [5, 1, 3], [4, 4, 7]])
+    a = emb.lookup(state["tables"], fc, ids2)
+    b = emb.lookup(state["tables"], fc, ids2, dedup=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-6)
+
+
+def test_ftrl_slots_roundtrip_through_checkpoint(tmp_path):
+    """FTRL accumulator/linear slot state survives a checkpoint
+    save/restore bit-for-bit, and training continues identically."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint)
+    opt = emb.FTRL(0.1, initial_accumulator_value=0.2)
+    table = emb.TableConfig(6, 3, name="t", optimizer=opt)
+    fc = emb.FeatureConfig(table)
+    state = emb.create_state(fc, rng=jax.random.PRNGKey(13))
+    g = jnp.asarray(np.random.default_rng(0).normal(
+        size=(6, 3)).astype("float32"))
+    state = emb.apply_gradients(state, {"t": g}, fc)
+
+    ckpt = Checkpoint(single_writer=True, emb=jax.tree_util.tree_map(
+        np.asarray, state))
+    path = ckpt.write(str(tmp_path / "emb-1"))
+    restored = Checkpoint(
+        single_writer=True,
+        emb={"tables": {"t": np.zeros(1)},
+             "slots": {"t": {"accumulators": np.zeros(1),
+                             "linears": np.zeros(1)}},
+             "step": np.zeros(1)}).restore(path)
+    for key in ("accumulators", "linears"):
+        np.testing.assert_array_equal(
+            restored[f"emb/slots/t/{key}"],
+            np.asarray(state["slots"]["t"][key]))
+    re_state = {
+        "tables": {"t": jnp.asarray(restored["emb/tables/t"])},
+        "slots": {"t": {k: jnp.asarray(restored[f"emb/slots/t/{k}"])
+                        for k in ("accumulators", "linears")}},
+        "step": jnp.asarray(restored["emb/step"])}
+    # training continues bit-identically from the restored slots
+    a = emb.apply_gradients(state, {"t": g}, fc)
+    b = emb.apply_gradients(re_state, {"t": g}, fc)
+    np.testing.assert_array_equal(np.asarray(a["tables"]["t"]),
+                                  np.asarray(b["tables"]["t"]))
+    for k in ("accumulators", "linears"):
+        np.testing.assert_array_equal(
+            np.asarray(a["slots"]["t"][k]),
+            np.asarray(b["slots"]["t"][k]))
+
+
 def test_wide_deep_embedding_step_distributed_equals_single(devices):
     """The DLRM-through-embedding-API path: dp×tp mesh == 1-device mesh
     step for step (≙ keras_correctness_test_base distributed-equivalence

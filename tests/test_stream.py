@@ -1,0 +1,188 @@
+"""Append-only event-log stream source (input/stream.py): record
+format and torn-tail semantics, resumable consumption, and the
+exactly-once contract — a trainer killed between apply and commit
+replays into bit-identical state (≙ the write-once/lease discipline of
+the data service, applied to an unbounded log)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.input import stream as st
+
+
+def _write(path, n, seed=0, chunk=64):
+    w = st.StreamWriter.open(path)
+    while w.next_offset < n:
+        k = min(chunk, n - w.next_offset)
+        st.append_chunk(w, st.seeded_events(seed, w.next_offset, k,
+                                            n_users=500, n_items=200))
+    w.close()
+
+
+def test_roundtrip_offsets_and_payloads(tmp_path):
+    path = str(tmp_path / "s.log")
+    _write(path, 100)
+    assert st.count_records(path) == 100
+    got = list(st.StreamDataset(path).events(end_offset=100,
+                                             idle_timeout_s=1.0))
+    assert [o for o, _ in got] == list(range(100))
+    # payloads are the seeded chunk events, bit-for-bit
+    ref = st.seeded_events(0, 0, 64, n_users=500, n_items=200)
+    assert got[3][1]["user"] == int(ref["user"][3])
+    np.testing.assert_array_equal(got[3][1]["dense"], ref["dense"][3])
+
+
+def test_torn_tail_is_invisible_and_truncated_on_append(tmp_path):
+    path = str(tmp_path / "s.log")
+    _write(path, 20)
+    with open(path, "ab") as f:
+        f.write(b"\xda\x5e\xff\x00\x01")      # torn header/payload
+    count, end = st.scan_log(path)
+    assert count == 20
+    # readers never see the torn record
+    assert len(list(st.StreamDataset(path).events(
+        end_offset=25, idle_timeout_s=0.2))) == 20
+    # a restarted producer truncates the tail and appends contiguously
+    w = st.StreamWriter.open(path)
+    assert w.next_offset == 20
+    w.append_event({"x": 1})
+    w.close()
+    assert st.count_records(path) == 21
+    assert os.path.getsize(path) > end
+
+
+def test_midfile_corruption_raises(tmp_path):
+    path = str(tmp_path / "s.log")
+    _write(path, 10)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(st.StreamCorruptError):
+        st.scan_log(path)
+
+
+def test_resume_from_offset_and_seek_past_end(tmp_path):
+    path = str(tmp_path / "s.log")
+    _write(path, 50)
+    ds = st.StreamDataset(path, start_offset=30)
+    got = [o for o, _ in ds.events(end_offset=50, idle_timeout_s=1.0)]
+    assert got == list(range(30, 50))
+    r = st.StreamReader(path)
+    with pytest.raises(ValueError):
+        r.seek(51)
+
+
+def test_tailing_consumer_sees_concurrent_producer(tmp_path):
+    path = str(tmp_path / "s.log")
+
+    def produce():
+        w = st.StreamWriter.open(path)
+        for i in range(0, 120, 24):
+            st.append_chunk(w, st.seeded_events(0, i, 24,
+                                                n_users=100,
+                                                n_items=50))
+        w.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = [o for o, _ in st.StreamDataset(path, poll_s=0.01).events(
+        end_offset=120, idle_timeout_s=5.0)]
+    t.join()
+    assert got == list(range(120))
+
+
+def test_seeded_chunks_are_deterministic():
+    a = st.seeded_events(7, 128, 32)
+    b = st.seeded_events(7, 128, 32)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    c = st.seeded_events(8, 128, 32)
+    assert not np.array_equal(a["user"], c["user"])
+
+
+# ---------------------------------------------------------------------------
+# The exactly-once regression: kill the trainer BETWEEN apply and
+# commit; the reformed trainer must replay the uncommitted records and
+# converge to state bit-identical to an uninterrupted run.
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg():
+    from distributed_tensorflow_tpu.models.online_dlrm import (
+        OnlineConfig)
+    return OnlineConfig.tiny(batch_size=8)
+
+
+@pytest.mark.parametrize("crash_after", [2, 7])
+def test_kill_between_apply_and_commit_replays_exactly_once(
+        tmp_path, crash_after):
+    from distributed_tensorflow_tpu.models import online_dlrm as od
+
+    cfg = _tiny_cfg()
+    path = str(tmp_path / "s.log")
+    w = st.StreamWriter.open(path)
+    st.append_chunk(w, st.seeded_events(
+        0, 0, 120, n_users=cfg.n_users, n_items=cfg.n_items,
+        n_dense=cfg.n_dense))
+    w.close()
+
+    ref = od.OnlineTrainer(cfg, path, str(tmp_path / "ck_ref"),
+                           commit_every=3)
+    ref.restore()
+    ref_summary = ref.run(120, idle_timeout_s=2.0)
+    assert ref_summary["offset"] == 120
+
+    ck = str(tmp_path / "ck")
+    t1 = od.OnlineTrainer(cfg, path, ck, commit_every=3)
+    t1.restore()
+    with pytest.raises(od._InjectedCrash):
+        t1.run(120, idle_timeout_s=2.0, crash_after_batches=crash_after)
+    # the dead incarnation applied batches past its last commit — a
+    # reformed trainer resumes at the COMMITTED cursor and replays
+    t2 = od.OnlineTrainer(cfg, path, ck, commit_every=3)
+    resumed = t2.restore()
+    assert resumed == (crash_after // 3) * 3 * cfg.batch_size
+    summary = t2.run(120, idle_timeout_s=2.0)
+    assert summary["offset"] == 120
+    # bit-identical convergence: every record applied exactly once in
+    # the surviving lineage, membership included
+    np.testing.assert_array_equal(np.asarray(t2.user_table.rows),
+                                  np.asarray(ref.user_table.rows))
+    np.testing.assert_array_equal(np.asarray(t2.item_table.rows),
+                                  np.asarray(ref.item_table.rows))
+    for k in ref.dense_params:
+        np.testing.assert_array_equal(np.asarray(t2.dense_params[k]),
+                                      np.asarray(ref.dense_params[k]))
+    assert t2.user_table.id_to_row == ref.user_table.id_to_row
+    assert t2.item_table.id_to_row == ref.item_table.id_to_row
+
+
+def test_cursor_rides_the_checkpoint_atomically(tmp_path):
+    """The cursor is a LEAF of the committed checkpoint: restore
+    returns cursor and model from the same atomic commit."""
+    from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+        Checkpoint, latest_checkpoint)
+    from distributed_tensorflow_tpu.models import online_dlrm as od
+
+    cfg = _tiny_cfg()
+    path = str(tmp_path / "s.log")
+    w = st.StreamWriter.open(path)
+    st.append_chunk(w, st.seeded_events(
+        0, 0, 48, n_users=cfg.n_users, n_items=cfg.n_items,
+        n_dense=cfg.n_dense))
+    w.close()
+    ck = str(tmp_path / "ck")
+    t = od.OnlineTrainer(cfg, path, ck, commit_every=2)
+    t.restore()
+    t.run(48, idle_timeout_s=2.0)
+    tmpl = Checkpoint(single_writer=True,
+                      online=od.checkpoint_template(cfg))
+    flat = tmpl.restore(latest_checkpoint(ck, "online"))
+    state = od.unpack_restored(flat)
+    assert int(np.asarray(state["offset"])) == 48
+    assert float(np.asarray(state["commit_wall"])) > 0
+    # membership came back with the same commit
+    assert od._is_dynamic(state["user"])

@@ -312,6 +312,43 @@ def load_autoscale_history(repo: str = REPO) \
     return series
 
 
+def load_online_history(repo: str = REPO) \
+        -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from ONLINE_r*.json (ISSUE 15): per
+    table mode (``dynamic`` vs the same-run ``static`` baseline), the
+    ingest-throughput series plus freshness (update→servable p50/p99)
+    and consumer-lag series carrying ``lower_is_better`` so the
+    regression gate inverts — a trainer that goes stale or falls
+    behind the stream fails CI. Historical rounds without a field
+    simply don't extend its series (absent-tolerant)."""
+    inverted = ("freshness_p50_s", "freshness_p99_s", "lag_p99_events")
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "ONLINE_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            extra = row.get("extra") or {}
+            mode = extra.get("mode") or "dynamic"
+            if not isinstance(row.get("value"), (int, float)):
+                continue
+            series.setdefault(f"online/events_per_sec/{mode}",
+                              {})[rnd] = {
+                "value": row.get("value"),
+                "unit": row.get("unit"),
+                "vs_static": row.get("vs_baseline"),
+            }
+            for lat in inverted:
+                if isinstance(extra.get(lat), (int, float)):
+                    series.setdefault(f"online/{lat}/{mode}",
+                                      {})[rnd] = {
+                        "value": extra[lat], "lower_is_better": True}
+    return series
+
+
 def check_regressions(series: "dict[str, dict[int, dict]]",
                       regression_frac: float) -> "list[str]":
     """Latest round of each series vs the BEST prior round: a drop past
@@ -402,6 +439,7 @@ def main(argv=None) -> int:
     series.update(load_fleet_history(args.repo))
     series.update(load_data_history(args.repo))
     series.update(load_autoscale_history(args.repo))
+    series.update(load_online_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

@@ -63,6 +63,20 @@ reform, and the goodput ledger priced the whole maneuver in the
 ``scale_transition`` bucket with ``wall == goodput + Σ badput`` intact
 (±1%) in BOTH jobs' ledgers.
 
+``--online`` sweeps the ONLINE-TRAINING axis (ISSUE 15): each seed
+runs the streaming recommender topology (examples/train_online.py
+--supervised — trainer/coordinator + async-PS grad worker + ingestor +
+evaluator) with a seed-derived SIGKILL of the trainer, ingestor, or
+evaluator mid-stream. A seed survives only when the job completes, the
+recovery timeline is recorded, the EXACTLY-ONCE offset accounting
+holds (every generation resumes at the lineage's last committed
+offset, applies a contiguous run of stream records from there, and the
+final commit covers every produced event — zero lost, zero
+double-applied in the surviving lineage), the freshness SLO re-clears
+in-run (the final published snapshot covers the whole stream within
+the freshness budget, with at least one snapshot served after the last
+recovery), and the goodput identity holds (±1%, recovery priced).
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -390,6 +404,208 @@ def run_data_seed(seed: int, *, input_workers: int, epochs: int,
     return ok, dt
 
 
+def _stream_accounting_gate(run_dir: str, total_events: int) \
+        -> "list[str]":
+    """Exactly-once event application across generations (ISSUE 15):
+
+    - every generation that applied batches first recorded a
+      ``stream.resume`` at the lineage's last committed offset (the
+      max ``stream.commit`` of all PRIOR generations — work a dead
+      incarnation applied but never committed is replayed, work it
+      committed is never re-applied);
+    - within a generation, ``stream.batch_applied`` ranges are
+      CONTIGUOUS from the resume offset (no gap = zero lost, no
+      overlap = zero double-applied in the surviving lineage);
+    - commit offsets never exceed the applied prefix, and the final
+      commit covers every configured event.
+
+    Returns violation messages (empty = ok)."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    resumes: dict = {}            # gen -> resume offset
+    batches: dict = {}            # gen -> [(lo, hi)] in file order
+    commits: dict = {}            # gen -> [offsets] in file order
+    for pid, events in read_run(run_dir).items():
+        for ev in events:
+            gen = ev.get("gen", 0)
+            name = ev.get("ev")
+            if name == "stream.resume":
+                resumes[gen] = ev.get("offset")
+            elif name == "stream.batch_applied":
+                batches.setdefault(gen, []).append(
+                    (ev.get("lo"), ev.get("hi")))
+            elif name == "stream.commit":
+                commits.setdefault(gen, []).append(ev.get("offset"))
+    if not batches:
+        return [f"no stream.batch_applied events under {run_dir}"]
+    bad = []
+    gens = sorted(set(resumes) | set(batches) | set(commits))
+    committed_prefix = 0
+    for gen in gens:
+        resume = resumes.get(gen)
+        gen_batches = batches.get(gen, [])
+        if resume is None:
+            if gen_batches:
+                bad.append(f"gen{gen}: applied {len(gen_batches)} "
+                           f"batch(es) without a stream.resume")
+            continue
+        if resume != committed_prefix:
+            why = ("LOST" if resume > committed_prefix
+                   else "REPLAYS COMMITTED")
+            bad.append(
+                f"gen{gen}: resumed at offset {resume} but the "
+                f"lineage's committed prefix is {committed_prefix} "
+                f"({why} events)")
+        cursor = resume
+        for lo, hi in gen_batches:
+            if lo != cursor:
+                why = ("GAP (lost events)" if lo > cursor
+                       else "OVERLAP (double-applied)")
+                bad.append(f"gen{gen}: batch [{lo},{hi}) does not "
+                           f"abut applied prefix {cursor} ({why})")
+            cursor = max(cursor, hi if isinstance(hi, int) else cursor)
+        prev = committed_prefix
+        for off in commits.get(gen, []):
+            if off < prev:
+                bad.append(f"gen{gen}: commit offset regressed "
+                           f"{prev} -> {off}")
+            if off > cursor:
+                bad.append(f"gen{gen}: committed offset {off} beyond "
+                           f"the applied prefix {cursor}")
+            prev = off
+        if commits.get(gen):
+            committed_prefix = max(committed_prefix,
+                                   max(commits[gen]))
+    if committed_prefix != total_events:
+        bad.append(f"final committed offset {committed_prefix} != "
+                   f"{total_events} produced events")
+    return bad
+
+
+def _freshness_gate(run_dir: str, total_events: int,
+                    freshness_budget_s: float) -> "list[str]":
+    """The freshness SLO must RE-CLEAR in-run after the injected kill:
+    the final published snapshot covers the whole stream with zero lag
+    and freshness within budget, at least one snapshot was served
+    AFTER the last recovery restart, and the multi-window burn is not
+    firing at end of run."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry import slo as tv_slo
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    events_by_pid = read_run(run_dir)
+    records = tv_slo.freshness_records_from_events(events_by_pid)
+    if not records:
+        return [f"no stream.snapshot_published events under {run_dir}"]
+    bad = []
+    last = records[-1]
+    if last.get("offset") != total_events:
+        bad.append(f"final snapshot covers offset {last.get('offset')} "
+                   f"of {total_events} events (model went stale)")
+    if last.get("lag_events"):
+        bad.append(f"final snapshot still lags the stream by "
+                   f"{last['lag_events']} event(s)")
+    f = last.get("freshness_s")
+    if not isinstance(f, (int, float)) or f > freshness_budget_s:
+        bad.append(f"final snapshot freshness {f}s exceeds the "
+                   f"{freshness_budget_s}s budget (SLO never "
+                   f"re-cleared)")
+    last_restart = 0.0
+    for events in events_by_pid.values():
+        for ev in events:
+            if ev.get("ev") == "recovery.restart" \
+                    and isinstance(ev.get("wall"), (int, float)):
+                last_restart = max(last_restart, ev["wall"])
+    if last_restart and not any(
+            isinstance(r.get("wall"), (int, float))
+            and r["wall"] > last_restart for r in records):
+        bad.append("no snapshot was published after the last recovery "
+                   "(the evaluator never came back)")
+    span = ((records[-1]["wall"] - records[0]["wall"])
+            if len(records) > 1 else 1.0)
+    slos = tv_slo.default_online_slos(
+        freshness_s=freshness_budget_s,
+        windows=tv_slo.windows_for_span(max(span, 1e-3)))
+    for name, res in tv_slo.evaluate_records(records, slos).items():
+        if res["firing"]:
+            bad.append(f"online SLO {name} still FIRING at end of run")
+    return bad
+
+
+def run_online_seed(seed: int, *, events: int, budget: int,
+                    keep_dirs: bool, freshness_budget: float,
+                    goodput_floor: "float | None" = None) \
+        -> tuple[bool, float]:
+    """One supervised online-training run with a seed-derived SIGKILL
+    of the trainer/ingestor/evaluator; survival = clean exit + recovery
+    telemetry + exactly-once offset accounting + freshness-SLO
+    re-clear + the goodput-ledger identity (recovery priced)."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_online_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "train_online.py"),
+           "--supervised", "--events", str(events),
+           "--kill-seed", str(seed),
+           "--restart-budget", str(budget),
+           "--stream-dir", os.path.join(run_dir, "stream"),
+           "--ckpt-dir", os.path.join(run_dir, "ckpt"),
+           "--telemetry-dir", run_dir]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if ok:
+        gate_cmd = [sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    run_dir, "--check",
+                    "--require", "recovery.restart",
+                    "--require", "recovery.run_complete",
+                    "--require", "stream.commit",
+                    "--require", "stream.snapshot_published"]
+        gate = subprocess.run(gate_cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: run finished but telemetry gate "
+                  f"FAILED (rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
+    if ok:
+        violations = _stream_accounting_gate(run_dir, events)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: exactly-once stream accounting "
+                  f"FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _freshness_gate(run_dir, events, freshness_budget)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: freshness-SLO gate FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _goodput_gate(run_dir, goodput_floor,
+                                   expect_recovery=True)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: goodput-ledger gate FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if not ok and proc.returncode != 0:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-15:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def _served_requests_gate(run_dir: str, n_requests: int,
                           serve_seed: int) -> "list[str]":
     """Zero dropped in-flight requests: the union of every replica's
@@ -629,6 +845,19 @@ def main(argv=None) -> int:
                          "every completed epoch must show exactly-once "
                          "split delivery (zero lost, zero duplicated) "
                          "with the recovery visible in telemetry")
+    ap.add_argument("--online", action="store_true",
+                    help="sweep seed-driven SIGKILLs of the online "
+                         "topology's trainer/ingestor/evaluator "
+                         "(examples/train_online.py --supervised): "
+                         "exactly-once stream-offset accounting, "
+                         "freshness-SLO re-clear, and the goodput "
+                         "identity are gated per seed")
+    ap.add_argument("--events", type=int, default=480,
+                    help="--online: stream events per run")
+    ap.add_argument("--freshness-budget", type=float, default=10.0,
+                    help="--online: final-snapshot update->servable "
+                         "budget in seconds (the SLO threshold the "
+                         "re-clear gate evaluates)")
     ap.add_argument("--input-workers", type=int, default=2,
                     help="--data: input-worker tasks per run")
     ap.add_argument("--epochs", type=int, default=2,
@@ -674,12 +903,18 @@ def main(argv=None) -> int:
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
-                             args.spike)) > 1:
-        ap.error("--kill, --serve, --data and --spike are separate "
-                 "sweep axes")
+                             args.spike, args.online)) > 1:
+        ap.error("--kill, --serve, --data, --spike and --online are "
+                 "separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.spike:
+        if args.online:
+            ok, dt = run_online_seed(
+                s, events=args.events, budget=args.restart_budget,
+                keep_dirs=args.keep_dirs,
+                freshness_budget=args.freshness_budget,
+                goodput_floor=args.goodput_floor)
+        elif args.spike:
             ok, dt = run_spike_seed(s, budget=args.budget,
                                     train_workers=args.workers,
                                     keep_dirs=args.keep_dirs,

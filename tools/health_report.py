@@ -54,7 +54,7 @@ from distributed_tensorflow_tpu.telemetry import (  # noqa: E402
 
 
 def build_report(run_dir: str, *, latency_s: float = 0.5,
-                 ttft_s: float = 0.25,
+                 ttft_s: float = 0.25, freshness_s: float = 5.0,
                  windows: "tuple | None" = None) -> dict:
     """Assemble the health report structure from a run directory."""
     events_by_pid = tv_events.read_run(run_dir)
@@ -70,6 +70,25 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
         slos = tv_slo.default_serving_slos(
             latency_s=latency_s, ttft_s=ttft_s, windows=windows)
         slo_report = tv_slo.evaluate_records(records, slos)
+
+    # online freshness SLO (ISSUE 15): update->servable burn over the
+    # evaluator's snapshot stamps. Folded into the same slo dict so
+    # --slo-budget gates it identically; names never collide with the
+    # serving set.
+    online_report = None
+    fresh_records = tv_slo.freshness_records_from_events(events_by_pid)
+    if fresh_records:
+        fw = windows
+        if fw is None:
+            span = ((fresh_records[-1]["wall"]
+                     - fresh_records[0]["wall"])
+                    if len(fresh_records) > 1 else 1.0)
+            fw = tv_slo.windows_for_span(max(span, 1e-3))
+        online_slos = tv_slo.default_online_slos(
+            freshness_s=freshness_s, windows=fw)
+        online_report = tv_slo.evaluate_records(fresh_records,
+                                                online_slos)
+        slo_report = {**(slo_report or {}), **online_report}
 
     stalls = []
     scale_decisions = 0
@@ -103,7 +122,25 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
         except OSError:
             live = None
 
+    online = None
+    if fresh_records:
+        lags = [r["lag_events"] for r in fresh_records
+                if isinstance(r.get("lag_events"), (int, float))]
+        fresh = [r["freshness_s"] for r in fresh_records
+                 if isinstance(r.get("freshness_s"), (int, float))]
+        online = {
+            "snapshots": len(fresh_records),
+            "last_offset": fresh_records[-1].get("offset"),
+            "last_lag_events": (lags[-1] if lags else None),
+            "freshness_p50_s": (round(sorted(fresh)[len(fresh) // 2], 4)
+                                if fresh else None),
+            "freshness_max_s": (round(max(fresh), 4) if fresh
+                                else None),
+            "slo": online_report,
+        }
+
     return {"ledger": ledger, "slo": slo_report, "stalls": stalls,
+            "online": online,
             "scale": {"decisions": scale_decisions,
                       "applied": scale_applied},
             "live_scrape": live,
@@ -153,6 +190,15 @@ def render_text(report: dict) -> str:
                            f"{w['short_s']:g}s: burn {bl}/{bs} "
                            f"(max {w['max_burn']:g})"
                            + ("  FIRING" if w["firing"] else ""))
+    on = report.get("online")
+    if on:
+        out.append(f"online: {on['snapshots']} snapshot(s) served, "
+                   f"last offset {on['last_offset']}"
+                   + (f", lag {on['last_lag_events']} event(s)"
+                      if on.get("last_lag_events") is not None else "")
+                   + (f", freshness p50 {on['freshness_p50_s']:g}s "
+                      f"max {on['freshness_max_s']:g}s"
+                      if on.get("freshness_p50_s") is not None else ""))
     scale = report.get("scale") or {}
     if scale.get("applied") or scale.get("decisions"):
         out.append(f"autoscaling: {scale.get('decisions', 0)} "
@@ -250,6 +296,9 @@ def main(argv=None) -> int:
                     help="p99 latency objective threshold (default 500)")
     ap.add_argument("--slo-ttft-ms", type=float, default=250.0,
                     help="p95 TTFT objective threshold (default 250)")
+    ap.add_argument("--slo-freshness-s", type=float, default=5.0,
+                    help="online freshness (update->servable) objective "
+                         "threshold in seconds (default 5)")
     ap.add_argument("--slo-window", action="append", metavar="L,S,B",
                     help="burn window triple long_s,short_s,max_burn "
                          "(repeatable; default: SRE presets scaled to "
@@ -272,6 +321,7 @@ def main(argv=None) -> int:
         report = build_report(args.target,
                               latency_s=args.slo_latency_ms / 1e3,
                               ttft_s=args.slo_ttft_ms / 1e3,
+                              freshness_s=args.slo_freshness_s,
                               windows=windows)
     except tv_events.EventLogCorruptError as e:
         print(f"health_report: {e}", file=sys.stderr)

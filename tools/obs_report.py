@@ -114,6 +114,18 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     serve_cached_tokens = 0
     serve_drafts_proposed = 0
     serve_drafts_accepted = 0
+    # online streaming (ISSUE 15): stream.* / embed.* telemetry
+    online_produced = 0            # newest produced offset
+    online_produced_wall = None
+    online_applied = 0             # newest applied offset
+    online_applied_wall = None
+    online_events = 0              # events applied (sum of batch n)
+    online_first_apply = online_last_apply = None
+    online_committed = 0
+    online_freshness: list[float] = []
+    online_lag_events = None       # last published snapshot's lag
+    online_snapshots = 0
+    online_tables: dict = {}       # table -> latest embed.update
 
     # the supervisor writes under pid "supervisor": sort keys as strings
     for pid, events in sorted(events_by_pid.items(), key=lambda kv:
@@ -190,6 +202,41 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 ct = ev.get("cached_tokens")
                 if isinstance(ct, (int, float)):
                     serve_cached_tokens += int(ct)
+            elif name == "stream.produced":
+                o = ev.get("offset")
+                if isinstance(o, (int, float)) and o >= online_produced:
+                    online_produced = int(o)
+                    online_produced_wall = ev.get("wall")
+            elif name == "stream.batch_applied":
+                hi = ev.get("hi")
+                if isinstance(hi, (int, float)) \
+                        and hi >= online_applied:
+                    online_applied = int(hi)
+                    online_applied_wall = ev.get("wall")
+                n = ev.get("n")
+                if isinstance(n, (int, float)):
+                    online_events += int(n)
+                if isinstance(w, (int, float)):
+                    online_first_apply = (w if online_first_apply is None
+                                          else online_first_apply)
+                    online_last_apply = w
+            elif name == "stream.commit":
+                o = ev.get("offset")
+                if isinstance(o, (int, float)):
+                    online_committed = max(online_committed, int(o))
+            elif name == "stream.snapshot_published":
+                online_snapshots += 1
+                f = ev.get("freshness_s")
+                if isinstance(f, (int, float)):
+                    online_freshness.append(f)
+                lag = ev.get("lag_events")
+                if isinstance(lag, (int, float)):
+                    online_lag_events = int(lag)
+            elif name == "embed.update":
+                online_tables[ev.get("table", "?")] = {
+                    k: ev.get(k) for k in
+                    ("capacity", "mapped", "admissions", "evictions",
+                     "grows")}
             elif name == "stall.suspected":
                 stalls.append({k: ev.get(k) for k in
                                ("pid", "stalled_s", "median_step_s",
@@ -290,6 +337,32 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                                           / serve_drafts_proposed, 4)
                                     if serve_drafts_proposed else None),
         } if (serve_latency or serve_steps) else None,
+        "online": {
+            "events_produced": online_produced,
+            "events_applied": online_events,
+            "applied_offset": online_applied,
+            "committed_offset": online_committed,
+            "events_per_sec": (round(
+                online_events / (online_last_apply
+                                 - online_first_apply), 1)
+                if online_first_apply is not None
+                and online_last_apply is not None
+                and online_last_apply > online_first_apply else None),
+            # current lag: newest produced offset minus newest applied,
+            # in events AND seconds (production wall vs apply wall)
+            "lag_events": (online_produced - online_applied
+                           if online_produced else None),
+            "lag_s": (round(max(0.0, online_produced_wall
+                                - online_applied_wall), 3)
+                      if isinstance(online_produced_wall, (int, float))
+                      and isinstance(online_applied_wall, (int, float))
+                      else None),
+            "snapshots_published": online_snapshots,
+            "snapshot_lag_events": online_lag_events,
+            "freshness": _percentiles(online_freshness),
+            "tables": online_tables,
+        } if (online_produced or online_applied
+              or online_snapshots) else None,
         "phases": phases_report,
         "goodput": goodput_report,
         "bottleneck": bottleneck,
@@ -492,6 +565,38 @@ def render_text(report: dict, rollup: dict) -> str:
                        f"{sv['accepted_draft_rate']:.1%} "
                        f"({sv['drafts_accepted']}/"
                        f"{sv['drafts_proposed']} draft tokens)")
+    if report.get("online"):
+        on = report["online"]
+        out.append(f"online: {on['events_applied']} event(s) applied "
+                   f"(offset {on['applied_offset']}, committed "
+                   f"{on['committed_offset']}) of "
+                   f"{on['events_produced']} produced"
+                   + (f", {on['events_per_sec']:g} events/s"
+                      if on.get("events_per_sec") else ""))
+        lag_bits = []
+        if on.get("lag_events") is not None:
+            lag_bits.append(f"{on['lag_events']} event(s)")
+        if on.get("lag_s") is not None:
+            lag_bits.append(f"{on['lag_s']:g}s")
+        if lag_bits:
+            out.append("  lag (produced - applied): "
+                       + ", ".join(lag_bits))
+        fr = on.get("freshness")
+        if fr:
+            out.append(f"  freshness (update->servable)  "
+                       f"p50 {fr['p50']:.3f}s  p99 {fr['p99']:.3f}s  "
+                       f"max {fr['max']:.3f}s over "
+                       f"{on['snapshots_published']} snapshot(s)"
+                       + (f", last lag "
+                          f"{on['snapshot_lag_events']} event(s)"
+                          if on.get("snapshot_lag_events") is not None
+                          else ""))
+        for name, t in sorted(on.get("tables", {}).items()):
+            out.append(f"  table {name}: {t.get('mapped')}/"
+                       f"{t.get('capacity')} rows mapped, "
+                       f"{t.get('admissions')} admitted, "
+                       f"{t.get('evictions')} evicted, "
+                       f"{t.get('grows')} grow(s)")
     _render_phase_table(report, out)
     gp = report.get("goodput")
     if gp:
