@@ -998,6 +998,381 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
     return row
 
 
+def run_serving_disagg(out_path: str | None = None, *,
+                       n_requests: int | None = None, seed: int = 0,
+                       qps: float | None = None,
+                       kv_dtype: str | None = None):
+    """Disaggregated prefill/decode serving bench (ISSUE 16): decode
+    tail latency under a **prefill burst**, disaggregated vs monolithic
+    at EQUAL chip budget.
+
+    Workload: a steady Poisson stream of short-prompt decode-heavy
+    requests, punctured by seeded bursts of near-max-prompt requests —
+    the traffic shape where a monolithic engine's prefill forwards
+    stall every in-flight decode (the interference DistServe/Splitwise
+    exist to remove). Both sides get two engines (same pool and slot
+    budget per engine), each cranked by its own thread:
+
+    - **monolithic**: requests round-robined over two full engines;
+    - **disaggregated**: engine 0 runs ``role="prefill"`` and migrates
+      every prefilled sequence's KV blocks to engine 1 (payloads cross
+      a real pack/unpack wire hop), which only decodes.
+
+    The headline is **decode_p99_ms** — the p99 inter-token gap (TBT),
+    measured driver-side with identical methodology on both sides: the
+    time between consecutive generated tokens of a running sequence,
+    observed across engine steps (first token excluded — that's TTFT).
+    The gate (tools/serve_sweep.py) is INVERTED vs the usual more-is-
+    better: the disagg row must show strictly LOWER decode p99 than
+    its same-run monolithic baseline, with byte-identical greedy
+    outputs. The row also carries the migration latency series
+    (``migrate_p50_ms``/``migrate_p99_ms``, export->adopt wall
+    including the wire hop) and the monolithic side's deferral split
+    (``deferred_prefill`` vs ``deferred_blocks``).
+    """
+    import queue as _queue
+    import random as _random
+    import threading as _threading
+
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.serving import (
+        InferenceEngine, Request, pack_payload, unpack_payload)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig.transformer_big(max_seq_len=1024,
+                                                scan_layers=False)
+        n_requests = n_requests or 48
+        qps = qps or 12.0
+        engine_kw = dict(num_blocks=1024, block_size=16, max_slots=16,
+                         max_prompt_len=512)
+        prompt_range, new_range = (8, 48), (16, 48)
+        burst_prompt, n_bursts, burst_size = (384, 512), 3, 4
+    else:
+        cfg = TransformerConfig.tiny(max_seq_len=64)
+        n_requests = n_requests or 36
+        qps = qps or 30.0
+        engine_kw = dict(num_blocks=96, block_size=8, max_slots=8,
+                         max_prompt_len=48)
+        prompt_range, new_range = (4, 10), (24, 40)
+        burst_prompt, n_bursts, burst_size = (40, 48), 3, 8
+
+    # a whole burst must be admittable in ONE step on both sides —
+    # that is the interference being measured: the monolithic engine
+    # prefills the burst as one big forward with every in-flight
+    # decode stalled behind it, the disagg prefill replica eats the
+    # same forward on its own chips
+    engine_kw["token_budget"] = (engine_kw["max_slots"]
+                                 + burst_size
+                                 * engine_kw["max_prompt_len"])
+
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # seeded workload: steady stream first (fixes the span), then the
+    # bursts dropped at fixed fractions of it — all from one stream so
+    # the whole schedule is a pure function of the seed
+    rng = _random.Random(f"dtx-disagg-bench:{seed}")
+    n_burst = n_bursts * burst_size
+    n_steady = max(1, n_requests - n_burst)
+    n_requests = n_steady + n_burst
+    arrivals = []
+    t = 0.0
+    for i in range(n_steady):
+        t += rng.expovariate(qps)
+        toks = [rng.randrange(cfg.vocab_size)
+                for _ in range(rng.randrange(*prompt_range))]
+        arrivals.append((t, Request(
+            id=f"s{i:04d}", tokens=tuple(toks),
+            max_new_tokens=rng.randrange(*new_range))))
+    span_est = t
+    for b in range(n_bursts):
+        tb = span_est * (b + 1) / (n_bursts + 1)
+        for j in range(burst_size):
+            toks = [rng.randrange(cfg.vocab_size)
+                    for _ in range(rng.randrange(*burst_prompt))]
+            arrivals.append((tb, Request(
+                id=f"p{b}{j:03d}", tokens=tuple(toks),
+                max_new_tokens=rng.randrange(2, 5))))
+    arrivals.sort(key=lambda a: a[0])
+
+    def build(role="both", prefix_caching=False):
+        return InferenceEngine(cfg, params, role=role,
+                               queue_capacity=n_requests + 1,
+                               kv_dtype=kv_dtype,
+                               prefix_caching=prefix_caching,
+                               **engine_kw)
+
+    def record_gaps(engine, now, last_t, ntok, gaps):
+        """Driver-side TBT: for every running STEADY sequence whose
+        generated count advanced since last observed, one gap per new
+        token from the previous observation (first token sets the
+        baseline). Only the steady stream's gaps count — the burst
+        requests are the interference source, the steady requests are
+        its victims — with the same rule on both sides."""
+        for seq in engine.scheduler.running.values():
+            rid = seq.request.id
+            if not rid.startswith("s"):
+                continue
+            n = len(seq.generated)
+            if n == 0:
+                continue
+            prev = ntok.get(rid)
+            if prev is None:
+                last_t[rid], ntok[rid] = now, n
+                continue
+            if n > prev:
+                gaps += [(now - last_t[rid]) / (n - prev)] * (n - prev)
+                last_t[rid], ntok[rid] = now, n
+
+    def mono_worker(engine, shard, t0, out, gaps, arrival):
+        pending = list(shard)
+        last_t, ntok = {}, {}
+        while pending or not engine.scheduler.idle:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                due, req = pending.pop(0)
+                engine.submit(req)
+                arrival[req.id] = due
+            if engine.scheduler.idle:
+                time.sleep(min(0.002, max(0.0,
+                                          pending[0][0] - now)))
+                continue
+            for rec in engine.step():
+                rec["latency_s"] = ((time.perf_counter() - t0)
+                                    - arrival[rec["id"]])
+                out[rec["id"]] = rec
+            record_gaps(engine, time.perf_counter(), last_t, ntok,
+                        gaps)
+
+    def prefill_worker(engine, shard, t0, wire, arrival):
+        pending = list(shard)
+        while pending or not engine.scheduler.idle:
+            now = time.perf_counter() - t0
+            if pending and engine.scheduler.idle:
+                time.sleep(min(0.002, max(0.0,
+                                          pending[0][0] - now)))
+                now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                due, req = pending.pop(0)
+                engine.submit(req)
+                arrival[req.id] = due
+            if not engine.scheduler.idle:
+                engine.step()
+            # migrate every freshly prefilled sequence: export, then a
+            # REAL wire hop (pack -> unpack) before it crosses threads
+            ready = sorted((s for s in engine.scheduler.running.values()
+                            if s.prefilled and not s.done),
+                           key=lambda s: s.slot)
+            for seq in ready:
+                tm0 = time.perf_counter()
+                payload = engine.export_sequence(seq)
+                wire.put((unpack_payload(pack_payload(payload)), tm0))
+        wire.put(None)                                  # drained
+
+    def decode_worker(engine, t0, wire, out, gaps, arrival, mig_ms):
+        last_t, ntok = {}, {}
+        hold, src_done = [], False
+        while not (src_done and not hold
+                   and engine.scheduler.idle):
+            while True:                    # drain the wire into `hold`
+                try:
+                    item = wire.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is None:
+                    src_done = True
+                else:
+                    hold.append(item)
+            # at most a couple of adoptions between decode steps: the
+            # insert cost amortizes across steps instead of landing as
+            # one long stall (the decode engine's own TBT discipline)
+            adopted = 0
+            while hold and adopted < 1 \
+                    and engine.can_adopt(hold[0][0]):
+                payload, tm0 = hold.pop(0)
+                engine.adopt_sequence(payload)
+                mig_ms.append((time.perf_counter() - tm0) * 1e3)
+                adopted += 1
+            if engine.scheduler.idle:
+                time.sleep(0.001)
+                continue
+            for rec in engine.step():
+                rec["latency_s"] = ((time.perf_counter() - t0)
+                                    - arrival[rec["id"]])
+                out[rec["id"]] = rec
+            record_gaps(engine, time.perf_counter(), last_t, ntok,
+                        gaps)
+
+    def warm_pair(a, b=None):
+        """Compile every program off the clock: batch-1 and burst-size
+        prefill shapes, decode, and (disagg) the gather/insert +
+        adopt paths."""
+        wl = burst_prompt[0]
+        if b is None:
+            a.generate([[1, 2, 3]], max_new_tokens=2)
+            a.generate([[1] * wl] * burst_size, max_new_tokens=2)
+            return
+        for prompts in ([[1, 2, 3]], [[1] * wl] * burst_size):
+            for i, p in enumerate(prompts):
+                a.submit(Request(id=f"w{len(p)}{i}", tokens=tuple(p),
+                                 max_new_tokens=2))
+            while not a.scheduler.idle:
+                a.step()
+                for seq in sorted(
+                        (s for s in a.scheduler.running.values()
+                         if s.prefilled and not s.done),
+                        key=lambda s: s.slot):
+                    pay = unpack_payload(pack_payload(
+                        a.export_sequence(seq)))
+                    b.adopt_sequence(pay)
+            while not b.scheduler.idle:
+                b.step()
+
+    def pct(vals, q):
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))] \
+            if vals else None
+
+    tv_dir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+
+    # ---- monolithic baseline (equal chip budget: 2 full engines,
+    # round-robin sharding, one thread each), telemetry suppressed so
+    # the run's event stream describes only the disagg headline
+    if tv_dir:
+        tv_events.shutdown()
+    monos = [build(), build()]
+    for e in monos:
+        warm_pair(e)
+    mono_out: dict = {}
+    mono_gaps: list = []
+    mono_arrival: dict = {}
+    shards = [[a for i, a in enumerate(arrivals) if i % 2 == k]
+              for k in range(2)]
+    t0 = time.perf_counter()
+    threads = [_threading.Thread(target=mono_worker,
+                                 args=(e, sh, t0, mono_out, mono_gaps,
+                                       mono_arrival))
+               for e, sh in zip(monos, shards)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    mono_span = time.perf_counter() - t0
+    mono_stats = [e.stats() for e in monos]
+
+    # ---- disaggregated (same budget: 1 prefill + 1 decode engine)
+    if tv_dir:
+        tv_events.configure(tv_dir)
+    pf = build(role="prefill")
+    dec = build()
+    warm_pair(pf, dec)
+    dis_out: dict = {}
+    dis_gaps: list = []
+    dis_arrival: dict = {}
+    mig_ms: list = []
+    wire: "_queue.Queue" = _queue.Queue()
+    t0 = time.perf_counter()
+    tp = _threading.Thread(target=prefill_worker,
+                           args=(pf, list(arrivals), t0, wire,
+                                 dis_arrival))
+    td = _threading.Thread(target=decode_worker,
+                           args=(dec, t0, wire, dis_out, dis_gaps,
+                                 dis_arrival, mig_ms))
+    tp.start()
+    td.start()
+    tp.join()
+    td.join()
+    dis_span = time.perf_counter() - t0
+
+    outputs_match = (set(dis_out) == set(mono_out) and all(
+        dis_out[rid]["tokens"] == mono_out[rid]["tokens"]
+        for rid in dis_out))
+
+    def tokens_of(done):
+        return sum(len(r["tokens"]) for r in done.values())
+
+    dis_lats = sorted(r["latency_s"] for r in dis_out.values())
+    mono_lats = sorted(r["latency_s"] for r in mono_out.values())
+    dis_gaps.sort()
+    mono_gaps.sort()
+    mig_ms.sort()
+    pf_stats, dec_stats = pf.stats(), dec.stats()
+
+    baseline = {
+        "tokens_per_sec": round(tokens_of(mono_out) / mono_span, 1),
+        "p50_latency_ms": round(pct(mono_lats, 0.50) * 1e3, 2),
+        "p99_latency_ms": round(pct(mono_lats, 0.99) * 1e3, 2),
+        "decode_p50_ms": round(pct(mono_gaps, 0.50) * 1e3, 3),
+        "decode_p99_ms": round(pct(mono_gaps, 0.99) * 1e3, 3),
+        "span_s": round(mono_span, 3),
+        # the deferral split (ISSUE 16 satellite): admission deferrals
+        # from prefill-token pressure vs block-pool exhaustion
+        "deferred_prefill": sum(s["deferred_prefill"]
+                                for s in mono_stats),
+        "deferred_blocks": sum(s["deferred_blocks"]
+                               for s in mono_stats),
+        "preemptions": sum(s["preemptions"] for s in mono_stats),
+    }
+    row = {
+        "metric": "serving_tokens_per_sec",
+        "value": round(tokens_of(dis_out) / dis_span, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "extra": {
+            "backend": backend,
+            "disagg": True,
+            "n_requests": n_requests,
+            "n_burst_requests": n_burst,
+            "qps_target": qps,
+            "qps_achieved": round(n_requests / dis_span, 2),
+            "p50_latency_ms": round(pct(dis_lats, 0.50) * 1e3, 2),
+            "p99_latency_ms": round(pct(dis_lats, 0.99) * 1e3, 2),
+            "decode_p50_ms": round(pct(dis_gaps, 0.50) * 1e3, 3),
+            "decode_p99_ms": round(pct(dis_gaps, 0.99) * 1e3, 3),
+            "tokens_generated": tokens_of(dis_out),
+            "seed": seed,
+            "kv_dtype": dec_stats.get("kv_dtype", "float32"),
+            "migrations": len(mig_ms),
+            "migrated_bytes": pf_stats["migrated_bytes"],
+            "migrate_p50_ms": round(pct(mig_ms, 0.50), 3),
+            "migrate_p99_ms": round(pct(mig_ms, 0.99), 3),
+            "deferred_prefill": pf_stats["deferred_prefill"],
+            "deferred_blocks": pf_stats["deferred_blocks"],
+            "max_slots": dec.max_slots,
+            "num_blocks": dec.cache_cfg.num_blocks,
+            "block_size": dec.cache_cfg.block_size,
+            "baseline_monolithic": baseline,
+            "outputs_match_monolithic": outputs_match,
+        },
+    }
+    extra = row["extra"]
+    win = extra["decode_p99_ms"] < baseline["decode_p99_ms"]
+    print(f"prefill burst ({n_bursts}x{burst_size} long prompts): "
+          f"disagg decode p99 {extra['decode_p99_ms']}ms vs "
+          f"monolithic {baseline['decode_p99_ms']}ms "
+          f"({'WIN' if win else 'NO WIN'}); {len(mig_ms)} migrations "
+          f"p99 {extra['migrate_p99_ms']}ms, "
+          f"{extra['migrated_bytes']} bytes on the wire; outputs "
+          f"{'byte-identical' if outputs_match else 'DIVERGED'}",
+          file=sys.stderr)
+    telemetry.event("serving.row", metric=row["metric"],
+                    value=row["value"],
+                    **{k: v for k, v in extra.items()
+                       if isinstance(v, (int, float, str))})
+    print(json.dumps(row))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "serving", "backend": backend,
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": [row]}, f, indent=1)
+            f.write("\n")
+    return row
+
+
 def run_fleet(out_path: str | None = None, *,
               worker_counts=(8, 64, 256, 1000), seed: int = 0):
     """Fleet-scale control-plane bench (ISSUE 11): N simulated workers
@@ -1703,6 +2078,12 @@ if __name__ == "__main__":
                         help="run the request-level serving bench "
                              "(p50/p99 latency + tokens/s at --qps "
                              "through the continuous-batching engine)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="with --serving: disaggregated prefill/"
+                             "decode under a seeded prefill burst — "
+                             "decode TBT p99 vs a same-run monolithic "
+                             "baseline at equal chip budget, plus the "
+                             "migration latency series")
     parser.add_argument("--fleet", action="store_true",
                         help="run the simulated-fleet control-plane "
                              "bench (ops/s, rollup latency, detect/"
@@ -1784,12 +2165,18 @@ if __name__ == "__main__":
         run_online(out_path=args.out, seed=args.seed,
                    total_events=args.events or 6144)
     elif args.serving or args.workload == "serving":
-        run_serving(out_path=args.out, qps=args.qps,
-                    n_requests=args.requests, seed=args.seed,
-                    slo_latency_ms=args.slo_latency_ms,
-                    prefix_reuse=args.prefix_reuse,
-                    kv_dtype=args.kv_dtype,
-                    speculative_k=args.speculative)
+        if args.disagg:
+            run_serving_disagg(out_path=args.out, qps=args.qps,
+                               n_requests=args.requests,
+                               seed=args.seed,
+                               kv_dtype=args.kv_dtype)
+        else:
+            run_serving(out_path=args.out, qps=args.qps,
+                        n_requests=args.requests, seed=args.seed,
+                        slo_latency_ms=args.slo_latency_ms,
+                        prefix_reuse=args.prefix_reuse,
+                        kv_dtype=args.kv_dtype,
+                        speculative_k=args.speculative)
     elif args.workload == "resnet50":
         run_resnet50()
     elif args.workload == "bert":

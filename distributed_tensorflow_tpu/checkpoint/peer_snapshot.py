@@ -94,25 +94,47 @@ def unpack(data: bytes) -> HostSnapshot:
 
 #: KV blob chunk size — comfortably under the coordination service's
 #: 4 MiB grpc message cap.
-_CHUNK = 2 << 20
+CHUNK = 2 << 20
 
 
-def _kv_put_blob(agent, prefix: str, data: bytes):
+def kv_put_blob(agent, prefix: str, data: bytes):
     """Publish ``data`` under ``prefix`` as write-once chunk keys with a
     committed-last count key (readers can never observe a partial
-    blob). Chunks stay under the grpc message cap."""
-    n = max(1, (len(data) + _CHUNK - 1) // _CHUNK)
+    blob). Chunks stay under the grpc message cap.
+
+    The transport is agent-agnostic: anything exposing
+    ``key_value_set``/``key_value_get`` works — the coordination
+    service's KV for ring replication here, and serving's file-backed
+    :class:`~distributed_tensorflow_tpu.serving.migrate.FileKV` for
+    KV-block migration (serving/migrate.py reuses this exact
+    chunked write-once protocol, so a writer SIGKILLed mid-publish
+    never leaves an adoptable half-blob)."""
+    n = max(1, (len(data) + CHUNK - 1) // CHUNK)
     for i in range(n):
         agent.key_value_set(f"{prefix}/c{i}",
-                            data[i * _CHUNK:(i + 1) * _CHUNK])
+                            data[i * CHUNK:(i + 1) * CHUNK])
     agent.key_value_set(f"{prefix}/n", str(n))
 
 
-def _kv_get_blob(agent, prefix: str, timeout_s: float) -> bytes:
+def kv_get_blob(agent, prefix: str, timeout_s: float) -> bytes:
+    """Fetch a blob :func:`kv_put_blob` published (blocks until the
+    committed-last count key lands, so a torn publish is never read)."""
     n = int(agent.key_value_get(f"{prefix}/n", timeout_s=timeout_s))
     return b"".join(
         agent.key_value_get(f"{prefix}/c{i}", timeout_s=timeout_s)
         for i in range(n))
+
+
+def kv_blob_committed(agent, prefix: str) -> bool:
+    """Non-blocking: has a blob under ``prefix`` fully committed? Needs
+    an agent with ``key_value_try_get`` (FileKV has one)."""
+    return agent.key_value_try_get(f"{prefix}/n") is not None
+
+
+# backwards-compatible private spellings (pre-factoring callers)
+_CHUNK = CHUNK
+_kv_put_blob = kv_put_blob
+_kv_get_blob = kv_get_blob
 
 
 def ring_source(pid: int, world: int) -> int:

@@ -413,27 +413,33 @@ class CoordinationServiceAgent:
                     raise CoordinationError(
                         f"key_value_increment({key!r}) failed: {e}") from e
         self._inc_hint[key] = i
-        # Value key for plain readers (write-direction: safe). The
-        # publish is best-effort AND racy: a slower peer's SMALLER
-        # value can land after ours (lost update — observed as a
-        # full-suite flake in the 2-process barrier/increment test).
-        # One verify-read + conditional re-publish closes the common
-        # ordering: the larger writer re-asserts its value if a stale
-        # one overwrote it. (Still best-effort by design — the slot
-        # keys are the ground truth.)
+        # Value key for plain readers (write-direction: safe). A naive
+        # publish is racy: a slower peer's SMALLER value can land after
+        # ours (lost update — observed as a full-suite flake in the
+        # 2-process barrier/increment test, where a reader past an
+        # "everyone incremented" barrier still saw a stale total). The
+        # slot keys are the ground truth, so close the race with them:
+        # after publishing, probe forward for slots claimed by peers
+        # and republish the larger tail until a probe issued AFTER our
+        # latest publish finds nothing. Each writer's RPCs are ordered,
+        # so any claim our final probe missed belongs to a peer whose
+        # own (larger) publish necessarily lands after ours.
         try:
-            c.key_value_set_bytes(key, str(i).encode(),
+            pub = i
+            c.key_value_set_bytes(key, str(pub).encode(),
                                   allow_overwrite=True)
-            cur = self._legacy_get_once(c, key, 50)
-            stale = True
-            if cur is not None:
-                try:
-                    stale = int(cur) < i
-                except ValueError:
-                    pass
-            if stale:
-                c.key_value_set_bytes(key, str(i).encode(),
+            tail = i
+            while tail < limit:
+                if self._legacy_get_once(
+                        c, f"{key}/__c__/{tail + 1}", 50) is not None:
+                    tail += 1
+                    continue
+                if tail == pub:
+                    break
+                c.key_value_set_bytes(key, str(tail).encode(),
                                       allow_overwrite=True)
+                pub = tail
+            self._inc_hint[key] = max(self._inc_hint[key], tail)
         except Exception:
             pass
         return i

@@ -459,7 +459,12 @@ class SharedFleetSupervisor:
             max_workers=self.policy.max_replicas,
             autoscaler=self.arbiter,
             drain_on_scale=True,
-            **(serve_sup_kwargs or {}))
+            # scale-downs hand live KV to the successor generation
+            # instead of replaying decode from the prompt — the
+            # preempt_replay badput of a shrink drops to ~0
+            # (serving/migrate.py; override via serve_sup_kwargs)
+            **{"drain_scale_down_mode": "migrate",
+               **(serve_sup_kwargs or {})})
 
     def _health_lines(self) -> "list[str]":
         """Root-exporter extra lines: both jobs' goodput ledgers (the

@@ -203,7 +203,8 @@ class RecoverySupervisor:
                  kv_gc=None,
                  autoscaler=None,
                  drain_on_scale: bool = False,
-                 drain_timeout_s: float = 15.0):
+                 drain_timeout_s: float = 15.0,
+                 drain_scale_down_mode: str = "full"):
         """Knobs beyond the obvious:
 
         - ``stall_timeout_s`` — heartbeat *staleness* budget: a worker
@@ -255,7 +256,13 @@ class RecoverySupervisor:
           per-task drain flags (cluster/elastic.drain_path) and give
           the generation ``drain_timeout_s`` to exit on its own;
           serving replicas use it to finish in-flight sequences so a
-          scale-down drops zero requests.
+          scale-down drops zero requests. ``drain_scale_down_mode``
+          picks the flag written on scale-DOWN: ``full`` (finish
+          everything admitted before exiting) or ``migrate`` (export
+          live KV blocks to the handoff namespace and exit now — the
+          successor generation adopts them with zero replayed decode
+          steps; serving/replica.py ``_drain``). Scale-up always
+          drains ``fast``: the capacity is wanted immediately.
         """
         self._fn = worker_fn
         self._num_workers = num_workers
@@ -285,6 +292,7 @@ class RecoverySupervisor:
         self.autoscaler = autoscaler
         self._drain_on_scale = drain_on_scale
         self._drain_timeout_s = drain_timeout_s
+        self._drain_scale_down_mode = drain_scale_down_mode
         #: serializes generation-replacing actions (failure recovery
         #: AND scale reforms): a scale request landing while a recovery
         #: holds this lock stays pending and is applied at the next
@@ -669,10 +677,12 @@ class RecoverySupervisor:
             if self._drain_on_scale:
                 # scale-up wants the capacity NOW (queued work
                 # re-shards); scale-down happens at low load, so
-                # completing the admitted queue first keeps those
-                # requests off the respawn gap's latency tail
+                # completing the admitted queue ("full") — or handing
+                # live KV to the successor ("migrate", zero replay) —
+                # keeps those requests off the respawn gap's tail
                 drained = self._drain_generation(
-                    "full" if direction == "down" else "fast")
+                    self._drain_scale_down_mode
+                    if direction == "down" else "fast")
             self._runner.terminate_all()
             if self.kv_gc is not None:
                 hbs = self._hb.read_all(old_n)

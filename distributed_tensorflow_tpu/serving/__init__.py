@@ -34,6 +34,15 @@ greedy outputs exactly equal non-speculative), and a **quantized KV
 pool** (``kv_dtype="bf16"|"int8"`` — 2-3.8x the servable slots per
 chip; ``kv_quantization_probe`` measures the logit-error bound).
 
+Disaggregated serving (ISSUE 16): :mod:`migrate` ships a sequence's
+live KV blocks between replicas over the write-once chunked blob
+transport — :class:`DisaggregatedEngine` splits prefill from decode
+(a prefill burst stops blowing decode p99), drain mode ``migrate``
+hands live sequences to a successor with zero replay, and cold
+prefix-cache blocks spill to a :class:`HostTier` and re-adopt on hit
+(``InferenceEngine(spill_tier=...)``). Greedy outputs stay
+byte-identical to the monolithic engine throughout.
+
 Quick start::
 
     from distributed_tensorflow_tpu import serving
@@ -53,10 +62,20 @@ from distributed_tensorflow_tpu.serving.kv_cache import (
     BlockAllocator,
     BlockTable,
     CacheConfig,
+    HostTier,
     OutOfBlocksError,
     PrefixCache,
     init_pool,
     pool_shardings,
+)
+from distributed_tensorflow_tpu.serving.migrate import (
+    DisaggregatedEngine,
+    FileKV,
+    MigrationPayload,
+    fetch_payload,
+    pack_payload,
+    publish_payload,
+    unpack_payload,
 )
 from distributed_tensorflow_tpu.serving.scheduler import (
     AdmissionQueue,
@@ -84,8 +103,10 @@ from distributed_tensorflow_tpu.serving.replica import (
 
 __all__ = [
     "InferenceEngine",
-    "BlockAllocator", "BlockTable", "CacheConfig", "OutOfBlocksError",
-    "PrefixCache", "init_pool", "pool_shardings",
+    "BlockAllocator", "BlockTable", "CacheConfig", "HostTier",
+    "OutOfBlocksError", "PrefixCache", "init_pool", "pool_shardings",
+    "DisaggregatedEngine", "FileKV", "MigrationPayload",
+    "fetch_payload", "pack_payload", "publish_payload", "unpack_payload",
     "AdmissionQueue", "ContinuousBatchingScheduler", "QueueOverflowError",
     "Request", "Sequence",
     "canonical_params", "kv_quantization_probe", "make_decode_fn",

@@ -72,6 +72,8 @@ tests/test_serving_speed.py pins):
 
 from __future__ import annotations
 
+import itertools
+import os
 import time
 
 import jax
@@ -85,11 +87,14 @@ from distributed_tensorflow_tpu.models.transformer import (
 from distributed_tensorflow_tpu.resilience import faults
 from distributed_tensorflow_tpu.serving import decode as decode_lib
 from distributed_tensorflow_tpu.serving.kv_cache import (
-    CacheConfig, init_pool, pool_shardings)
+    CacheConfig, HostTier, init_pool, pool_shardings)
 from distributed_tensorflow_tpu.serving.scheduler import (
-    AdmissionQueue, ContinuousBatchingScheduler, Request, Sequence)
+    AdmissionQueue, ContinuousBatchingScheduler, OutOfBlocksError,
+    Request, Sequence)
 from distributed_tensorflow_tpu.utils.jax_compat import (
     safe_donate_argnums)
+
+_pool_epochs = itertools.count()
 
 
 def request_span_id(request_id: str) -> str:
@@ -98,6 +103,13 @@ def request_span_id(request_id: str) -> str:
     preemption replays, across replica generations — carries the SAME
     id and the trace assembler threads them with flow arrows."""
     return f"req/{request_id}"
+
+
+def migrate_span_id(request_id: str) -> str:
+    """Span id shared by BOTH halves of one KV migration — the source's
+    export and the destination's adopt — so the merged trace renders a
+    flow arrow prefill→decode (or victim→survivor for drain/rescue)."""
+    return f"kvmig/{request_id}"
 
 
 class InferenceEngine:
@@ -128,12 +140,24 @@ class InferenceEngine:
                  cache_dtype=None, kv_dtype: str | None = None,
                  prefix_caching: bool = False,
                  speculative_k: int = 0,
-                 draft_params=None, draft_cfg=None):
+                 draft_params=None, draft_cfg=None,
+                 role: str = "both",
+                 spill_tier: "HostTier | int | None" = None):
         if cfg.mesh is not None:
             import dataclasses
             cfg = dataclasses.replace(cfg, mesh=None)
+        if role not in ("both", "prefill"):
+            raise ValueError(f"role={role!r}; expected 'both' or "
+                             f"'prefill'")
         self.cfg = cfg
         self.mesh = mesh
+        #: "prefill" compiles no decode program: step() admits +
+        #: prefills only, and the disaggregated runtime EXPORTS each
+        #: prefilled sequence's KV to a decode replica (migrate.py).
+        self.role = role
+        #: fences host-tier spills and stale drain handoffs: unique per
+        #: engine incarnation, never equal across restarts
+        self.pool_epoch = f"{os.getpid()}-{next(_pool_epochs)}"
         self.max_slots = max_slots
         self.max_seq_len = min(max_seq_len or cfg.max_seq_len,
                                cfg.max_seq_len)
@@ -189,10 +213,16 @@ class InferenceEngine:
 
         prefill = decode_lib.make_prefill_fn(cfg, cache_cfg)
         decode = (decode_lib.make_decode_fn(cfg, cache_cfg)
-                  if cfg.causal else None)
+                  if cfg.causal and role != "prefill" else None)
         extend = (decode_lib.make_extend_fn(cfg, cache_cfg)
                   if cfg.causal else None)
         copy_fn = decode_lib.make_copy_fn()
+
+        def gather_fn(pool, rows):
+            return {n: pool[n][:, rows] for n in pool}
+
+        def insert_fn(pool, rows, vals):
+            return {n: pool[n].at[:, rows].set(vals[n]) for n in pool}
         if mesh is not None:
             # jit under the mesh context so GSPMD partitions over it;
             # inputs arrive host-side and get sharded by in_shardings
@@ -237,6 +267,17 @@ class InferenceEngine:
                 copy_fn, in_shardings=(pool_sh, rep, rep),
                 out_shardings=pool_sh,
                 donate_argnums=safe_donate_argnums((0,)))
+            # migration/spill row movers: gather block rows to a
+            # replicated (host-fetchable) array, insert host rows into
+            # the sharded pool. No donation on gather — the pool
+            # survives an export.
+            self._gather = jax.jit(gather_fn,
+                                   in_shardings=(pool_sh, rep),
+                                   out_shardings=rep)
+            self._insert = jax.jit(
+                insert_fn, in_shardings=(pool_sh, rep, rep),
+                out_shardings=pool_sh,
+                donate_argnums=safe_donate_argnums((0,)))
         else:
             self._prefill = jax.jit(
                 prefill, donate_argnums=safe_donate_argnums((1,)))
@@ -249,6 +290,9 @@ class InferenceEngine:
             self._extend_spec = self._extend_prefill
             self._copy = jax.jit(
                 copy_fn, donate_argnums=safe_donate_argnums((0,)))
+            self._gather = jax.jit(gather_fn)
+            self._insert = jax.jit(
+                insert_fn, donate_argnums=safe_donate_argnums((0,)))
 
         # shared inference namespace (Model.predict reports here too)
         reg = telemetry.get_registry()
@@ -289,6 +333,38 @@ class InferenceEngine:
         # above are process-wide and shared across engines)
         self._spec_proposed_n = 0
         self._spec_accepted_n = 0
+        # instance-local migration tallies
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self.migrated_bytes = 0
+
+        self.spill_tier: HostTier | None = None
+        if spill_tier is not None and spill_tier is not False:
+            if not self.prefix_caching:
+                raise ValueError("spill_tier requires "
+                                 "prefix_caching=True (the tier backs "
+                                 "prefix-cache eviction)")
+            tier = (spill_tier if isinstance(spill_tier, HostTier)
+                    else HostTier(int(spill_tier)))
+            bs = self.cache_cfg.block_size
+
+            def _extract(block: int) -> dict:
+                rows = jnp.arange(block * bs, (block + 1) * bs,
+                                  dtype=jnp.int32)
+                g = self._gather(self.pool, rows)
+                return {n: np.asarray(jax.device_get(a))
+                        for n, a in g.items()}
+
+            def _insert_block(block: int, arrays: dict):
+                rows = jnp.arange(block * bs, (block + 1) * bs,
+                                  dtype=jnp.int32)
+                vals = {n: jnp.asarray(a) for n, a in arrays.items()}
+                self.pool = self._insert(self.pool, rows, vals)
+
+            self.scheduler.prefix_cache.attach_spill(
+                tier, extract=_extract, insert=_insert_block,
+                epoch=self.pool_epoch)
+            self.spill_tier = tier
 
     # -- weights -----------------------------------------------------------
     @classmethod
@@ -599,6 +675,8 @@ class InferenceEngine:
             # 1. retire finished sequences -> blocks free immediately
             for seq in list(sched.finished()):
                 finished.append(self._complete(seq))
+            defer_p0 = sched.deferred_prefill
+            defer_b0 = sched.deferred_blocks
             admitted = sched.admit()
             for seq in admitted:
                 self._prefill_one(seq)
@@ -627,6 +705,15 @@ class InferenceEngine:
             sp["finished"] = len(finished)
             sp["queued"] = len(sched.queue)
             sp["blocks_free"] = sched.allocator.num_free
+            # deferral split BY CAUSE (this step's deltas): prefill
+            # budget pressure vs pool exhaustion — the bench reads
+            # these off serve.step to attribute p99 to interference
+            if sched.deferred_prefill > defer_p0:
+                sp["deferred_prefill"] = (sched.deferred_prefill
+                                          - defer_p0)
+            if sched.deferred_blocks > defer_b0:
+                sp["deferred_blocks"] = (sched.deferred_blocks
+                                         - defer_b0)
             if admitted:
                 cached = sum(s.cached_tokens for s in admitted)
                 if cached:
@@ -685,6 +772,180 @@ class InferenceEngine:
                 "replayed_tokens": replayed,
                 "preemptions": seq.preemptions}
 
+    # -- KV-block migration ------------------------------------------------
+    def pool_fingerprint(self) -> dict:
+        """Pool-compatibility fingerprint a migration payload carries:
+        adoption REQUIRES equality — same storage dtype, same block
+        geometry, same per-row shape — or the raw exported rows would
+        be reinterpreted wrongly. Weights equality is the caller's
+        contract (replicas of one serving deployment share a
+        checkpoint)."""
+        c = self.cache_cfg
+        return {"kv_dtype": str(jnp.dtype(c.dtype).name),
+                "block_size": c.block_size, "n_layers": c.n_layers,
+                "n_heads": c.n_heads, "head_dim": c.head_dim}
+
+    def _block_rows(self, blocks) -> np.ndarray:
+        bs = self.cache_cfg.block_size
+        return np.concatenate(
+            [np.arange(b * bs, (b + 1) * bs, dtype=np.int32)
+             for b in blocks])
+
+    def export_sequence(self, seq: Sequence, *,
+                        reason: str = "migrate"):
+        """Gather a PREFILLED sequence's KV blocks off the pool and
+        return a :class:`~distributed_tensorflow_tpu.serving.migrate.
+        MigrationPayload` holding everything another replica needs to
+        continue it — raw block rows (scales included for int8), the
+        request, tokens generated so far (carried as LIVE state, so the
+        adopter replays nothing), and latency provenance. The
+        sequence's slot and blocks are released HERE: after export the
+        payload is the only copy, and publishing it is the caller's
+        job (write-once blob commit makes that crash-safe).
+
+        The exported rows include position ``length-1``'s not-yet-
+        written row — the engine's KV timing invariant (the last banked
+        token's KV is written by the NEXT decode step before any read),
+        so shipping one stale row is byte-correct exactly like the
+        monolithic step."""
+        rid = seq.request.id
+        sched = self.scheduler
+        if not seq.prefilled:
+            raise ValueError(f"export {rid}: sequence not prefilled "
+                             f"(nothing in the cache to migrate)")
+        from distributed_tensorflow_tpu.serving import (
+            migrate as _migrate)
+        blocks = list(seq.table.blocks)
+        t0 = time.monotonic()
+        with telemetry.span("kv.migrate", id=rid,
+                            span_id=migrate_span_id(rid),
+                            direction="export", reason=reason,
+                            blocks=len(blocks)) as sp:
+            g = self._gather(self.pool,
+                             jnp.asarray(self._block_rows(blocks)))
+            arrays = {n: np.asarray(jax.device_get(a))
+                      for n, a in g.items()}
+            ttft = ((seq.first_token_s - seq.admitted_s)
+                    if seq.first_token_s is not None else None)
+            payload = _migrate.MigrationPayload(
+                request_id=rid, tokens=tuple(seq.request.tokens),
+                max_new_tokens=seq.request.max_new_tokens,
+                eos_id=seq.request.eos_id,
+                generated_prefix=tuple(seq.request.generated_prefix),
+                generated=tuple(seq.generated), length=seq.length,
+                fingerprint=self.pool_fingerprint(),
+                pool_epoch=self.pool_epoch,
+                arrival_wall=self._submitted.get(rid),
+                ttft_s=ttft, preemptions=seq.preemptions,
+                arrays=arrays)
+            sp["bytes"] = payload.nbytes
+            # source-side release: the slot (unless the scheduler's
+            # preemption path already freed it) and the block refs
+            if sched.running.get(seq.slot) is seq:
+                del sched.running[seq.slot]
+                sched._free_slots.append(seq.slot)
+                sched._free_slots.sort(reverse=True)
+            seq.table.release(sched.allocator)
+            self._submitted.pop(rid, None)
+            self._submit_mono.pop(rid, None)
+        ledger = _goodput.active_ledger()
+        if ledger is not None:
+            ledger.record("kv_migrate", time.monotonic() - t0)
+        self.migrations_out += 1
+        self.migrated_bytes += payload.nbytes
+        return payload
+
+    def can_adopt(self, payload) -> bool:
+        """Non-destructive capacity probe: a free slot AND enough free
+        blocks for the payload. The migration source MUST check before
+        shipping — adoption never preempts to make room."""
+        n_blocks = payload.arrays["k"].shape[1] \
+            // self.cache_cfg.block_size
+        return (bool(self.scheduler._free_slots)
+                and self.scheduler.allocator.num_free >= n_blocks)
+
+    def adopt_sequence(self, payload, *,
+                       arrival_wall: "float | None" = None) -> Sequence:
+        """Install a migrated-in sequence: allocate blocks, scatter the
+        payload's rows into this pool, and register the sequence as
+        prefilled-and-running. Greedy decode continues exactly where
+        the source stopped — prior tokens are live generation state,
+        so ``replayed_tokens`` stays 0 and the completion record is
+        byte-identical to the monolithic run. Raises ``ValueError`` on
+        a pool-fingerprint mismatch (never serves through an
+        incompatible pool) and ``OutOfBlocksError`` when capacity is
+        short (see :meth:`can_adopt`)."""
+        rid = payload.request_id
+        fp = self.pool_fingerprint()
+        if payload.fingerprint != fp:
+            raise ValueError(
+                f"adopt {rid}: pool fingerprint mismatch "
+                f"(payload {payload.fingerprint} vs engine {fp})")
+        sched = self.scheduler
+        bs = self.cache_cfg.block_size
+        n_blocks = payload.arrays["k"].shape[1] // bs
+        t0 = time.monotonic()
+        with telemetry.span("kv.migrate", id=rid,
+                            span_id=migrate_span_id(rid),
+                            direction="adopt", blocks=n_blocks,
+                            bytes=payload.nbytes):
+            blocks = sched.allocator.alloc(n_blocks)
+            try:
+                req = Request(id=rid, tokens=payload.tokens,
+                              max_new_tokens=payload.max_new_tokens,
+                              eos_id=payload.eos_id,
+                              generated_prefix=tuple(
+                                  payload.generated_prefix))
+                seq = sched.adopt(req, blocks, payload.length,
+                                  payload.generated)
+            except Exception:
+                sched.allocator.free(blocks)
+                raise
+            vals = {n: jnp.asarray(a)
+                    for n, a in payload.arrays.items()}
+            self.pool = self._insert(
+                self.pool, jnp.asarray(self._block_rows(blocks)), vals)
+            seq.preemptions = payload.preemptions
+            if payload.ttft_s is not None:
+                # preserve the SOURCE-measured time-to-first-token
+                # (_complete reports first_token_s - admitted_s)
+                seq.first_token_s = seq.admitted_s + payload.ttft_s
+            self._submitted[rid] = (
+                arrival_wall if arrival_wall is not None
+                else payload.arrival_wall
+                if payload.arrival_wall is not None else time.time())
+            self._submit_mono[rid] = time.monotonic()
+        ledger = _goodput.active_ledger()
+        if ledger is not None:
+            ledger.record("kv_migrate", time.monotonic() - t0)
+        self.migrations_in += 1
+        self.migrated_bytes += payload.nbytes
+        return seq
+
+    def block_accounting(self) -> dict:
+        """Allocator conservation audit (the chaos --disagg gate):
+        every live reference must be owned by a running sequence's
+        table or a prefix-cache entry, and free + allocated must equal
+        the usable pool. ``leaked_refs != 0`` or ``conserved: False``
+        means a migration path dropped or duplicated block ownership."""
+        sched = self.scheduler
+        alloc = sched.allocator
+        seq_refs = sum(len(s.table.blocks)
+                       for s in sched.running.values())
+        cache_refs = (len(sched.prefix_cache)
+                      if sched.prefix_cache is not None else 0)
+        return {
+            "free": alloc.num_free,
+            "allocated": alloc.num_allocated,
+            "usable": self.cache_cfg.usable_blocks,
+            "total_refs": alloc.total_refs,
+            "seq_refs": seq_refs,
+            "cache_refs": cache_refs,
+            "leaked_refs": alloc.total_refs - seq_refs - cache_refs,
+            "conserved": (alloc.num_free + alloc.num_allocated
+                          == self.cache_cfg.usable_blocks),
+        }
+
     # -- convenience -------------------------------------------------------
     def run_until_idle(self, *, max_steps: int = 100000,
                        retry_faults: bool = False) -> dict:
@@ -727,6 +988,12 @@ class InferenceEngine:
             "blocks_free": sched.allocator.num_free,
             "blocks_total": self.cache_cfg.usable_blocks,
             "preemptions": sched.preemptions,
+            "deferred_prefill": sched.deferred_prefill,
+            "deferred_blocks": sched.deferred_blocks,
+            "migrated_out": sched.migrated_out,
+            "migrations_out": self.migrations_out,
+            "migrations_in": self.migrations_in,
+            "migrated_bytes": self.migrated_bytes,
             "queue_rejected": sched.queue.rejected,
             "queue_evicted": sched.queue.evicted,
             "requests_completed": self._m_completed.value,
@@ -737,6 +1004,8 @@ class InferenceEngine:
         }
         if sched.prefix_cache is not None:
             out["prefix_cache"] = sched.prefix_cache.stats()
+        if self.spill_tier is not None:
+            out["spill_tier"] = self.spill_tier.stats()
         if self.spec_k:
             prop = self._spec_proposed_n
             out["speculative"] = {

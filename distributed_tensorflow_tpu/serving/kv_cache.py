@@ -181,6 +181,15 @@ class BlockAllocator:
     def num_allocated(self) -> int:
         return len(self._refs)
 
+    @property
+    def total_refs(self) -> int:
+        """Sum of live references across all allocated blocks — the
+        conservation quantity the disaggregated chaos gate audits:
+        every ref must be owned by a running sequence's table or a
+        prefix-cache entry, so ``total_refs - cache_entries -
+        Σ len(table.blocks) == 0`` or blocks leaked."""
+        return sum(self._refs.values())
+
     def refcount(self, block: int) -> int:
         """Live references on ``block`` (0 = free). Refcount > 1 means
         SHARED: writers must copy first (BlockTable.ensure_writable)."""
@@ -323,6 +332,85 @@ class _CacheEntry:
         self.last_used = last_used
 
 
+class _SpillEntry:
+    __slots__ = ("key", "parent", "tokens", "arrays", "epoch")
+
+    def __init__(self, key, parent, tokens, arrays, epoch):
+        self.key = key
+        self.parent = parent
+        self.tokens = tokens
+        self.arrays = arrays          # host copies of the block's rows
+        self.epoch = epoch            # pool epoch of the spilling engine
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays.values())
+
+
+class HostTier:
+    """Host-memory spill tier for cold :class:`PrefixCache` blocks.
+
+    When the prefix cache must evict a block (pool pressure), the
+    block's K/V rows — quantisation scales included — are copied to
+    host RAM instead of being dropped; a later prompt that walks the
+    same chain re-adopts the block into a fresh pool slot bit-exactly
+    (tests/test_migrate.py pins the round-trip per ``kv_dtype``). The
+    tier holds NO allocator references — its entries are plain host
+    bytes keyed by the same chain key the cache indexes by.
+
+    **Epoch fencing.** Every entry records the spilling engine's
+    ``pool_epoch``. A restarted engine has a NEW epoch, so a stale
+    spill (possibly from different weights or a different pool layout)
+    is rejected at re-adoption rather than served — the cache then just
+    prefill-recomputes, which is always correct.
+
+    Capacity is bounded (``capacity_blocks``); insertion past it drops
+    the least-recently-touched spilled block."""
+
+    def __init__(self, capacity_blocks: int = 256):
+        if capacity_blocks < 1:
+            raise ValueError("capacity_blocks must be >= 1")
+        self.capacity_blocks = capacity_blocks
+        import collections
+        self._entries: "collections.OrderedDict[tuple, _SpillEntry]" = \
+            collections.OrderedDict()
+        self.spilled = 0
+        self.readopted = 0
+        self.rejected = 0
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def put(self, key, parent, tokens, arrays, epoch):
+        if key in self._entries:
+            self._entries.pop(key)
+        while len(self._entries) >= self.capacity_blocks:
+            self._entries.popitem(last=False)
+            self.dropped += 1
+        self._entries[key] = _SpillEntry(key, parent, tokens, arrays,
+                                         epoch)
+        self.spilled += 1
+
+    def get(self, key) -> "_SpillEntry | None":
+        e = self._entries.get(key)
+        if e is not None:
+            self._entries.move_to_end(key)
+        return e
+
+    def drop(self, key):
+        self._entries.pop(key, None)
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "nbytes": self.nbytes,
+                "spilled": self.spilled, "readopted": self.readopted,
+                "rejected": self.rejected, "dropped": self.dropped}
+
+
 class PrefixCache:
     """Content index over committed prompt-prefix blocks (cross-request
     KV reuse — the vLLM "automatic prefix caching" idea on this pool).
@@ -361,9 +449,28 @@ class PrefixCache:
         self.hit_requests = 0
         self.lookups = 0
         self.evictions = 0
+        self._spill: HostTier | None = None
+        self._spill_extract = None
+        self._spill_insert = None
+        self._spill_epoch = None
+        self.spill_hits = 0
+        self.spill_rejects = 0
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    def attach_spill(self, tier: HostTier, *, extract, insert, epoch):
+        """Wire a :class:`HostTier` behind this cache. ``extract(block)
+        -> {name: np.ndarray}`` copies one block's pool rows (plus
+        scales) to host; ``insert(block, arrays)`` writes them back
+        into a freshly allocated block; ``epoch`` is the engine's
+        ``pool_epoch`` fence (stale entries from a previous engine
+        incarnation are rejected on re-adoption). The engine provides
+        all three — the cache stays device-agnostic."""
+        self._spill = tier
+        self._spill_extract = extract
+        self._spill_insert = insert
+        self._spill_epoch = epoch
 
     def match(self, tokens) -> tuple[int, list[int]]:
         """``(n_cached_tokens, blocks)`` — the longest cached chain
@@ -384,6 +491,11 @@ class PrefixCache:
         while n + bs <= limit:
             k = (key, tokens[n:n + bs])
             e = self._entries.get(k)
+            if e is None:
+                # Chain miss on device — maybe the block was spilled to
+                # the host tier. Re-adoption is full-block only: the
+                # partial-hop heuristic below stays device-resident.
+                e = self._readopt(k, key)
             if e is None:
                 break
             e.last_used = self._clock
@@ -455,6 +567,13 @@ class PrefixCache:
                     victim = e
             if victim is None:
                 break
+            if self._spill is not None:
+                # Victim selection above already guarantees refcount 1
+                # (the cache's own ref): a block any sequence shares is
+                # never spilled, only truly cold cache-private blocks.
+                self._spill.put(victim.key, victim.parent, victim.tokens,
+                                self._spill_extract(victim.block),
+                                self._spill_epoch)
             del self._entries[victim.key]
             kids = self._children.get(victim.parent)
             if kids is not None:
@@ -466,6 +585,33 @@ class PrefixCache:
             freed += 1
         return freed
 
+    def _readopt(self, key, chain_key) -> "_CacheEntry | None":
+        """Try to pull a spilled block back into the pool on a chain
+        miss. Needs one free block; a stale entry (pool-epoch mismatch
+        — the engine restarted since the spill) is dropped and counted
+        in ``spill_rejects`` instead of being served."""
+        if self._spill is None:
+            return None
+        se = self._spill.get(key)
+        if se is None:
+            return None
+        if se.epoch != self._spill_epoch:
+            self._spill.drop(key)
+            self._spill.rejected += 1
+            self.spill_rejects += 1
+            return None
+        if self._alloc.num_free < 1:
+            return None
+        block = self._alloc.alloc(1)[0]       # cache-owned reference
+        self._spill_insert(block, se.arrays)
+        self._spill.drop(key)
+        self._spill.readopted += 1
+        self.spill_hits += 1
+        e = _CacheEntry(key, chain_key, block, se.tokens, self._clock)
+        self._entries[key] = e
+        self._children.setdefault(chain_key, set()).add(key)
+        return e
+
     def stats(self) -> dict:
         return {
             "entries": len(self._entries),
@@ -476,6 +622,8 @@ class PrefixCache:
             "hit_rate": (self.hit_tokens / self.lookup_tokens
                          if self.lookup_tokens else 0.0),
             "evictions": self.evictions,
+            "spill_hits": self.spill_hits,
+            "spill_rejects": self.spill_rejects,
         }
 
 

@@ -155,6 +155,48 @@ def completed_ids(path: str) -> dict[str, list]:
     return out
 
 
+def _handoff_index(kv) -> dict:
+    """``{request_id: blob_prefix}`` of committed drain-by-migration
+    handoffs, HIGHEST generation winning (a request drained twice has
+    one blob per draining generation; later = more decode progress).
+    Keys are ``handoff/g<gen>/t<task>/<rid>`` — per-generation
+    namespaces, so a republish never rewrites chunks under a committed
+    count key."""
+    best: dict = {}
+    for key in kv.list("handoff/"):
+        if not key.endswith("/n"):
+            continue
+        parts = key.split("/")      # handoff, g<gen>, t<task>, rid, n
+        if len(parts) != 5:
+            continue
+        try:
+            gen = int(parts[1][1:])
+        except ValueError:
+            continue
+        rid = parts[3]
+        if rid not in best or gen > best[rid][0]:
+            best[rid] = (gen, key[:-len("/n")])
+    return {rid: pfx for rid, (g, pfx) in best.items()}
+
+
+def _try_adopt(engine, kv, prefix: str, timeout_s: float = 2.0) -> str:
+    """Adopt one committed migration blob. Returns ``"adopted"``,
+    ``"full"`` (fits but no capacity RIGHT NOW — retry later) or
+    ``"bad"`` (fingerprint mismatch / corrupt blob — the caller must
+    re-serve from the prompt, which is always correct)."""
+    from distributed_tensorflow_tpu.serving import migrate as _mig
+    try:
+        payload = _mig.fetch_payload(kv, prefix, timeout_s=timeout_s)
+        if payload.fingerprint != engine.pool_fingerprint():
+            return "bad"
+        if not engine.can_adopt(payload):
+            return "full"
+        engine.adopt_sequence(payload)
+        return "adopted"
+    except Exception:
+        return "bad"
+
+
 def serving_replica(run_dir: str, n_requests: int, seed: int,
                     vocab_size: int = 256, *, max_retries: int = 50,
                     engine_kwargs: dict | None = None,
@@ -163,7 +205,8 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                     spike: dict | None = None,
                     prefix_caching: bool = False,
                     speculative_k: int = 0,
-                    kv_dtype: str | None = None):
+                    kv_dtype: str | None = None,
+                    disagg: bool = False):
     """One generation of one supervised serving replica.
 
     Serves the seeded workload to completion, heartbeating every engine
@@ -184,7 +227,22 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     KV-dtype table), so the cross-generation byte-identical-duplicates
     gate holds with them enabled, and a restarted incarnation simply
     rebuilds its prefix cache cold: correctness never depends on cache
-    state. Returns ``(task_index, n_served_this_generation,
+    state.
+
+    ``disagg=True`` (needs >= 2 replicas) splits the fleet by ROLE:
+    task 0 is the prefill replica — it owns ALL admission, prefills
+    every prompt, and publishes each sequence's KV blocks as a
+    write-once migration blob (serving/migrate.py) keyed to its decode
+    owner; tasks >= 1 are decode replicas that adopt their blobs and
+    run the token loop. A SIGKILL on either side is safe by the blob
+    commit protocol: an uncommitted blob is re-published by the next
+    prefill incarnation, a committed one is re-adopted by the next
+    decode incarnation, and greedy determinism keeps any duplicate
+    completions byte-identical. Drain mode ``migrate`` exports live
+    sequences to per-generation handoff blobs the next incarnation
+    adopts — progress moves, nothing replays.
+
+    Returns ``(task_index, n_served_this_generation,
     n_total_completed)``."""
     from distributed_tensorflow_tpu.cluster import bootstrap, elastic
 
@@ -240,8 +298,12 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
         done = completed_ids_all(run_dir)
     else:
         workload = seeded_requests(seed, n_requests, vocab_size)
-        done = completed_ids(os.path.join(run_dir,
-                                          f"served-{task}.jsonl"))
+        # disagg reads the UNION: completions land in the decode
+        # replicas' logs, and the prefill replica must not re-admit them
+        done = (completed_ids_all(run_dir) if disagg
+                else completed_ids(os.path.join(run_dir,
+                                                f"served-{task}.jsonl")))
+    disagg = bool(disagg) and n_replicas >= 2 and spike is None
 
     cfg = TransformerConfig.tiny(max_seq_len=64)
     kwargs = dict(num_blocks=48, block_size=8, max_slots=4,
@@ -251,6 +313,8 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                   speculative_k=speculative_k,
                   kv_dtype=kv_dtype)
     kwargs.update(engine_kwargs or {})
+    if disagg and task == 0:
+        kwargs["role"] = "prefill"      # no decode program compiled
     if ckpt_dir:
         engine = InferenceEngine.from_checkpoint(cfg, ckpt_dir, **kwargs)
     else:
@@ -276,11 +340,28 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
         engine.run_until_idle(retry_faults=True)
         epoch = run_epoch(run_dir)
 
+    from distributed_tensorflow_tpu.serving import migrate as _mig
+    kv = _mig.FileKV(os.path.join(run_dir, "kvwire"))
+    n_dec = max(1, n_replicas - 1)
+
+    def _dtask(rid: str) -> int:
+        """Deterministic request -> decode-replica owner (disagg): the
+        same id maps to the same decode task in every incarnation, so
+        a respawned decoder knows exactly which blobs are its."""
+        return 1 + int(rid.lstrip("rs")) % n_dec
+
     log_path = os.path.join(run_dir, f"served-{task}.jsonl")
-    # replicas statically shard the workload (request i -> replica
-    # i mod N); the union of all replicas' completion logs must cover
-    # the full request set — the chaos sweep's zero-dropped gate
-    mine = [r for i, r in enumerate(workload) if i % n_replicas == task]
+    if disagg:
+        # role sharding: prefill (task 0) owns every admission, decode
+        # task d owns the requests _dtask maps to it
+        mine = (list(workload) if task == 0
+                else [r for r in workload if _dtask(r.id) == task])
+    else:
+        # replicas statically shard the workload (request i -> replica
+        # i mod N); the union of all replicas' completion logs must
+        # cover the full request set — the chaos zero-dropped gate
+        mine = [r for i, r in enumerate(workload)
+                if i % n_replicas == task]
     todo = [r for r in mine if r.id not in done]
     gen = elastic.generation()
     print(f"[gen {gen} serve-{task}] {len(mine) - len(todo)} already "
@@ -293,9 +374,24 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
     import collections as _collections
     import time as _time
     pending = _collections.deque(todo)   # arrival order == index order
-    if spike is None:
+    finished_ids: set = set()
+    if spike is None and not disagg:
+        # drain-by-migration handoffs from a previous generation: adopt
+        # the live KV (decode continues, zero replay) instead of
+        # re-serving from the prompt; anything that does not fit or
+        # match simply submits — correctness never depends on a blob
+        handoffs = _handoff_index(kv)
+        adopted_n = 0
         for r in todo:
-            engine.submit(r)
+            pfx = handoffs.get(r.id)
+            if pfx is not None and _try_adopt(engine, kv,
+                                              pfx) == "adopted":
+                adopted_n += 1
+            else:
+                engine.submit(r)
+        if adopted_n:
+            print(f"[gen {gen} serve-{task}] adopted {adopted_n} "
+                  f"drained sequence(s) by KV migration", flush=True)
         pending.clear()
 
     def _log_finished(log, finished):
@@ -306,6 +402,7 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                 "prompt_tokens": rec["prompt_tokens"],
                 "latency_s": round(rec["latency_s"], 6),
                 "gen": gen}) + "\n")
+            finished_ids.add(rec["id"])
             served += 1
 
     def _step(log) -> bool:
@@ -324,11 +421,16 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
         NOW): finish only the RUNNING sequences, the queue re-shards.
         ``full`` (scale-down: load is low by definition): finish
         everything already admitted, so no accepted request pays the
-        respawn gap's latency tail. Either way nothing is dropped —
-        whatever is left re-shards onto the next generation via the
-        completion-log union."""
+        respawn gap's latency tail. ``migrate`` (fastest, zero wasted
+        work): EXPORT every running sequence's live KV to a
+        per-generation handoff blob a survivor/successor adopts —
+        decode continues where it stopped instead of finishing here or
+        replaying there. Either way nothing is dropped — whatever is
+        left re-shards onto the next generation via the completion-log
+        union."""
         nonlocal drained
         held = 0
+        migrated = 0
         if mode == "full":
             while not engine.scheduler.idle:
                 elastic.heartbeat(step)
@@ -336,13 +438,119 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
         else:
             while engine.scheduler.queue.pop() is not None:
                 held += 1
+            if mode == "migrate":
+                for seq in sorted(engine.scheduler.running.values(),
+                                  key=lambda s: s.slot):
+                    if not seq.prefilled or seq.done:
+                        continue
+                    rid = seq.request.id
+                    payload = engine.export_sequence(seq,
+                                                     reason="drain")
+                    _mig.publish_payload(
+                        kv, f"handoff/g{gen}/t{task}/{rid}", payload)
+                    migrated += 1
             while engine.scheduler.running:
                 elastic.heartbeat(step)
                 _step(log)
         tv_events.event("serve.drain", task=task, mode=mode,
                         completed=served,
-                        requeued=held + len(pending))
+                        requeued=held + len(pending),
+                        migrated=migrated or None)
         drained = True
+
+    def _alloc_check():
+        """Allocator conservation audit at generation end — the chaos
+        --disagg gate asserts zero leaked refs on EVERY one of these,
+        so a migration path that drops or duplicates block ownership
+        fails loudly, not silently."""
+        tv_events.event("serve.alloc_check", task=task,
+                        **engine.block_accounting())
+
+    def _finish(msg: str):
+        elastic.heartbeat(step)
+        _alloc_check()
+        print(f"[gen {gen} serve-{task}] {msg}", flush=True)
+        goodput.activate(None)
+        if tdir:
+            tv_events.shutdown()
+        bootstrap.shutdown()
+
+    if disagg and task == 0:
+        # ---- prefill replica: admit everything, prefill, publish ----
+        pending.clear()
+        todo = [r for r in todo
+                if not _mig.payload_committed(
+                    kv, f"mig/d{_dtask(r.id)}/{r.id}")]
+        with open(log_path, "a", buffering=1) as log:
+            for r in todo:
+                engine.submit(r)
+            while not engine.scheduler.idle:
+                elastic.heartbeat(step)
+                if elastic.drain_mode() is not None:
+                    # nothing decodes here: every prefilled sequence is
+                    # exported the step it commits, so drain just stops
+                    # admitting — the queue re-shards next generation
+                    drained = True
+                    break
+                if step_delay_s:
+                    _time.sleep(step_delay_s)
+                _step(log)        # admit + prefill (+ scoring finishes)
+                step += 1
+                for seq in sorted(engine.scheduler.running.values(),
+                                  key=lambda s: s.slot):
+                    if seq.prefilled and not seq.done:
+                        rid = seq.request.id
+                        payload = engine.export_sequence(
+                            seq, reason="prefill")
+                        _mig.publish_payload(
+                            kv, f"mig/d{_dtask(rid)}/{rid}", payload)
+        _finish(f"prefilled+shipped, {served} completed at prefill "
+                f"({'drained' if drained else 'complete'}), "
+                f"{retries} injected-fault retries")
+        return task, served, served
+
+    if disagg:
+        # ---- decode replica: adopt my blobs, run the token loop -----
+        pending.clear()
+        todo_by_id = {r.id: r for r in todo}
+        todo_ids = set(todo_by_id)
+        shipped: set = set()
+        # a previous incarnation (possibly of ANOTHER task, before a
+        # reshard) may have drained by migration: its handoff blobs
+        # carry more decode progress than the original prefill blob —
+        # prefer them
+        handoffs = _handoff_index(kv)
+        with open(log_path, "a", buffering=1) as log:
+            while todo_ids - finished_ids:
+                elastic.heartbeat(step)
+                mode = elastic.drain_mode()
+                if mode is not None:
+                    _drain(log, mode if mode == "full" else "migrate")
+                    break
+                for rid in sorted(todo_ids - finished_ids - shipped):
+                    pfx = handoffs.get(rid, f"mig/d{task}/{rid}")
+                    if not _mig.payload_committed(kv, pfx):
+                        continue
+                    got = _try_adopt(engine, kv, pfx)
+                    if got == "full":
+                        break           # capacity frees as seqs finish
+                    if got == "bad":
+                        # stale/incompatible blob: serve from the
+                        # prompt — greedy determinism keeps the output
+                        # identical, only the KV shortcut is lost
+                        engine.submit(todo_by_id[rid])
+                    shipped.add(rid)
+                if engine.scheduler.running:
+                    if step_delay_s:
+                        _time.sleep(step_delay_s)
+                    _step(log)
+                    step += 1
+                else:
+                    _time.sleep(0.01)   # blobs still in flight
+        _finish(f"served {served} this generation "
+                f"({'drained' if drained else 'complete'}), "
+                f"{retries} injected-fault retries")
+        return task, served, len(mine) - len(todo) + served
 
     end_rel = (float(spike.get("duration_s", 40.0)) + linger_s
                if spike is not None else 0.0)
@@ -380,12 +588,7 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
                 _time.sleep(step_delay_s)
             _step(log)
             step += 1
-    elastic.heartbeat(step)
-    print(f"[gen {gen} serve-{task}] served {served} this generation "
-          f"({'drained' if drained else 'complete'}), "
-          f"{retries} injected-fault retries", flush=True)
-    goodput.activate(None)
-    if tdir:
-        tv_events.shutdown()
-    bootstrap.shutdown()
+    _finish(f"served {served} this generation "
+            f"({'drained' if drained else 'complete'}), "
+            f"{retries} injected-fault retries")
     return task, served, len(mine) - len(todo) + served

@@ -203,6 +203,30 @@ class ContinuousBatchingScheduler:
         self.running: dict[int, Sequence] = {}      # slot -> sequence
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self.preemptions = 0
+        #: admission deferrals split BY CAUSE (satellite of the
+        #: disaggregation PR): ``deferred_prefill`` — the head request
+        #: didn't fit the step's prefill token budget (compute-bound
+        #: prefill interference, the thing disaggregation removes);
+        #: ``deferred_blocks`` — the pool had too few free blocks even
+        #: after cache eviction (capacity, which disaggregation does
+        #: NOT fix). The engine stamps per-step deltas on serve.step.
+        self.deferred_prefill = 0
+        self.deferred_blocks = 0
+        reg = telemetry.get_registry()
+        self._m_deferred_prefill = reg.counter(
+            "serving/deferred_prefill_total",
+            "admissions deferred by the prefill token budget "
+            "(prefill/decode interference — disaggregation removes)")
+        self._m_deferred_blocks = reg.counter(
+            "serving/deferred_blocks_total",
+            "admissions deferred by pool exhaustion (KV capacity — "
+            "disaggregation does not remove)")
+        #: optional callable(victim: Sequence) -> bool installed by the
+        #: disaggregated engine: return True to take OWNERSHIP of a
+        #: preemption victim (migrate its live KV to another replica)
+        #: instead of the replay requeue. See _preempt_newest.
+        self.preempt_hook = None
+        self.migrated_out = 0
         self.prefix_cache = (PrefixCache(self.allocator,
                                          cache_cfg.block_size)
                              if prefix_caching else None)
@@ -227,6 +251,8 @@ class ContinuousBatchingScheduler:
             if need > budget and (admitted or self.running):
                 if cblocks:                 # hand the match refs back
                     self.allocator.free(cblocks)
+                self.deferred_prefill += 1
+                self._m_deferred_prefill.increment()
                 break                       # never starves: alone it runs
             blocks_needed = self.cache_cfg.blocks_for(len(req.tokens) + 1)
             if blocks_needed > self.max_blocks_per_seq:
@@ -245,6 +271,8 @@ class ContinuousBatchingScheduler:
                 if grow > self.allocator.num_free:
                     if cblocks:
                         self.allocator.free(cblocks)
+                    self.deferred_blocks += 1
+                    self._m_deferred_blocks.increment()
                     break                   # wait for blocks to free up
             self.queue.pop()
             slot = self._free_slots.pop()
@@ -324,6 +352,14 @@ class ContinuousBatchingScheduler:
         del self.running[victim.slot]
         self._free_slots.append(victim.slot)
         self._free_slots.sort(reverse=True)
+        if self.preempt_hook is not None and victim.prefilled \
+                and self.preempt_hook(victim):
+            # The hook took ownership: the victim's live KV migrated to
+            # another replica (blocks + bookkeeping released there), so
+            # there is nothing to replay — the request is NOT requeued
+            # and this is not a replay preemption.
+            self.migrated_out += 1
+            return victim
         victim.table.release(self.allocator)
         # generated tokens become prompt suffix: greedy decode replays
         # them identically on re-admission (deterministic outputs), and
@@ -338,6 +374,34 @@ class ContinuousBatchingScheduler:
         victim.preemptions += 1
         self.preemptions += 1
         return victim
+
+    def adopt(self, request: Request, blocks: list[int], length: int,
+              generated) -> Sequence:
+        """Install an ALREADY-PREFILLED sequence (KV migrated in from
+        another replica — see serving/migrate.py). ``blocks`` are
+        freshly allocated on THIS scheduler's allocator and hold the
+        sequence's first ``length`` cache rows; ``generated`` are
+        tokens produced elsewhere, kept as live generation state (NOT
+        ``generated_prefix``) so the handoff replays nothing. Raises
+        when no slot is free — the migration source must check capacity
+        before shipping."""
+        if not self._free_slots:
+            raise OutOfBlocksError(
+                f"adopt({request.id}): no free slot "
+                f"(max_slots={self.max_slots})")
+        if len(blocks) > self.max_blocks_per_seq:
+            raise OutOfBlocksError(
+                f"adopt({request.id}): {len(blocks)} blocks > "
+                f"max_blocks_per_seq={self.max_blocks_per_seq}")
+        slot = self._free_slots.pop()
+        table = BlockTable(self.cache_cfg, self.max_blocks_per_seq)
+        table.blocks = list(blocks)         # caller's refs transfer here
+        table.length = length
+        seq = Sequence(request, slot, table)
+        seq.generated = [int(t) for t in generated]
+        seq.prefilled = True
+        self.running[slot] = seq
+        return seq
 
     def append_token(self, seq: Sequence, token: int):
         seq.table.length += 1

@@ -28,6 +28,12 @@ module classifies **every wall-clock second** of every worker into
                       auditable, resilience/autoscaler.py)
   ``preempt_replay``  serving decode time spent re-generating tokens a
                       preempted/killed sequence had already produced
+  ``kv_migrate``      KV-block migration time (``kv.migrate`` spans):
+                      disaggregated prefill→decode handoff, drain-by-
+                      migration, rescue — the honest price of NOT
+                      replaying (serving/migrate.py); a drain that
+                      migrates moves seconds from ``preempt_replay``
+                      into this much smaller bucket
   ``idle``            everything unattributed (gaps between steps,
                       drain after the last step)
   ==================  ==================================================
@@ -61,7 +67,8 @@ from distributed_tensorflow_tpu.telemetry import registry as _registry
 #: Badput bucket names, in render order. ``idle`` is the residual that
 #: makes the identity exact.
 BADPUT_BUCKETS = ("startup", "infeed_wait", "ckpt_block", "recovery",
-                  "scale_transition", "preempt_replay", "idle")
+                  "scale_transition", "preempt_replay", "kv_migrate",
+                  "idle")
 
 #: Step events whose duration is (mostly) goodput.
 _STEP_EVENTS = frozenset({"train.step", "serve.step"})
@@ -152,6 +159,16 @@ def _worker_ledger(events: "list[dict]",
                 out["goodput_s"] += span - infeed - ckpt
             else:                        # serve.step
                 serve_s += span
+            cursor = wall
+        elif name == "kv.migrate":
+            # KV handoff (export or adopt) is honest badput: the chip
+            # moved cache rows instead of computing tokens. The event
+            # also ADVANCES the cursor, so a migration nested inside a
+            # serve.step span is clipped out of that step's serve share
+            # by the standard overlap rule — never double-counted.
+            start = max(cursor, wall - dur)
+            bad["startup" if in_startup else "idle"] += start - cursor
+            bad["kv_migrate"] += wall - start
             cursor = wall
         elif name == "serve.request":
             rt = ev.get("replayed_tokens")

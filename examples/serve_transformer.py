@@ -14,6 +14,13 @@ deterministic). Render the run with ``tools/obs_report.py
 <telemetry-dir>`` — serving request latency and the recovery timeline
 share one report.
 
+``--elastic --disagg`` splits the replica fleet into one prefill
+replica (task 0: owns admission, migrates each prefilled sequence's KV
+blocks to a decode task over the write-once chunked blob transport) and
+N-1 decode replicas. Greedy outputs stay byte-identical to the
+monolithic fleet; chaos kills exercise prefill death mid-migration and
+decode death while holding adopted blocks.
+
 With ``--ckpt-dir`` the replicas restore weights down the checkpoint
 recovery ladder (CheckpointManager.restore_latest — host snapshot >
 peer replica > local disk > durable disk); ``--write-ckpt`` first
@@ -99,11 +106,33 @@ def write_checkpoint(ckpt_dir: str):
     print(f"wrote serving checkpoint to {ckpt_dir}")
 
 
+def disagg_kill_plan(seed: int, num_workers: int, kills: int,
+                     step_range):
+    """Disaggregation-aware chaos schedule: alternate kills between the
+    prefill replica (task 0 — dies mid-migration, since it exports KV
+    blobs every step) and a seed-chosen decode replica (dies holding
+    adopted blocks). Same seeding discipline as seeded_kill_plan."""
+    import random
+
+    from distributed_tensorflow_tpu.resilience import KillSpec
+
+    rng = random.Random(f"dtx-kill-disagg:{seed}")
+    plan = []
+    for i in range(kills):
+        worker = 0 if i % 2 == 0 else rng.randrange(1, num_workers)
+        plan.append(KillSpec(worker=worker,
+                             after_step=rng.randrange(*step_range)))
+    return plan
+
+
 def run_elastic(args):
     from distributed_tensorflow_tpu.resilience import (
         RecoverySupervisor, seeded_kill_plan)
     from distributed_tensorflow_tpu.serving.replica import serving_replica
 
+    if args.disagg and args.workers < 2:
+        raise SystemExit("--disagg needs --workers >= 2 "
+                         "(one prefill + at least one decode replica)")
     run_dir = args.run_dir or args.telemetry_dir
     if not run_dir:
         import tempfile
@@ -114,9 +143,14 @@ def run_elastic(args):
         # kill step range sized to the per-replica workload so the
         # SIGKILL lands while requests are genuinely in flight
         per_replica = max(1, args.requests // args.workers)
-        kill_plan = seeded_kill_plan(
-            args.kill_seed, args.workers, kills=args.kills,
-            step_range=(3, max(6, per_replica)))
+        step_range = (3, max(6, per_replica))
+        if args.disagg:
+            kill_plan = disagg_kill_plan(
+                args.kill_seed, args.workers, args.kills, step_range)
+        else:
+            kill_plan = seeded_kill_plan(
+                args.kill_seed, args.workers, kills=args.kills,
+                step_range=step_range)
         print(f"chaos kill plan (seed {args.kill_seed}): {kill_plan}")
     sup = RecoverySupervisor(
         serving_replica, num_workers=args.workers,
@@ -125,7 +159,8 @@ def run_elastic(args):
                 "step_delay_s": args.step_delay,
                 "prefix_caching": args.prefix_cache,
                 "speculative_k": args.speculative,
-                "kv_dtype": args.kv_dtype},
+                "kv_dtype": args.kv_dtype,
+                "disagg": args.disagg},
         max_restarts=args.restart_budget, kill_plan=kill_plan,
         generation_timeout_s=args.generation_timeout,
         telemetry_dir=args.telemetry_dir)
@@ -185,6 +220,11 @@ def main():
                     choices=("f32", "bf16", "int8"),
                     help="KV-pool storage dtype (int8: quantized, "
                          "2x+ slots per chip)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="elastic: disaggregated prefill/decode — task "
+                         "0 prefills and migrates KV blocks to decode "
+                         "tasks 1..N-1 over the chunked blob transport "
+                         "(needs --workers >= 2)")
     args = ap.parse_args()
 
     if args.write_ckpt:

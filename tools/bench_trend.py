@@ -33,7 +33,11 @@ Series:
   ``serving/accepted_draft_rate/<point>`` gate NON-inverted (a cache
   or draft that stops earning its keep fails), tolerating their
   absence in SERVING_r01-era files (the series just starts at the
-  first round that carries them);
+  first round that carries them); disaggregated rows (ISSUE 16,
+  ``extra.disagg``) key with a ``dg`` point suffix and add two more
+  inverted series — ``serving/decode_p99_ms/<point>`` (decode TBT
+  tail under the prefill burst) and ``serving/migrate_p99_ms/<point>``
+  (the KV-block migration latency tail);
 - ``fleet/ops_per_sec/nNNNN`` + ``fleet/detect_ms/nNNNN`` /
   ``fleet/mttr_ms/nNNNN`` — the ``FLEET_r*.json`` simulated-fleet
   control-plane rows per worker count (bench.py --fleet /
@@ -151,6 +155,8 @@ def _serving_point(extra: dict) -> str:
         point += f"kv{kd}"
     if extra.get("speculative_k"):
         point += f"sp{extra['speculative_k']}"
+    if extra.get("disagg"):
+        point += "dg"
     return point
 
 
@@ -176,7 +182,12 @@ def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
                 "unit": row.get("unit"),
                 "qps_achieved": extra.get("qps_achieved"),
             }
-            for lat in ("p50_latency_ms", "p99_latency_ms"):
+            for lat in ("p50_latency_ms", "p99_latency_ms",
+                        # disagg columns (ISSUE 16): decode TBT tail
+                        # under the prefill burst + the KV-block
+                        # migration latency series, both inverted (a
+                        # tail that grows fails)
+                        "decode_p99_ms", "migrate_p99_ms"):
                 if isinstance(extra.get(lat), (int, float)):
                     series.setdefault(f"serving/{lat}/{pt}", {})[rnd] = {
                         "value": extra[lat], "lower_is_better": True}

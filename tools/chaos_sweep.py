@@ -40,6 +40,19 @@ ZERO dropped requests: the union of ``served-*.jsonl`` ids equals the
 full seeded request set, with any cross-generation duplicates having
 generated IDENTICAL tokens (deterministic re-serve).
 
+``--serve --disagg`` (ISSUE 16) runs the DISAGGREGATED topology
+instead (``serve_transformer --elastic --disagg``, >= 3 workers: task
+0 prefills and migrates KV blocks, tasks 1..N-1 decode) with a
+disaggregation-aware kill schedule: one SIGKILL lands on the prefill
+replica mid-migration, one on a decode replica holding adopted
+blocks. On top of the zero-dropped / byte-identical-duplicate gates,
+every ``serve.alloc_check`` event must show block-allocator
+conservation (``leaked_refs`` == 0, ``conserved``) with at least one
+present — a migration torn by SIGKILL may never leak a block — the
+``kv_migrate`` badput bucket must be priced (> 0s), and
+``preempt_replay`` must stay under 1%% of wall: live KV handoff, not
+replay, is how in-flight work survives.
+
 ``--data`` sweeps the DISAGGREGATED-INPUT axis (ISSUE 12): each seed
 runs a supervised data-service mnist job (examples/train_mnist.py
 --data-service — task 0 trains and dispatches FILE splits, tasks 1..M
@@ -91,6 +104,7 @@ Usage::
     python tools/chaos_sweep.py --kill --seeds 3      # SIGKILL sweep
     python tools/chaos_sweep.py --kill --shrink --workers 3 --seeds 3
     python tools/chaos_sweep.py --serve --seeds 3     # serving sweep
+    python tools/chaos_sweep.py --serve --disagg --seeds 3  # disagg
     python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
@@ -643,14 +657,69 @@ def _served_requests_gate(run_dir: str, n_requests: int,
     return bad
 
 
+def _alloc_conservation_gate(run_dir: str) -> "list[str]":
+    """Block-allocator conservation under migration chaos (ISSUE 16):
+    every replica emits a ``serve.alloc_check`` at exit — free +
+    allocated must equal the pool, and every live ref must be owned by
+    a sequence or the prefix cache (``leaked_refs`` == 0). A SIGKILL
+    mid-migration that leaks blocks shows up here even though the run
+    'worked'. At least one check must be present."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry.events import read_run
+    checks, bad = 0, []
+    for pid, events in read_run(run_dir).items():
+        for ev in events:
+            if ev.get("ev") != "serve.alloc_check":
+                continue
+            checks += 1
+            if ev.get("leaked_refs") or not ev.get("conserved"):
+                bad.append(
+                    f"p{pid} task{ev.get('task')} gen{ev.get('gen')}: "
+                    f"allocator NOT conserved — leaked_refs="
+                    f"{ev.get('leaked_refs')} free={ev.get('free')} "
+                    f"allocated={ev.get('allocated')}")
+    if checks == 0:
+        bad.append("no serve.alloc_check events recorded — the leak "
+                   "gate never ran")
+    return bad
+
+
+def _migrate_ledger_gate(run_dir: str,
+                         max_replay_frac: float = 0.01) -> "list[str]":
+    """The disagg pricing gate: migrations must be visibly priced into
+    the ``kv_migrate`` badput bucket, and ``preempt_replay`` must stay
+    under ``max_replay_frac`` of wall — in-flight work survives kills
+    by live KV handoff (re-adopting committed blobs), not by replaying
+    decode steps."""
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry import goodput
+    ledger = goodput.ledger_from_run(run_dir)
+    bad = []
+    wall = ledger["wall_s"]
+    if ledger["badput_s"].get("kv_migrate", 0.0) <= 0:
+        bad.append("0s priced into the kv_migrate bucket — migrations "
+                   "either did not run or were not priced")
+    replay = ledger["badput_s"].get("preempt_replay", 0.0)
+    if wall > 0 and replay / wall > max_replay_frac:
+        bad.append(f"preempt_replay {replay:.3f}s is "
+                   f"{replay / wall:.1%} of wall (> "
+                   f"{max_replay_frac:.0%}) — migration should have "
+                   f"made replay ~0")
+    return bad
+
+
 def run_serve_seed(seed: int, *, workers: int, requests: int,
                    budget: int, keep_dirs: bool,
-                   goodput_floor: "float | None" = None) \
+                   goodput_floor: "float | None" = None,
+                   disagg: bool = False) \
         -> tuple[bool, float]:
     """One supervised serving run with a seed-derived replica SIGKILL;
     survival = clean exit + recovery & serving telemetry + zero dropped
-    requests (see ``--serve`` in the module docstring)."""
-    run_dir = tempfile.mkdtemp(prefix=f"chaos_serve_s{seed}_")
+    requests (see ``--serve`` in the module docstring). With
+    ``disagg``, the disaggregated topology plus the allocator-
+    conservation and migrate-pricing gates (``--serve --disagg``)."""
+    kind = "serve_disagg" if disagg else "serve"
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_{kind}_s{seed}_")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     cmd = [sys.executable,
@@ -659,13 +728,19 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
            "--requests", str(requests), "--seed", str(seed),
            "--kill-seed", str(seed),
            "--restart-budget", str(budget),
-           # serving-speed features ON under chaos (ISSUE 14): the
-           # SIGKILLed replica restarts with a COLD prefix cache and a
-           # fresh draft, and the zero-dropped / byte-identical-
-           # duplicate gates below prove correctness never depended on
-           # cache or speculation state
-           "--prefix-cache", "--speculative", "2",
            "--run-dir", run_dir, "--telemetry-dir", run_dir]
+    if disagg:
+        # two scheduled kills: the prefill replica mid-migration AND a
+        # decode replica holding adopted blocks (serve_transformer's
+        # disagg-aware kill plan alternates between them)
+        cmd += ["--disagg", "--kills", "2"]
+    else:
+        # serving-speed features ON under chaos (ISSUE 14): the
+        # SIGKILLed replica restarts with a COLD prefix cache and a
+        # fresh draft, and the zero-dropped / byte-identical-
+        # duplicate gates below prove correctness never depended on
+        # cache or speculation state
+        cmd += ["--prefix-cache", "--speculative", "2"]
     t0 = time.monotonic()
     proc = subprocess.run(cmd, cwd=REPO, env=env,
                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -691,6 +766,21 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
         if violations:
             ok = False
             print(f"--- seed {seed}: dropped/diverged requests ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok and disagg:
+        violations = _alloc_conservation_gate(run_dir)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: allocator-conservation gate "
+                  f"FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok and disagg:
+        violations = _migrate_ledger_gate(run_dir)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: migrate-pricing gate FAILED ---")
             for v in violations:
                 print(f"    {v}")
     if ok:
@@ -831,6 +921,13 @@ def main(argv=None) -> int:
                          "mid-load: supervisor must restart the replica, "
                          "in-flight requests must be re-served (zero "
                          "dropped), recovery visible in obs_report")
+    ap.add_argument("--disagg", action="store_true",
+                    help="with --serve: disaggregated prefill/decode "
+                         "topology (>= 3 workers) with kills landing "
+                         "on the prefill replica mid-migration and a "
+                         "decode replica holding adopted blocks; adds "
+                         "the allocator-conservation and kv_migrate-"
+                         "pricing gates")
     ap.add_argument("--spike", action="store_true",
                     help="sweep seeded traffic spikes through a shared "
                          "training+serving fleet: the autoscaler must "
@@ -900,6 +997,8 @@ def main(argv=None) -> int:
 
     if args.shrink and not args.kill:
         ap.error("--shrink requires --kill")
+    if args.disagg and not args.serve:
+        ap.error("--disagg requires --serve")
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
@@ -929,11 +1028,17 @@ def main(argv=None) -> int:
                                    keep_dirs=args.keep_dirs,
                                    goodput_floor=args.goodput_floor)
         elif args.serve:
-            ok, dt = run_serve_seed(s, workers=args.workers,
-                                    requests=args.requests,
-                                    budget=args.restart_budget,
-                                    keep_dirs=args.keep_dirs,
-                                    goodput_floor=args.goodput_floor)
+            ok, dt = run_serve_seed(
+                s,
+                # disagg needs one prefill + at least two decode
+                # replicas (a rescue migration target must exist)
+                workers=(max(args.workers, 3) if args.disagg
+                         else args.workers),
+                requests=args.requests,
+                budget=args.restart_budget,
+                keep_dirs=args.keep_dirs,
+                goodput_floor=args.goodput_floor,
+                disagg=args.disagg)
         elif args.kill:
             ok, dt = run_kill_seed(s, workers=args.workers,
                                    steps=args.steps,

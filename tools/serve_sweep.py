@@ -24,6 +24,14 @@ Row contract (what downstream tooling depends on):
   tokens/s and lower p99 than the baseline — and byte-identical
   outputs (``outputs_match_nocache``); an int8 row's measured
   ``kv_quant_max_logit_err`` must be a finite non-negative number.
+- disaggregated rows (ISSUE 16, ``extra.disagg`` true): must carry the
+  same-run monolithic baseline (``baseline_monolithic``) with
+  byte-identical outputs (``outputs_match_monolithic``), and the gate
+  is INVERTED vs the usual more-is-better — decode TBT p99
+  (``decode_p99_ms``) must be strictly LOWER than the monolithic
+  baseline's at equal chip budget; the migration latency series
+  (``migrations`` > 0, finite positive ``migrate_p99_ms``) must be
+  present.
 
 Usage::
 
@@ -99,6 +107,35 @@ def validate_row(row: dict) -> list[str]:
                                 and 0.0 <= err < float("inf")):
         bad.append(f"extra.kv_quant_max_logit_err={err!r} not a "
                    f"finite non-negative number")
+    if extra.get("disagg"):
+        mono = extra.get("baseline_monolithic")
+        if not isinstance(mono, dict):
+            bad.append("disagg row missing baseline_monolithic "
+                       "(the same-run equal-chip-budget baseline)")
+        else:
+            if extra.get("outputs_match_monolithic") is not True:
+                bad.append("outputs_match_monolithic is not true — "
+                           "disaggregation changed greedy outputs")
+            dp = extra.get("decode_p99_ms")
+            mp = mono.get("decode_p99_ms")
+            if not isinstance(dp, (int, float)) or dp <= 0:
+                bad.append(f"extra.decode_p99_ms={dp!r} not positive")
+            # the INVERTED gate: under the prefill burst the disagg
+            # decode tail must beat the monolithic one
+            elif isinstance(mp, (int, float)) and dp >= mp:
+                bad.append(f"disagg decode p99 {dp}ms >= monolithic "
+                           f"baseline {mp}ms — disaggregation did "
+                           f"not protect the decode tail")
+        n_mig = extra.get("migrations")
+        if not isinstance(n_mig, int) or n_mig <= 0:
+            bad.append(f"extra.migrations={n_mig!r} not positive — "
+                       f"a disagg row without migrations measured "
+                       f"nothing")
+        mig99 = extra.get("migrate_p99_ms")
+        if not (isinstance(mig99, (int, float))
+                and 0.0 < mig99 < float("inf")):
+            bad.append(f"extra.migrate_p99_ms={mig99!r} not a finite "
+                       f"positive number")
     return bad
 
 
@@ -120,12 +157,15 @@ def validate_file(path: str) -> list[str]:
 
 
 def run_bench(out_path: str, qps, requests, seed, telemetry_dir, *,
-              prefix_reuse=None, kv_dtype=None, speculative=None) -> int:
+              prefix_reuse=None, kv_dtype=None, speculative=None,
+              disagg=False) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["DTX_TELEMETRY_DIR"] = telemetry_dir
     cmd = [sys.executable, os.path.join(REPO, "bench.py"), "--serving",
            "--out", out_path, "--seed", str(seed)]
+    if disagg:
+        cmd += ["--disagg"]
     if qps is not None:
         cmd += ["--qps", str(qps)]
     if requests is not None:
@@ -162,6 +202,11 @@ def main(argv=None) -> int:
                     choices=("f32", "bf16", "int8"))
     ap.add_argument("--speculative", type=int, default=None,
                     metavar="K")
+    ap.add_argument("--disagg", action="store_true",
+                    help="forward to bench.py --serving: the "
+                         "disaggregated prefill/decode burst bench "
+                         "(inverted decode-p99 gate vs the same-run "
+                         "monolithic baseline)")
     args = ap.parse_args(argv)
 
     if args.check:
@@ -179,7 +224,8 @@ def main(argv=None) -> int:
     rc = run_bench(out_path, args.qps, args.requests, args.seed, tmp,
                    prefix_reuse=args.prefix_reuse,
                    kv_dtype=args.kv_dtype,
-                   speculative=args.speculative)
+                   speculative=args.speculative,
+                   disagg=args.disagg)
     if rc != 0:
         print(f"serve_sweep: bench.py --serving failed (rc={rc})",
               file=sys.stderr)

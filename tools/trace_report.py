@@ -18,7 +18,10 @@ https://ui.perfetto.dev or ``chrome://tracing``. Per-host clocks are
 aligned from the run's own sync points (barrier-release ``clock.sync``
 events + supervisor heartbeat ``clock.hb`` observations — see
 telemetry/trace.py); spans sharing a ``span_id`` (dispatched closures,
-tiered checkpoint commits) render as flow arrows.
+tiered checkpoint commits, and ``kv.migrate`` export/adopt pairs —
+one ``kvmig/<request>`` id across both replicas, so a KV-block
+migration draws an arrow from the prefill replica to the decode
+replica that adopted the blocks) render as flow arrows.
 
 ``--check`` is the CI gate ``chaos_sweep --kill`` runs per seed: exit
 non-zero when any event file is corrupt mid-file (torn FINAL lines from
@@ -105,6 +108,16 @@ def _pipeline_tracks(events_by_pid: dict, trace: dict):
     return n
 
 
+def _migrate_pairs(mig_spans: "list[dict]") -> "dict[str, set]":
+    """``{span_id: {directions seen}}`` over kv.migrate spans."""
+    pairs: "dict[str, set]" = {}
+    for ev in mig_spans:
+        sid = ev.get("span_id")
+        if sid:
+            pairs.setdefault(sid, set()).add(ev.get("direction"))
+    return pairs
+
+
 def summarize_trace(run_dir: str) -> dict:
     """Everything --check and the text summary need, in one read."""
     events_by_pid = tv_events.read_run(run_dir)
@@ -158,6 +171,13 @@ def main(argv=None) -> int:
 
     comp = info["completeness"]
     meta = trace["otherData"]
+    # kv.migrate export/adopt spans pair up by span_id (kvmig/<rid>):
+    # a pair crossing two pids is one rendered migration arrow
+    mig_spans = [ev for evs in events_by_pid.values() for ev in evs
+                 if ev.get("ev") == "kv.migrate"]
+    mig_pairs = sum(
+        1 for sid, dirs in _migrate_pairs(mig_spans).items()
+        if "export" in dirs and "adopt" in dirs)
     summary = {
         "trace": out_path,
         "processes": meta["processes"],
@@ -169,6 +189,8 @@ def main(argv=None) -> int:
         "missing_generations": comp["missing"],
         "torn_tails": info["torn_tails"],
         "pipeline_spans": n_pipeline,
+        "kv_migrate_spans": len(mig_spans),
+        "kv_migrate_pairs": mig_pairs,
     }
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
@@ -189,6 +211,9 @@ def main(argv=None) -> int:
             print(f"  torn tail tolerated: {path}")
         if n_pipeline:
             print(f"  pipeline: {n_pipeline} analytic stage spans")
+        if mig_spans:
+            print(f"  kv.migrate: {len(mig_spans)} spans, "
+                  f"{mig_pairs} export->adopt flow arrows")
         print("  open at https://ui.perfetto.dev or chrome://tracing")
 
     if args.check:
