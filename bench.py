@@ -1944,6 +1944,195 @@ def run_autoscale(out_path: str | None = None, *, seed: int = 0,
     return rows
 
 
+def run_rollout(out_path: str | None = None, *, seed: int = 0,
+                duration: float = 24.0, keep_dir: bool = False):
+    """Live-rollout bench (ISSUE 17), measured from real supervised
+    runs of examples/live_rollout.py plus an in-process delta leg:
+
+    - ``rollout_swap_freshness_p99_s`` — snapshot publish → weights
+      SERVING on the hot-swap path (per-replica ``serve.swap`` close),
+      gated INVERTED by tools/bench_trend.py; the same workload is
+      replayed ``--restart-mode`` (replica exits, supervisor respawns,
+      new incarnation adopts) and the swap path must land STRICTLY
+      below that restart baseline or the bench fails;
+    - ``rollout_swap_install_s`` — the in-engine install pause
+      (param flip + requeue + cache fence), inverted;
+    - ``rollout_rollback_detect_s`` — bad-canary run: canary serving →
+      auto-rollback decision (burn detect + debounce), inverted;
+    - ``rollout_delta_publish_s`` / ``rollout_delta_bytes_frac`` —
+      2^20-row delta snapshot publish vs the full it chains from
+      (<1% rows dirty), reconstruction bit-identity required, both
+      inverted.
+
+    Both freshness legs run with a lax latency SLO so the ramp
+    completes in both modes — the restart path's respawn gap blows any
+    tight SLO (that is the point of hot-swap) and a rolled-back ramp
+    has no promotion freshness to measure."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, repo)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    def leg(name: str, extra_args: list) -> "tuple[dict, dict] | None":
+        run_dir = tempfile.mkdtemp(prefix=f"bench_rollout_{name}_")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "examples", "live_rollout.py"),
+             "--seed", str(seed), "--duration", str(duration),
+             "--telemetry-dir", run_dir,
+             "--ckpt-dir", os.path.join(run_dir, "ckpt"),
+             *extra_args],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        if proc.returncode != 0:
+            print(f"rollout: {name} leg FAILED (rc={proc.returncode}); "
+                  f"dir kept: {run_dir}", file=sys.stderr)
+            print("\n".join(proc.stdout.decode(errors="replace")
+                            .splitlines()[-10:]), file=sys.stderr)
+            return None
+        with open(os.path.join(run_dir, "rollout-summary.json")) as f:
+            summary = json.load(f)
+        events = tv_events.read_run(run_dir)
+        flat = [e for evs in events.values() for e in evs]
+        if not keep_dir:
+            import shutil
+            shutil.rmtree(run_dir, ignore_errors=True)
+        return summary, {"flat": flat}
+
+    lax = ["--latency-slo-ms", "30000"]
+    swap = leg("swap", lax)
+    restart = leg("restart", ["--restart-mode", *lax])
+    bad = leg("badcanary", ["--bad-canary"])
+    if swap is None or restart is None or bad is None:
+        return []
+
+    def swap_durs(flat, mode):
+        return [e["dur_s"] for e in flat
+                if e.get("ev") == "serve.swap" and e.get("mode") == mode
+                and isinstance(e.get("dur_s"), (int, float))]
+
+    swap_sum, swap_ev = swap
+    restart_sum, restart_ev = restart
+    bad_sum, bad_ev = bad
+    swap_p99 = (swap_sum.get("freshness") or {}).get("p99_s")
+    restart_p99 = (restart_sum.get("freshness") or {}).get("p99_s")
+    install = swap_durs(swap_ev["flat"], "swap")
+    adopt = swap_durs(restart_ev["flat"], "restart")
+    # canary serving -> rollback decision, from the bad-canary run
+    detect = None
+    canary_swaps = [e["wall"] for e in bad_ev["flat"]
+                    if e.get("ev") == "serve.swap"
+                    and e.get("step") == 2]
+    rollbacks = [e["wall"] for e in bad_ev["flat"]
+                 if e.get("ev") == "rollout.decision"
+                 and e.get("action") == "rollback"]
+    if canary_swaps and rollbacks:
+        detect = round(min(rollbacks) - min(canary_swaps), 3)
+
+    # --- delta leg: 2^20 rows, <1% dirty, publish cost + size ratio
+    import numpy as np
+    from distributed_tensorflow_tpu.checkpoint import (
+        DeltaSnapshotStore, states_equal)
+    from distributed_tensorflow_tpu.embedding.dynamic import (
+        DynamicTable, DynamicTableConfig)
+    n_rows = 1 << 20
+    cfg = DynamicTableConfig(dim=4, initial_capacity=n_rows,
+                             max_capacity=n_rows)
+    table = DynamicTable(cfg)
+    rng = np.random.default_rng(seed)
+
+    def touch(n, hi):
+        ids = rng.integers(0, hi, size=n)
+        rows = table.translate(ids)
+        table.apply_row_grads(
+            rows, rng.normal(size=(len(ids), cfg.dim))
+            .astype(np.float32))
+
+    delta_dir = tempfile.mkdtemp(prefix="bench_rollout_delta_")
+    store = DeltaSnapshotStore(delta_dir, full_every=64)
+    touch(200_000, 2_000_000)
+    t0 = time.perf_counter()
+    full = store.publish(table)
+    full_s = time.perf_counter() - t0
+    touch(4_000, 30_000)              # hot head: <1% of rows move
+    dirty = table.dirty_rows
+    t0 = time.perf_counter()
+    delta = store.publish(table)
+    delta_s = time.perf_counter() - t0
+    rt, info = store.reconstruct(cfg)
+    bit_identical = (not info["chain_broken"]
+                     and states_equal(table.state_dict(),
+                                      rt.state_dict()))
+    import shutil
+    shutil.rmtree(delta_dir, ignore_errors=True)
+
+    swap_lt_restart = (isinstance(swap_p99, (int, float))
+                       and isinstance(restart_p99, (int, float))
+                       and swap_p99 < restart_p99)
+    if not swap_lt_restart:
+        print(f"rollout: swap freshness p99 ({swap_p99}s) is NOT "
+              f"below the restart baseline ({restart_p99}s) — "
+              f"bench FAILED", file=sys.stderr)
+        return []
+    if not bit_identical:
+        print("rollout: delta reconstruction is NOT bit-identical — "
+              "bench FAILED", file=sys.stderr)
+        return []
+    extra = {
+        "seed": seed,
+        "restart_freshness_p99_s": restart_p99,
+        "swap_lt_restart": swap_lt_restart,
+        "swap_state": swap_sum["rollout"].get("state"),
+        "restart_state": restart_sum["rollout"].get("state"),
+        "bad_canary_rolled_back":
+            bad_sum["rollout"].get("rolled_back"),
+        "dropped": {"swap": swap_sum["requests"]["dropped"],
+                    "restart": restart_sum["requests"]["dropped"],
+                    "bad_canary": bad_sum["requests"]["dropped"]},
+        "mixed_or_wrong": {
+            "swap": swap_sum["versions"]["mixed_or_wrong"],
+            "restart": restart_sum["versions"]["mixed_or_wrong"],
+            "bad_canary": bad_sum["versions"]["mixed_or_wrong"]},
+        "restart_adopt_s": round(max(adopt), 3) if adopt else None,
+        "rollout_badput_s": {
+            "swap": swap_sum["ledger"]["rollout_badput_s"],
+            "restart": restart_sum["ledger"]["rollout_badput_s"]},
+        "delta": {"rows": n_rows, "dirty_rows": dirty,
+                  "full_bytes": full["bytes"],
+                  "delta_bytes": delta["bytes"],
+                  "full_publish_s": round(full_s, 4),
+                  "bit_identical": bit_identical},
+    }
+    rows = []
+    for metric, value, unit in (
+            ("rollout_swap_freshness_p99_s", swap_p99, "s"),
+            ("rollout_swap_install_s",
+             round(max(install), 4) if install else None, "s"),
+            ("rollout_rollback_detect_s", detect, "s"),
+            ("rollout_delta_publish_s", round(delta_s, 4), "s"),
+            ("rollout_delta_bytes_frac",
+             round(delta["bytes"] / full["bytes"], 5), "frac")):
+        if not isinstance(value, (int, float)):
+            print(f"rollout: no measurement for {metric}",
+                  file=sys.stderr)
+            continue
+        row = {"metric": metric, "value": value, "unit": unit,
+               "vs_baseline": None, "extra": extra}
+        rows.append(row)
+        print(json.dumps(row))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "rollout",
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": rows}, f, indent=1)
+            f.write("\n")
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -2065,7 +2254,7 @@ if __name__ == "__main__":
                         choices=["all", "transformer", "resnet50", "bert",
                                  "input_pipeline", "scaling", "serving",
                                  "fleet", "data_service", "autoscale",
-                                 "online"],
+                                 "online", "rollout"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -2114,6 +2303,12 @@ if __name__ == "__main__":
                              "training+serving fleet: scale-up "
                              "latency, SLO recovery, goodput through "
                              "the transition)")
+    parser.add_argument("--rollout", action="store_true",
+                        help="run the live-rollout bench (hot-swap vs "
+                             "restart-adoption publish->servable "
+                             "freshness, install pause, bad-canary "
+                             "detect->rollback time, 2^20-row delta-"
+                             "snapshot publish cost + size ratio)")
     parser.add_argument("--qps", type=float, default=None,
                         help="with --serving: target arrival rate")
     parser.add_argument("--requests", type=int, default=None,
@@ -2161,6 +2356,8 @@ if __name__ == "__main__":
                          seed=args.seed)
     elif args.autoscale or args.workload == "autoscale":
         run_autoscale(out_path=args.out, seed=args.seed)
+    elif args.rollout or args.workload == "rollout":
+        run_rollout(out_path=args.out, seed=args.seed)
     elif args.online or args.workload == "online":
         run_online(out_path=args.out, seed=args.seed,
                    total_events=args.events or 6144)

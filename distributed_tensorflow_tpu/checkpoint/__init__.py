@@ -8,6 +8,12 @@ from distributed_tensorflow_tpu.checkpoint.checkpoint import (
     Checkpoint,
     CheckpointCorruptError,
     CheckpointManager,
+    latest_checkpoint,
+)
+from distributed_tensorflow_tpu.checkpoint.delta import (
+    DeltaChainError,
+    DeltaSnapshotStore,
+    states_equal,
 )
 from distributed_tensorflow_tpu.checkpoint.peer_snapshot import (
     HostSnapshot,

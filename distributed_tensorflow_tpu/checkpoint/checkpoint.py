@@ -783,15 +783,19 @@ class CheckpointManager:
                 out.append((int(m.group(1)), full))
         return sorted(out)
 
-    def _disk_best(self) -> "tuple[int, str, str] | None":
+    def _disk_best(self, at_step: int | None = None
+                   ) -> "tuple[int, str, str] | None":
         """(step, path, tier) of the freshest intact disk checkpoint
-        across tiers; the warmer (local) tier wins step ties."""
+        across tiers; the warmer (local) tier wins step ties. With
+        ``at_step`` only that EXACT step qualifies (pin-restore)."""
         cands = []
         for tier, d in (("local", self.local_dir),
                         ("durable", self.directory)):
             if not d:
                 continue
             cks = self._list_checkpoints(d)
+            if at_step is not None:
+                cks = [(n, p) for n, p in cks if n == at_step]
             if cks:
                 n, p = cks[-1]
                 cands.append((n, 1 if tier == "local" else 0, p, tier))
@@ -918,7 +922,40 @@ class CheckpointManager:
     _TIER_RANK = {"host": 0, "peer": 0, "memory": 0, "local": 1,
                   "durable": 2, "none": 3}
 
-    def restore_latest(self, *, timeout_s: float = 60.0
+    def _restore_pinned(self, step: int
+                        ) -> "tuple[str, int, dict]":
+        """Pin-restore the EXACT snapshot ``step`` from disk — the
+        rollback primitive. Disk tiers only (memory snapshots hold the
+        freshest state, which is precisely what rollback must not
+        get), no peer negotiation. Raises loudly rather than silently
+        restoring a different version: ``CheckpointCorruptError`` when
+        the pinned step's directory exists but is torn,
+        ``FileNotFoundError`` when it was pruned / never written."""
+        disk = self._disk_best(at_step=step)
+        if disk is None:
+            seen = []
+            for d in (self.local_dir, self.directory):
+                if not d:
+                    continue
+                full = os.path.join(d, f"{self._name}-{step}")
+                if os.path.isdir(full):
+                    raise CheckpointCorruptError(
+                        f"pinned step {step}: {full} exists but is "
+                        f"torn/incomplete — refusing to fall back to "
+                        f"a different version")
+                seen.append(d)
+            raise FileNotFoundError(
+                f"pinned step {step}: no intact {self._name}-{step} "
+                f"under {seen} (pruned by rotation?)")
+        got, path, tier = disk
+        restored = self.checkpoint.restore(path)
+        telemetry.event("recovery.restore_tier", tier=tier, step=got,
+                        pinned=True)
+        self.checkpoint._save_counter = int(got)
+        return tier, int(got), restored
+
+    def restore_latest(self, *, timeout_s: float = 60.0,
+                       at_step: int | None = None
                        ) -> "tuple[str, int, dict] | None":
         """Restore down the recovery ladder: own host snapshot > peer
         replica (fetched over the coordination KV) > local disk >
@@ -934,7 +971,15 @@ class CheckpointManager:
 
         Returns ``(tier, step, flat_restored)`` or ``None`` when there
         is nothing anywhere to restore.
+
+        ``at_step`` PINS the restore to one exact snapshot step (the
+        rollback path): disk tiers only, no negotiation, and a torn or
+        pruned pinned step raises loudly instead of silently handing
+        back a different version. Freshest-intact semantics are
+        completely unchanged when ``at_step`` is None.
         """
+        if at_step is not None:
+            return self._restore_pinned(int(at_step))
         from distributed_tensorflow_tpu.checkpoint import (
             peer_snapshot as _ps)
         from distributed_tensorflow_tpu.cluster import elastic
@@ -1011,7 +1056,23 @@ class CheckpointManager:
         return tier, int(step), restored
 
 
-def latest_checkpoint(directory: str, name: str = "ckpt") -> str | None:
-    """Module-level convenience (≙ tf.train.latest_checkpoint)."""
+def latest_checkpoint(directory: str, name: str = "ckpt",
+                      at_step: int | None = None) -> str | None:
+    """Module-level convenience (≙ tf.train.latest_checkpoint). With
+    ``at_step`` returns the EXACT pinned step's path — raising
+    ``CheckpointCorruptError`` (torn) or ``FileNotFoundError``
+    (pruned/absent) instead of silently yielding a different one."""
     mgr = CheckpointManager(Checkpoint(), directory, checkpoint_name=name)
-    return mgr.latest_checkpoint
+    if at_step is None:
+        return mgr.latest_checkpoint
+    best = mgr._disk_best(at_step=int(at_step))
+    if best is None:
+        full = os.path.join(directory, f"{name}-{int(at_step)}")
+        if os.path.isdir(full):
+            raise CheckpointCorruptError(
+                f"pinned step {at_step}: {full} exists but is "
+                f"torn/incomplete")
+        raise FileNotFoundError(
+            f"pinned step {at_step}: no intact {name}-{at_step} under "
+            f"{directory} (pruned by rotation?)")
+    return best[1]

@@ -80,6 +80,9 @@ class CountMinSketch:
                                   dtype=np.uint64) | np.uint64(1))
         self._add = rng.integers(0, 2**63, size=depth, dtype=np.uint64)
         self.counts = np.zeros((depth, self.width), dtype=np.uint32)
+        # flat counter cells touched since mark_clean() — the sketch's
+        # contribution to a row-sparse delta snapshot
+        self._dirty: set[int] = set()
 
     def _slots(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, dtype=np.uint64)
@@ -94,6 +97,25 @@ class CountMinSketch:
         slots = self._slots(ids)
         for d in range(self.depth):
             np.add.at(self.counts[d], slots[d], 1)
+        flat = (np.arange(self.depth, dtype=np.int64)[:, None]
+                * self.width + slots).ravel()
+        self._dirty.update(np.unique(flat).tolist())
+
+    # -- delta snapshots --------------------------------------------------
+    def delta(self) -> "tuple[np.ndarray, np.ndarray]":
+        """(flat indices, values) of every counter cell touched since
+        :meth:`mark_clean` — sorted, so two identical dirty sets
+        serialize identically."""
+        idx = np.asarray(sorted(self._dirty), dtype=np.int64)
+        return idx, self.counts.reshape(-1)[idx].copy()
+
+    def apply_delta(self, idx: np.ndarray, vals: np.ndarray):
+        flat = self.counts.reshape(-1)
+        flat[np.asarray(idx, dtype=np.int64)] = np.asarray(
+            vals, dtype=np.uint32)
+
+    def mark_clean(self):
+        self._dirty.clear()
 
     def estimate(self, ids: np.ndarray) -> np.ndarray:
         slots = self._slots(np.atleast_1d(ids))
@@ -227,6 +249,12 @@ class DynamicTable:
         self.evictions = 0
         self.grows = 0
         self.declined = 0
+        # rows whose weights/slots/bookkeeping changed since the last
+        # mark_clean() — the row-sparse delta-snapshot feed. A capacity
+        # change (growth) invalidates delta-ability entirely:
+        # state_delta() returns None until the next full publish.
+        self._dirty: set[int] = set()
+        self._clean_capacity = self.capacity
 
     # -- init helpers -----------------------------------------------------
     def _init_rows(self, start: int, n: int) -> jnp.ndarray:
@@ -290,6 +318,7 @@ class DynamicTable:
             elif train:
                 self.row_freq[row] += int(counts[j])
                 self.row_last[row] = self.step
+                self._dirty.add(row)
             row_of[uid] = row
         self._flush_reinits(pending)
         return np.asarray([row_of[int(i)] for i in ids], dtype=np.int32)
@@ -312,6 +341,7 @@ class DynamicTable:
         self.row_freq[row] = est
         self.row_last[row] = self.step
         self.admissions += 1
+        self._dirty.add(row)
         return row
 
     def _evict_for(self, candidate_est: int) -> int | None:
@@ -329,6 +359,7 @@ class DynamicTable:
         self.row_id[victim] = -1
         self.row_freq[victim] = 0
         self.evictions += 1
+        self._dirty.add(victim)
         return victim
 
     def _grow(self):
@@ -382,6 +413,7 @@ class DynamicTable:
         self.rows, self.slots = _sparse_apply_fn(self._opt)(
             self.rows, self.slots, jnp.asarray(idx), jnp.asarray(pad_g),
             jnp.asarray(self.step, jnp.int32))
+        self._dirty.update(int(r) for r in uniq)
         self.step += 1
 
     def end_step(self):
@@ -437,6 +469,90 @@ class DynamicTable:
         self.step = int(aux["step"])
         (self.admissions, self.evictions, self.grows,
          self.declined) = (int(x) for x in aux["counters"])
+        self.mark_clean()
+
+    # -- delta snapshots --------------------------------------------------
+    @property
+    def dirty_rows(self) -> int:
+        """Rows touched since the last :meth:`mark_clean`."""
+        return len(self._dirty)
+
+    def mark_clean(self):
+        """Commit point: what is in the table NOW is what the last
+        published snapshot (full or delta) holds."""
+        self._dirty.clear()
+        self.sketch.mark_clean()
+        self._clean_capacity = self.capacity
+
+    def state_delta(self) -> "dict | None":
+        """Row-sparse state since the last :meth:`mark_clean`: only the
+        dirty rows' weights/slots/bookkeeping, the sketch's dirty
+        cells, and the scalars. Returns ``None`` when the table GREW
+        since the clean point — every row moved then, so only a full
+        snapshot is honest (the publisher falls back to one).
+
+        The free list ships as ``free_len`` alone: between grows it
+        only ever shrinks by pops from its end (``_admit``), so the
+        clean-point list truncated to ``free_len`` IS the current
+        list — a structural invariant the delta format leans on
+        (growth, the one operation that prepends, forces a full)."""
+        if self.capacity != self._clean_capacity:
+            return None
+        idx = np.asarray(sorted(self._dirty), dtype=np.int64)
+        sk_idx, sk_vals = self.sketch.delta()
+        rows = np.asarray(self.rows)
+        return {
+            "capacity": self.capacity,
+            "idx": idx,
+            "rows": rows[idx].copy(),
+            "slots": {k: np.asarray(v)[idx].copy()
+                      for k, v in self.slots.items()},
+            "row_id": self.row_id[idx].copy(),
+            "row_freq": self.row_freq[idx].copy(),
+            "row_last": self.row_last[idx].copy(),
+            "free_len": len(self._free),
+            "sketch_idx": sk_idx,
+            "sketch_vals": sk_vals,
+            "step": self.step,
+            "counters": (self.admissions, self.evictions, self.grows,
+                         self.declined),
+        }
+
+    def apply_state_delta(self, delta: dict):
+        """Scatter a :meth:`state_delta` onto this table (which must
+        hold the delta's parent state — the reconstructor's job to
+        guarantee via the crc'd chain). Bit-identical to having taken
+        the steps directly: rows/slots scatter on device, bookkeeping
+        scatters on host, membership rebuilds from ``row_id``."""
+        if int(delta["capacity"]) != self.capacity:
+            raise ValueError(
+                f"table {self.cfg.name!r}: delta capacity "
+                f"{delta['capacity']} != table capacity "
+                f"{self.capacity} (chain broken — restore the full "
+                f"base first)")
+        idx = np.asarray(delta["idx"], dtype=np.int64)
+        if len(idx):
+            jidx = jnp.asarray(idx)
+            self.rows = self.rows.at[jidx].set(
+                jnp.asarray(delta["rows"]))
+            self.slots = {k: self.slots[k].at[jidx].set(
+                jnp.asarray(v)) for k, v in delta["slots"].items()}
+            self.row_id[idx] = np.asarray(delta["row_id"],
+                                          dtype=np.int64)
+            self.row_freq[idx] = np.asarray(delta["row_freq"],
+                                            dtype=np.int64)
+            self.row_last[idx] = np.asarray(delta["row_last"],
+                                            dtype=np.int64)
+        self._free = [int(x)
+                      for x in self._free[:int(delta["free_len"])]]
+        self.sketch.apply_delta(delta["sketch_idx"],
+                                delta["sketch_vals"])
+        mapped = np.flatnonzero(self.row_id >= 0)
+        self.id_to_row = {int(self.row_id[r]): int(r) for r in mapped}
+        self.step = int(delta["step"])
+        (self.admissions, self.evictions, self.grows,
+         self.declined) = (int(x) for x in delta["counters"])
+        self.mark_clean()
 
 
 class StaticHashTable:
@@ -467,6 +583,9 @@ class StaticHashTable:
         self.step = 0
         self.admissions = self.evictions = self.grows = 0
         self.mapped = capacity
+        # the shared apply_row_grads tracks dirty rows (delta
+        # snapshots); the static baseline just ignores the set
+        self._dirty: set[int] = set()
 
     def translate(self, ids: np.ndarray, *, train: bool = True
                   ) -> np.ndarray:

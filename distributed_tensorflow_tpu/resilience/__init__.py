@@ -50,3 +50,8 @@ from distributed_tensorflow_tpu.resilience.autoscaler import (
     ScaleDecision,
     SharedFleetSupervisor,
 )
+from distributed_tensorflow_tpu.resilience.rollout import (
+    RolloutController,
+    RolloutDecision,
+    RolloutPolicy,
+)

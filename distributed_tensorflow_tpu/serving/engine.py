@@ -74,7 +74,9 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +114,21 @@ def migrate_span_id(request_id: str) -> str:
     return f"kvmig/{request_id}"
 
 
+def params_digest(params) -> str:
+    """Content digest of a parameter tree: crc32 over the tree
+    structure plus every leaf's raw bytes, host-fetched. Two engines
+    serving byte-identical weights get the same digest regardless of
+    how the weights arrived (fresh init, restore tier, hot-swap) — the
+    content half of the ``weights_version`` identity stamped on every
+    serving event."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    crc = zlib.crc32(repr(treedef).encode())
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+        crc = zlib.crc32(a.tobytes(), crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
 class InferenceEngine:
     """Continuous-batching inference over a sharded transformer.
 
@@ -142,7 +159,8 @@ class InferenceEngine:
                  speculative_k: int = 0,
                  draft_params=None, draft_cfg=None,
                  role: str = "both",
-                 spill_tier: "HostTier | int | None" = None):
+                 spill_tier: "HostTier | int | None" = None,
+                 snapshot_step: int | None = None):
         if cfg.mesh is not None:
             import dataclasses
             cfg = dataclasses.replace(cfg, mesh=None)
@@ -184,11 +202,14 @@ class InferenceEngine:
             raise ValueError("speculative decoding requires a causal "
                              "model")
         self.spec_k = int(speculative_k)
+        self._draft_default = False
         if self.spec_k:
             if draft_params is None:
                 # default draft: the target's own first half of layers
                 # (free self-speculation; pass an explicit small model
-                # for a real distilled draft)
+                # for a real distilled draft) — re-derived from the new
+                # weights on every hot-swap (install_version)
+                self._draft_default = True
                 draft_cfg, draft_params = decode_lib.truncated_draft(
                     cfg, params)
             elif draft_cfg is None:
@@ -209,6 +230,22 @@ class InferenceEngine:
         else:
             params = jax.tree_util.tree_map(jnp.asarray, dict(params))
         self.params = params
+        #: model-version identity: snapshot step (0 = direct params, no
+        #: checkpoint provenance) + content digest. Stamped on every
+        #: serve.prefill/serve.request event and rotated by
+        #: install_version — the fence the prefix cache and the rollout
+        #: controller both key on.
+        self.weights_step = (int(snapshot_step)
+                             if snapshot_step is not None else 0)
+        self.weights_digest = params_digest(self.params)
+        self.swaps = 0
+        self.swap_error: BaseException | None = None
+        # hot-swap provenance (set by from_checkpoint; load_version
+        # needs both to rebuild a pinned CheckpointManager)
+        self._version_source: dict | None = None
+        self._params_template = None
+        self._swap_thread: threading.Thread | None = None
+        self._pending_swap = None
         self.pool = init_pool(cache_cfg, mesh)
 
         prefill = decode_lib.make_prefill_fn(cfg, cache_cfg)
@@ -325,6 +362,13 @@ class InferenceEngine:
             "serving/draft_tokens_proposed")
         self._m_spec_accepted = reg.counter(
             "serving/draft_tokens_accepted")
+        self._m_model_version = reg.gauge(
+            "serving/model_version",
+            "snapshot step of the weights currently serving")
+        self._m_model_version.set(self.weights_step)
+        self._m_swaps = reg.counter(
+            "serving/weight_swaps",
+            "in-place weight hot-swaps completed")
 
         self._step_idx = 0
         self._submitted: dict[str, float] = {}      # id -> wall arrival
@@ -363,15 +407,30 @@ class InferenceEngine:
 
             self.scheduler.prefix_cache.attach_spill(
                 tier, extract=_extract, insert=_insert_block,
-                epoch=self.pool_epoch)
+                epoch=self._cache_epoch())
             self.spill_tier = tier
 
     # -- weights -----------------------------------------------------------
+    @property
+    def weights_version(self) -> str:
+        """``<step>@<digest>`` — the identity stamped on serving
+        events; also the weights half of the prefix-cache epoch."""
+        return f"{self.weights_step}@{self.weights_digest}"
+
+    def _cache_epoch(self) -> str:
+        """Spill/fence epoch = incarnation × weights version. A
+        host-tier block survives ONLY while both halves match: a
+        restart rotates pool_epoch (PR 16's fence), a hot-swap rotates
+        weights_version — either way a stale block is dropped-and-
+        counted at re-adoption, never served."""
+        return f"{self.pool_epoch}/{self.weights_version}"
+
     @classmethod
     def from_checkpoint(cls, cfg: TransformerConfig, directory: str, *,
                         checkpoint_name: str = "ckpt",
                         local_dir: str | None = None,
                         snapshot_store=None, seed: int = 0,
+                        at_step: int | None = None,
                         **engine_kwargs) -> "InferenceEngine":
         """Restore serving weights down the recovery ladder. The
         checkpoint must have been written as ``Checkpoint(params=...)``
@@ -380,27 +439,221 @@ class InferenceEngine:
         trainers (CheckpointManager.restore_latest walks host > peer >
         local > durable and emits ``recovery.restore_tier``). With
         nothing restorable anywhere, falls back to seed-deterministic
-        fresh init (cold start)."""
+        fresh init (cold start). ``at_step`` pin-restores an exact
+        snapshot (rollback; raises loudly when that step is torn or
+        pruned). The engine remembers its checkpoint source, so
+        :meth:`load_version` can later hot-swap to any other step.
+
+        A successful restore emits ``serve.swap`` with
+        ``mode="restart"`` — the restart-adoption datapoint the
+        update→servable freshness SLO closes on, so the respawn gap
+        hot-swap removes is measured, not assumed."""
         from distributed_tensorflow_tpu.checkpoint.checkpoint import (
             Checkpoint, CheckpointManager)
         from distributed_tensorflow_tpu.training.model import (
             _unflatten_like)
 
+        t0 = time.monotonic()
         model = TransformerLM(cfg)
         tokens = jnp.zeros((1, min(8, cfg.max_seq_len)), jnp.int32)
         params = model.init(jax.random.PRNGKey(seed), tokens)["params"]
         params = (params.unfreeze() if hasattr(params, "unfreeze")
                   else dict(params))
+        template = params
         ckpt = Checkpoint(params=params)
         mgr = CheckpointManager(ckpt, directory,
                                 checkpoint_name=checkpoint_name,
                                 local_dir=local_dir,
                                 snapshot_store=snapshot_store)
-        res = mgr.restore_latest()
+        res = mgr.restore_latest(at_step=at_step)
+        step = None
         if res is not None:
-            _tier, _step, flat = res
-            params = _unflatten_like(params, flat, "params")
-        return cls(cfg, params, **engine_kwargs)
+            _tier, step, flat = res
+            params = _unflatten_like(template, flat, "params")
+        eng = cls(cfg, params, snapshot_step=step, **engine_kwargs)
+        eng._version_source = dict(directory=directory,
+                                   checkpoint_name=checkpoint_name,
+                                   local_dir=local_dir,
+                                   snapshot_store=snapshot_store)
+        eng._params_template = template
+        if step is not None:
+            telemetry.event(
+                "serve.swap", step=step, version=eng.weights_version,
+                previous=None, mode="restart", requeued=0,
+                dur_s=round(time.monotonic() - t0, 6))
+        return eng
+
+    def install_version(self, params, *, step: int | None = None,
+                        published_wall: "float | None" = None,
+                        mode: str = "swap",
+                        started_mono: "float | None" = None) -> dict:
+        """Flip the serving weights IN PLACE at a step boundary. The
+        parameter tree must match the current one exactly (structure,
+        shapes, dtypes) — the compiled programs take params as a plain
+        argument, so an identical-shape flip costs zero recompiles.
+
+        The swap rule, in order: (1) every running sequence is
+        released and its PRISTINE request re-queued at the front
+        (tokens generated under the old weights are discarded, so no
+        completed output ever mixes versions — the preemption-replay
+        path is sanitized too); (2) the params pointer flips (and the
+        default truncated-target draft is re-derived when speculative
+        decoding uses it); (3) the prefix cache is fenced by the new
+        ``weights_version`` — device entries dropped, host-tier spills
+        epoch-fenced; (4) a ``serve.swap`` event is emitted and the
+        whole transition is priced into the ``rollout`` badput bucket.
+        Zero requests are dropped: the latency clock keys on request
+        id and survives the requeue, so SLO burn stays honest."""
+        t0 = started_mono if started_mono is not None \
+            else time.monotonic()
+        raw = params
+        params = decode_lib.canonical_params(self.cfg, params)
+        if self.mesh is not None:
+            shardings = decode_lib.param_shardings(self.cfg, self.mesh)
+            params = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(jnp.asarray(a), s),
+                dict(params), shardings)
+        else:
+            params = jax.tree_util.tree_map(jnp.asarray, dict(params))
+        old_l, old_t = jax.tree_util.tree_flatten(self.params)
+        new_l, new_t = jax.tree_util.tree_flatten(params)
+        if old_t != new_t or any(
+                a.shape != b.shape or a.dtype != b.dtype
+                for a, b in zip(old_l, new_l)):
+            raise ValueError(
+                "install_version: parameter tree mismatch — hot-swap "
+                "requires the same TransformerConfig (identical "
+                "structure/shapes/dtypes); rebuild the engine for an "
+                "architecture change")
+        previous = self.weights_version
+        requeued = self.scheduler.requeue_running()
+        self.params = params
+        if self.spec_k and self._draft_default:
+            dcfg, dparams = decode_lib.truncated_draft(self.cfg, raw)
+            self._draft_cfg = dcfg
+            self._draft_params = jax.tree_util.tree_map(
+                jnp.asarray,
+                dict(decode_lib.canonical_params(dcfg, dparams)))
+        self.weights_step = (int(step) if step is not None
+                             else self.weights_step + 1)
+        self.weights_digest = params_digest(self.params)
+        dropped = 0
+        if self.scheduler.prefix_cache is not None:
+            dropped = self.scheduler.prefix_cache.fence(
+                self._cache_epoch())
+        self.swaps += 1
+        self.swap_error = None
+        self._m_swaps.increment()
+        self._m_model_version.set(self.weights_step)
+        dur = time.monotonic() - t0
+        now = time.time()
+        freshness = (max(0.0, now - published_wall)
+                     if published_wall is not None else None)
+        telemetry.event(
+            "serve.swap", step=self.weights_step,
+            version=self.weights_version, previous=previous,
+            mode=mode, requeued=requeued, cache_dropped=dropped,
+            dur_s=round(dur, 6),
+            freshness_s=(round(freshness, 6)
+                         if freshness is not None else None))
+        ledger = _goodput.active_ledger()
+        if ledger is not None:
+            ledger.record("rollout", dur)
+        return {"step": self.weights_step,
+                "version": self.weights_version,
+                "previous": previous, "requeued": requeued,
+                "cache_dropped": dropped, "dur_s": dur}
+
+    def _restore_pinned(self, step: int):
+        """Fetch snapshot ``step``'s flat state via the restore-tier
+        ladder, pinned (torn/pruned ⇒ loud raise, never a silent
+        different version)."""
+        if self._version_source is None:
+            raise RuntimeError(
+                "load_version: engine has no checkpoint provenance — "
+                "build it with InferenceEngine.from_checkpoint")
+        from distributed_tensorflow_tpu.checkpoint.checkpoint import (
+            Checkpoint, CheckpointManager)
+        src = self._version_source
+        ckpt = Checkpoint(params=self._params_template)
+        mgr = CheckpointManager(
+            ckpt, src["directory"],
+            checkpoint_name=src["checkpoint_name"],
+            local_dir=src["local_dir"],
+            snapshot_store=src["snapshot_store"])
+        res = mgr.restore_latest(at_step=int(step))
+        if res is None:
+            raise FileNotFoundError(
+                f"load_version: pinned step {step} not restorable")
+        return res[2]
+
+    def load_version(self, step: int, *,
+                     published_wall: "float | None" = None) -> dict:
+        """Synchronous hot-swap to snapshot ``step``: restore down the
+        tier ladder (pinned), rebuild the parameter tree, and
+        :meth:`install_version` it. Restore time is part of the priced
+        transition. Prefer :meth:`begin_load_version` on a live step
+        loop — it keeps the restore off the serving thread."""
+        from distributed_tensorflow_tpu.training.model import (
+            _unflatten_like)
+        t0 = time.monotonic()
+        flat = self._restore_pinned(step)
+        params = _unflatten_like(self._params_template, flat, "params")
+        return self.install_version(params, step=step,
+                                    published_wall=published_wall,
+                                    started_mono=t0)
+
+    def begin_load_version(self, step: int, *,
+                           published_wall: "float | None" = None
+                           ) -> bool:
+        """Start restoring snapshot ``step`` on a background thread;
+        :meth:`step` installs it at the next step boundary once the
+        restore lands (the flip itself stays on the serving thread, so
+        no request ever sees a half-written tree). Returns False when a
+        load is already in flight. A failed restore surfaces as a
+        ``serve.swap_error`` event + :attr:`swap_error` — the replica
+        keeps serving the current version."""
+        if self._swap_thread is not None and \
+                self._swap_thread.is_alive():
+            return False
+
+        def _work():
+            t0 = time.monotonic()
+            try:
+                flat = self._restore_pinned(step)
+            except BaseException as e:       # surfaced at the boundary
+                self._pending_swap = ("error", int(step), e)
+                return
+            self._pending_swap = ("ready", int(step), flat,
+                                  published_wall, t0)
+
+        self._pending_swap = None
+        self._swap_thread = threading.Thread(
+            target=_work, name=f"swap-load-{step}", daemon=True)
+        self._swap_thread.start()
+        return True
+
+    def _poll_pending_swap(self):
+        """Install a background-loaded version at the step boundary."""
+        if self._swap_thread is None or self._swap_thread.is_alive():
+            return
+        self._swap_thread = None
+        pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        if pending[0] == "error":
+            _kind, step, err = pending
+            self.swap_error = err
+            telemetry.event("serve.swap_error", step=step,
+                            error=repr(err))
+            return
+        from distributed_tensorflow_tpu.training.model import (
+            _unflatten_like)
+        _kind, step, flat, published_wall, t0 = pending
+        params = _unflatten_like(self._params_template, flat, "params")
+        self.install_version(params, step=step,
+                             published_wall=published_wall,
+                             started_mono=t0)
 
     # -- request lifecycle -------------------------------------------------
     def submit(self, request: Request, *,
@@ -477,6 +730,7 @@ class InferenceEngine:
         C = seq.cached_tokens
         with telemetry.span(
                 "serve.prefill", id=rid, span_id=request_span_id(rid),
+                model_version=self.weights_version,
                 prompt_tokens=seq.prompt_len,
                 cached_tokens=C or None,
                 queue_wait_s=(round(queue_wait, 6)
@@ -669,6 +923,11 @@ class InferenceEngine:
         # chaos site FIRST: an injected raise leaves scheduler/cache
         # state untouched, so the caller can simply retry the step
         faults.fire("serve.step", tag=self._step_idx)
+        # a background-loaded version installs HERE — the step
+        # boundary: after the fault site (an injected raise just
+        # defers the flip to the retry), before any admission/decode
+        # touches the old weights
+        self._poll_pending_swap()
         sched = self.scheduler
         finished: list[dict] = []
         with telemetry.span("serve.step", step=self._step_idx) as sp:
@@ -762,12 +1021,14 @@ class InferenceEngine:
         telemetry.event(
             "serve.request", id=req.id, dur_s=round(latency, 6),
             span_id=request_span_id(req.id),
+            model_version=self.weights_version,
             prompt_tokens=prompt_tokens, new_tokens=len(generated),
             replayed_tokens=replayed,
             ttft_s=round(ttft, 6) if ttft is not None else None,
             preemptions=seq.preemptions)
         return {"id": req.id, "tokens": tokens,
                 "prompt_tokens": prompt_tokens,
+                "model_version": self.weights_version,
                 "latency_s": latency, "ttft_s": ttft,
                 "replayed_tokens": replayed,
                 "preemptions": seq.preemptions}
@@ -1001,6 +1262,9 @@ class InferenceEngine:
             "tokens_replayed": self._m_replayed.value,
             "serve_time_s": self._m_step.export().get("sum", 0.0),
             "kv_dtype": str(jnp.dtype(self.cache_cfg.dtype).name),
+            "weights_step": self.weights_step,
+            "weights_version": self.weights_version,
+            "swaps": self.swaps,
         }
         if sched.prefix_cache is not None:
             out["prefix_cache"] = sched.prefix_cache.stats()

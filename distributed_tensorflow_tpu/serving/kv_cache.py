@@ -455,6 +455,8 @@ class PrefixCache:
         self._spill_epoch = None
         self.spill_hits = 0
         self.spill_rejects = 0
+        self.fences = 0
+        self.fence_dropped = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -585,6 +587,28 @@ class PrefixCache:
             freed += 1
         return freed
 
+    def fence(self, epoch) -> int:
+        """Invalidate the whole cache in one step and rotate the spill
+        epoch — the weights-version fence a hot-swap relies on: a block
+        committed under weights N must never match a request served
+        under weights N+1 (same tokens, different K/V). Device entries
+        are dropped eagerly (the cache's own allocator reference per
+        entry returns to the pool; blocks a running sequence still
+        shares survive through the sequence's refs). Host-tier spilled
+        entries are NOT scanned: the epoch rotation makes
+        :meth:`_readopt` drop-and-count each one lazily on its next
+        lookup, exactly like a stale entry from a dead engine
+        incarnation. Returns the number of device entries dropped."""
+        dropped = len(self._entries)
+        for e in self._entries.values():
+            self._alloc.free([e.block])
+        self._entries.clear()
+        self._children.clear()
+        self._spill_epoch = epoch
+        self.fences += 1
+        self.fence_dropped += dropped
+        return dropped
+
     def _readopt(self, key, chain_key) -> "_CacheEntry | None":
         """Try to pull a spilled block back into the pool on a chain
         miss. Needs one free block; a stale entry (pool-epoch mismatch
@@ -624,6 +648,8 @@ class PrefixCache:
             "evictions": self.evictions,
             "spill_hits": self.spill_hits,
             "spill_rejects": self.spill_rejects,
+            "fences": self.fences,
+            "fence_dropped": self.fence_dropped,
         }
 
 

@@ -375,6 +375,44 @@ class ContinuousBatchingScheduler:
         self.preemptions += 1
         return victim
 
+    @staticmethod
+    def _pristine(req: Request) -> Request:
+        """Undo preemption-replay rewriting: the ORIGINAL request, with
+        any previously generated tokens stripped back out of the prompt
+        and the generation budget restored."""
+        n = len(req.generated_prefix)
+        if n == 0:
+            return req
+        return dataclasses.replace(
+            req, tokens=req.tokens[:len(req.tokens) - n],
+            max_new_tokens=req.max_new_tokens + n,
+            generated_prefix=())
+
+    def requeue_running(self) -> int:
+        """Release every running sequence and re-queue its PRISTINE
+        request at the front of the admission queue — the weights
+        hot-swap primitive. Tokens generated so far are discarded, not
+        replayed: replaying them as prompt (the preemption path) would
+        splice version-N tokens into a version-N+1 stream. Re-admission
+        re-prefills from scratch under the new weights, so every
+        completed output is wholly one version's. Queued requests that
+        carry a preemption-replay ``generated_prefix`` (version-N
+        tokens waiting to be replayed) are sanitized the same way.
+        Returns the number of running sequences requeued. FIFO age
+        order is preserved: the oldest request ends up at the front."""
+        seqs = sorted(self.running.values(),
+                      key=lambda s: s.admitted_s, reverse=True)
+        for seq in seqs:
+            del self.running[seq.slot]
+            self._free_slots.append(seq.slot)
+            seq.table.release(self.allocator)
+            self.queue.push_front(self._pristine(seq.request))
+        self._free_slots.sort(reverse=True)
+        for i, req in enumerate(self.queue._q):
+            if req.generated_prefix:
+                self.queue._q[i] = self._pristine(req)
+        return len(seqs)
+
     def adopt(self, request: Request, blocks: list[int], length: int,
               generated) -> Sequence:
         """Install an ALREADY-PREFILLED sequence (KV migrated in from

@@ -34,6 +34,12 @@ module classifies **every wall-clock second** of every worker into
                       replaying (serving/migrate.py); a drain that
                       migrates moves seconds from ``preempt_replay``
                       into this much smaller bucket
+  ``rollout``         model-version transition time (``serve.swap``
+                      events): weight hot-swap restore+flip, canary
+                      promote/rollback pin-restores — the price of
+                      keeping serving fresh without restarts
+                      (serving/engine.install_version,
+                      resilience/rollout.py)
   ``idle``            everything unattributed (gaps between steps,
                       drain after the last step)
   ==================  ==================================================
@@ -68,7 +74,7 @@ from distributed_tensorflow_tpu.telemetry import registry as _registry
 #: makes the identity exact.
 BADPUT_BUCKETS = ("startup", "infeed_wait", "ckpt_block", "recovery",
                   "scale_transition", "preempt_replay", "kv_migrate",
-                  "idle")
+                  "rollout", "idle")
 
 #: Step events whose duration is (mostly) goodput.
 _STEP_EVENTS = frozenset({"train.step", "serve.step"})
@@ -169,6 +175,16 @@ def _worker_ledger(events: "list[dict]",
             start = max(cursor, wall - dur)
             bad["startup" if in_startup else "idle"] += start - cursor
             bad["kv_migrate"] += wall - start
+            cursor = wall
+        elif name == "serve.swap":
+            # a version transition (hot-swap flip + restore share that
+            # landed on this worker's wall, or a restart adoption) is
+            # ``rollout`` badput; the cursor advance clips it out of
+            # any enclosing/overlapping serve.step exactly like
+            # kv.migrate — identity intact by the same overlap rule
+            start = max(cursor, wall - dur)
+            bad["startup" if in_startup else "idle"] += start - cursor
+            bad["rollout"] += wall - start
             cursor = wall
         elif name == "serve.request":
             rt = ev.get("replayed_tokens")

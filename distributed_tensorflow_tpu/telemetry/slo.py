@@ -234,6 +234,7 @@ def records_from_events(events_by_pid: "dict") -> "list[dict]":
                 "wall": ev.get("wall"),
                 "latency_s": ev.get("dur_s"),
                 "ttft_s": ev.get("ttft_s"),
+                "model_version": ev.get("model_version"),
                 "ok": not ev.get("error"),
             })
     records.sort(key=lambda r: r.get("wall") or 0.0)
@@ -241,21 +242,69 @@ def records_from_events(events_by_pid: "dict") -> "list[dict]":
 
 
 def freshness_records_from_events(events_by_pid: "dict") -> "list[dict]":
-    """Freshness records from ``stream.snapshot_published`` events (the
-    online evaluator's stamp per served snapshot): the feed
-    :func:`default_online_slos` evaluates, rendered by
-    ``tools/health_report.py`` and gated by ``chaos_sweep --online``."""
-    records = []
-    for events in events_by_pid.values():
+    """Freshness records measuring true update→**servable** lag.
+
+    A publish event (``stream.snapshot_published`` from the online
+    evaluator, or ``rollout.publish`` from the rollout controller)
+    opens a freshness interval; it CLOSES only at a serving replica's
+    swap-complete event (``serve.swap`` — in-place hot-swap or
+    restart adoption, matched by snapshot ``step``), and the record's
+    ``freshness_s`` is the publish stamp's own lag plus the
+    publish→swap gap. A replica that adopts by restart therefore
+    honestly reports the respawn-sized gap the hot-swap path removes;
+    a snapshot no replica ever adopts produces NO record (it never
+    became servable). One record per adopting replica per publish.
+
+    Back-compat: a run with no ``serve.swap`` events at all (PR 15's
+    online topology — the evaluator scores snapshots in-process) keeps
+    the original close-at-publish semantics, so existing feeds and the
+    ``chaos_sweep --online`` gate read unchanged."""
+    pubs, swaps = [], []
+    for pid, events in events_by_pid.items():
         for ev in events:
-            if ev.get("ev") != "stream.snapshot_published":
-                continue
+            name = ev.get("ev")
+            if name in ("stream.snapshot_published", "rollout.publish"):
+                pubs.append(ev)
+            elif name == "serve.swap":
+                swaps.append((pid, ev))
+    records = []
+    if not swaps:
+        for ev in pubs:
             records.append({
                 "wall": ev.get("wall"),
                 "freshness_s": ev.get("freshness_s"),
                 "lag_events": ev.get("lag_events"),
                 "offset": ev.get("offset"),
                 "ok": not ev.get("error"),
+            })
+        records.sort(key=lambda r: r.get("wall") or 0.0)
+        return records
+    for pub in pubs:
+        pwall = pub.get("wall")
+        if not isinstance(pwall, (int, float)):
+            continue
+        step = pub.get("step")
+        base = pub.get("freshness_s")
+        base = float(base) if isinstance(base, (int, float)) else 0.0
+        # each replica's FIRST matching swap at/after the publish
+        first: dict = {}
+        for pid, sw in swaps:
+            if step is not None and sw.get("step") != step:
+                continue
+            swall = sw.get("wall")
+            if not isinstance(swall, (int, float)) or swall < pwall:
+                continue
+            if pid not in first or swall < first[pid][0]:
+                first[pid] = (swall, sw)
+        for pid, (swall, sw) in first.items():
+            records.append({
+                "wall": swall,
+                "freshness_s": round(base + (swall - pwall), 6),
+                "lag_events": pub.get("lag_events"),
+                "offset": pub.get("offset"),
+                "step": step,
+                "mode": sw.get("mode"),
+                "ok": not sw.get("error"),
             })
     records.sort(key=lambda r: r.get("wall") or 0.0)
     return records

@@ -1,0 +1,742 @@
+"""Live rollout: hot-swap, delta snapshots, SLO-gated canary.
+
+The load-bearing contracts (ISSUE 17):
+
+- **hot-swap** — ``InferenceEngine.load_version`` flips weights at a
+  step boundary with zero dropped requests and NO mixed-version token
+  streams: in-flight sequences are re-queued pristine and re-decoded
+  wholly under the new version; a null swap (identical weights) is
+  byte-invisible;
+- **version fencing** — the prefix cache is fenced at the swap: a
+  block committed under weights N never serves a request under N+1,
+  device-resident or spilled to the host tier;
+- **pin-restore** — ``restore_latest(at_step=)`` returns the EXACT
+  snapshot or raises loudly (torn ⇒ CheckpointCorruptError, pruned ⇒
+  FileNotFoundError) — the rollback primitive must never silently
+  restore a different version;
+- **delta snapshots** — the full+delta record chain reconstructs a
+  DynamicTable bit-identically; growth forces a full; a broken link
+  serves the longest intact prefix, honestly;
+- **canary** — the RolloutController promotes on held-clear burn with
+  evidence, rolls back on canary-only burn with debounce, and holds
+  when the baseline burns too;
+- **accounting** — swap transitions are priced into the ``rollout``
+  badput bucket with the ledger identity intact, and the freshness SLO
+  closes at swap-complete, not at publish.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.checkpoint import (
+    Checkpoint, CheckpointCorruptError, CheckpointManager,
+    DeltaChainError, DeltaSnapshotStore, latest_checkpoint,
+    states_equal)
+from distributed_tensorflow_tpu.embedding.dynamic import (
+    DynamicTable, DynamicTableConfig)
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, TransformerLM)
+from distributed_tensorflow_tpu.resilience import faults
+from distributed_tensorflow_tpu.resilience.faults import (
+    FaultRule, FaultSchedule)
+from distributed_tensorflow_tpu.resilience.rollout import (
+    RolloutController, RolloutPolicy, read_assignment, version_step)
+from distributed_tensorflow_tpu.serving.engine import (
+    InferenceEngine, params_digest)
+from distributed_tensorflow_tpu.serving.kv_cache import (
+    BlockAllocator, HostTier, PrefixCache)
+from distributed_tensorflow_tpu.serving.scheduler import Request
+from distributed_tensorflow_tpu.telemetry import goodput
+from distributed_tensorflow_tpu.telemetry import slo as tv_slo
+
+
+# ---------------------------------------------------------------------------
+# shared tiny model + checkpoint pair
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+ENGINE_KW = dict(num_blocks=48, block_size=8, max_slots=4,
+                 max_prompt_len=16, queue_capacity=64)
+
+
+def _params(cfg, seed: int) -> dict:
+    p = TransformerLM(cfg).init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return p.unfreeze() if hasattr(p, "unfreeze") else dict(p)
+
+
+def _save_pair(cfg, directory: str, *, null_swap: bool = False):
+    """Steps 1 and 2 in one checkpoint dir (2 = 1 when null_swap)."""
+    for step, seed in ((1, 0), (2, 0 if null_swap else 7)):
+        mgr = CheckpointManager(
+            Checkpoint(params=_params(cfg, seed)), directory,
+            max_to_keep=8)
+        mgr.save(step)
+
+
+def _serve_all(engine, requests) -> dict:
+    out = {}
+    for r in requests:
+        engine.submit(r)
+    while not engine.scheduler.idle:
+        for rec in engine.step():
+            out[rec["id"]] = (tuple(rec["tokens"]),
+                              rec["model_version"])
+    return out
+
+
+def _requests(n: int, *, new_tokens: int = 5) -> list:
+    return [Request(id=f"q{i}", tokens=tuple(range(2, 2 + 4 + i % 3)),
+                    max_new_tokens=new_tokens) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# version identity
+# ---------------------------------------------------------------------------
+
+class TestVersionIdentity:
+    def test_digest_stable_and_sensitive(self, tiny):
+        cfg, params = tiny
+        d1 = params_digest(params)
+        assert d1 == params_digest(params)
+        assert len(d1) == 8
+        other = _params(cfg, 7)
+        assert params_digest(other) != d1
+
+    def test_weights_version_shape(self, tiny):
+        cfg, params = tiny
+        eng = InferenceEngine(cfg, params, **ENGINE_KW)
+        # direct params (no snapshot): step 0, digest of the canonical
+        # tree
+        assert eng.weights_step == 0
+        assert eng.weights_version == f"0@{eng.weights_digest}"
+        assert version_step(eng.weights_version) == 0
+        assert eng.stats()["weights_version"] == eng.weights_version
+
+    def test_completions_stamped_with_version(self, tiny):
+        cfg, params = tiny
+        eng = InferenceEngine(cfg, params, **ENGINE_KW)
+        out = _serve_all(eng, _requests(3))
+        assert all(ver == eng.weights_version
+                   for _, ver in out.values())
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_null_swap_byte_identity(self, tiny, tmp_path):
+        cfg, _ = tiny
+        _save_pair(cfg, str(tmp_path), null_swap=True)
+        reqs = _requests(8)
+        ref = _serve_all(InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, **ENGINE_KW), reqs)
+        eng = InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, **ENGINE_KW)
+        for r in reqs:
+            eng.submit(r)
+        out = {}
+        steps = 0
+        while not eng.scheduler.idle:
+            for rec in eng.step():
+                out[rec["id"]] = (tuple(rec["tokens"]),
+                                  rec["model_version"])
+            steps += 1
+            if steps == 2:
+                eng.load_version(2)
+        assert eng.swaps == 1
+        assert {k: v[0] for k, v in out.items()} \
+            == {k: v[0] for k, v in ref.items()}
+
+    def test_real_swap_no_mixed_versions(self, tiny, tmp_path):
+        cfg, _ = tiny
+        _save_pair(cfg, str(tmp_path))
+        reqs = _requests(8)
+        refs = {s: {k: v[0] for k, v in _serve_all(
+                    InferenceEngine.from_checkpoint(
+                        cfg, str(tmp_path), at_step=s, **ENGINE_KW),
+                    reqs).items()}
+                for s in (1, 2)}
+        assert refs[1] != refs[2]        # the versions really differ
+        eng = InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, **ENGINE_KW)
+        for r in reqs:
+            eng.submit(r)
+        out = {}
+        info = None
+        while not eng.scheduler.idle:
+            for rec in eng.step():
+                out[rec["id"]] = (tuple(rec["tokens"]),
+                                  rec["model_version"])
+            # swap once some v1 completions landed, mid-flight for
+            # the rest
+            if info is None and len(out) >= 2:
+                info = eng.load_version(2)
+        # in-flight sequences were re-queued, none dropped
+        assert info is not None and info["requeued"] >= 1
+        assert set(out) == {r.id for r in reqs}
+        # every completion is wholly ONE version's pure output
+        for rid, (tokens, ver) in out.items():
+            step = version_step(ver)
+            assert step in (1, 2)
+            assert tokens == tuple(refs[step][rid]), \
+                f"{rid} mixed tokens across versions"
+        # the swap happened mid-stream: both versions completed some
+        assert {version_step(v) for _, v in out.values()} == {1, 2}
+
+    def test_requeue_sanitizes_preemption_replay(self, tiny):
+        """A queued replay request (non-empty generated_prefix — the
+        preemption path) is stripped pristine at requeue: the replayed
+        tokens were version N's and must not seed version N+1."""
+        cfg, params = tiny
+        eng = InferenceEngine(cfg, params, **ENGINE_KW)
+        replay = Request(id="replay", tokens=(2, 3, 4, 5, 9, 9),
+                         max_new_tokens=3,
+                         generated_prefix=(9, 9))
+        eng.submit(_requests(2)[0])
+        eng.step()                        # something running mid-decode
+        eng.scheduler.queue.submit(replay)
+        requeued = eng.scheduler.requeue_running()
+        assert requeued == 1
+        assert not eng.scheduler.running
+        sanitized = {r.id: r for r in eng.scheduler.queue._q}
+        rep = sanitized["replay"]
+        assert rep.generated_prefix == ()
+        assert rep.tokens == (2, 3, 4, 5)
+        assert rep.max_new_tokens == 5
+        # the formerly-running request is back at the queue FRONT
+        assert eng.scheduler.queue._q[0].id == "q0"
+
+    def test_swap_rejects_mismatched_tree(self, tiny):
+        cfg, params = tiny
+        eng = InferenceEngine(cfg, params, **ENGINE_KW)
+        bad_cfg = TransformerConfig.tiny(max_seq_len=64, d_model=96)
+        bad = _params(bad_cfg, 0)
+        with pytest.raises(ValueError, match="swap"):
+            eng.install_version(bad, step=2)
+
+    def test_background_swap_error_keeps_serving(self, tiny, tmp_path):
+        cfg, _ = tiny
+        _save_pair(cfg, str(tmp_path))
+        eng = InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, **ENGINE_KW)
+        assert eng.begin_load_version(99)     # no such snapshot
+        t = eng._swap_thread
+        t.join(30.0)
+        assert not t.is_alive()
+        out = _serve_all(eng, _requests(2))   # step() polls the error
+        assert eng.swap_error is not None
+        assert eng.weights_step == 1          # still serving v1
+        assert len(out) == 2
+
+    def test_background_swap_installs_at_step_boundary(
+            self, tiny, tmp_path):
+        cfg, _ = tiny
+        _save_pair(cfg, str(tmp_path))
+        eng = InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, **ENGINE_KW)
+        assert eng.begin_load_version(2)
+        assert not eng.begin_load_version(2)  # one in flight at a time
+        eng._swap_thread.join(30.0)
+        assert eng.weights_step == 1          # not yet: no step ran
+        out = _serve_all(eng, _requests(2))
+        assert eng.weights_step == 2 and eng.swaps == 1
+        assert all(version_step(v) == 2 for _, v in out.values())
+
+
+# ---------------------------------------------------------------------------
+# version-fenced prefix cache
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheFence:
+    def test_fence_drops_device_entries(self):
+        alloc = BlockAllocator(16)
+        cache = PrefixCache(alloc, block_size=4)
+        blocks = alloc.alloc(2)
+        cache.register(tuple(range(8)), blocks)
+        alloc.free(blocks)                    # cache holds its own refs
+        free_before_fence = alloc.num_free
+        dropped = cache.fence("pool/2@beef")
+        assert dropped == 2 and len(cache) == 0
+        assert alloc.num_free == free_before_fence + 2
+        s = cache.stats()
+        assert s["fences"] == 1 and s["fence_dropped"] == 2
+        # a stale prefix MISSES after the fence
+        n, got = cache.match(tuple(range(9)))
+        assert n == 0 and got == []
+
+    def test_fence_drops_spilled_blocks_lazily(self):
+        """A host-tier block spilled under weights N is dropped and
+        counted — not served — when looked up under N+1."""
+        alloc = BlockAllocator(16)
+        cache = PrefixCache(alloc, block_size=4)
+        store: dict = {}
+        tier = HostTier(capacity_blocks=8)
+        cache.attach_spill(
+            tier,
+            extract=lambda b: {"k": np.full((2, 2), b, np.float32)},
+            insert=lambda b, arrays: store.update({b: arrays}),
+            epoch="pool/1@aaaa")
+        blocks = alloc.alloc(1)
+        cache.register(tuple(range(4)), blocks)
+        alloc.free(blocks)
+        assert cache.evict(1) == 1            # spilled to host tier
+        assert len(tier) == 1
+        # same epoch: the spilled block re-adopts fine...
+        n, got = cache.match(tuple(range(5)))
+        assert n == 4 and cache.spill_hits == 1
+        for b in got:
+            alloc.free([b])
+        cache.fence("pool/1@aaaa")            # back to device-free state
+        blocks = alloc.alloc(1)
+        cache.register(tuple(range(4)), blocks)
+        alloc.free(blocks)
+        assert cache.evict(1) == 1
+        # ...but across a WEIGHTS fence it is dropped and counted
+        cache.fence("pool/2@bbbb")
+        rejects_before = cache.spill_rejects
+        n, got = cache.match(tuple(range(5)))
+        assert n == 0 and got == []
+        assert cache.spill_rejects == rejects_before + 1
+        assert len(tier) == 0                 # dropped, not retained
+
+    def test_engine_swap_fences_cache(self, tiny, tmp_path):
+        cfg, _ = tiny
+        _save_pair(cfg, str(tmp_path))
+        eng = InferenceEngine.from_checkpoint(
+            cfg, str(tmp_path), at_step=1, prefix_caching=True,
+            **ENGINE_KW)
+        prompt = tuple(range(2, 2 + 12))
+        r1 = Request(id="a", tokens=prompt, max_new_tokens=3)
+        r2 = Request(id="b", tokens=prompt, max_new_tokens=3)
+        _serve_all(eng, [r1])
+        _serve_all(eng, [r2])                 # same prompt: cache hit
+        cache = eng.scheduler.prefix_cache
+        hits_before = cache.hit_requests
+        assert hits_before >= 1
+        eng.load_version(2)
+        assert cache.stats()["fences"] == 1
+        out = _serve_all(eng, [Request(id="c", tokens=prompt,
+                                       max_new_tokens=3)])
+        # the v1 blocks did NOT serve v2's prefill
+        assert cache.hit_requests == hits_before
+        assert version_step(out["c"][1]) == 2
+
+
+# ---------------------------------------------------------------------------
+# pin-restore
+# ---------------------------------------------------------------------------
+
+class TestPinRestore:
+    def _mgr(self, cfg, directory, **kw):
+        return CheckpointManager(
+            Checkpoint(params=_params(cfg, 0)), directory, **kw)
+
+    def test_at_step_restores_exact_snapshot(self, tiny, tmp_path):
+        cfg, _ = tiny
+        d = str(tmp_path)
+        for step, seed in ((1, 0), (2, 7), (3, 9)):
+            mgr = CheckpointManager(
+                Checkpoint(params=_params(cfg, seed)), d, max_to_keep=8)
+            mgr.save(step)
+        want = params_digest(_params(cfg, 7))
+        mgr = self._mgr(cfg, d, max_to_keep=8)
+        tier, step, flat = mgr.restore_latest(at_step=2)
+        assert step == 2
+        path = latest_checkpoint(d, at_step=2)
+        assert path.endswith("ckpt-2")
+        # and the weights really are step 2's, not the latest
+        from distributed_tensorflow_tpu.training.model import (
+            _unflatten_like)
+        got = _unflatten_like(_params(cfg, 0), flat, "params")
+        assert params_digest(got) == want
+
+    def test_pruned_step_raises_loudly(self, tiny, tmp_path):
+        cfg, _ = tiny
+        d = str(tmp_path)
+        mgr = self._mgr(cfg, d, max_to_keep=1)
+        for step in (1, 2, 3):
+            mgr.save(step)                    # rotation prunes 1 and 2
+        with pytest.raises(FileNotFoundError, match="pinned"):
+            mgr.restore_latest(at_step=1)
+        with pytest.raises(FileNotFoundError):
+            latest_checkpoint(d, at_step=1)
+
+    def test_torn_step_raises_corrupt(self, tiny, tmp_path):
+        cfg, _ = tiny
+        d = str(tmp_path)
+        mgr = self._mgr(cfg, d, max_to_keep=8)
+        mgr.save(1)
+        os.makedirs(os.path.join(d, "ckpt-5"))   # exists, never committed
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore_latest(at_step=5)
+        with pytest.raises(CheckpointCorruptError):
+            latest_checkpoint(d, at_step=5)
+
+
+# ---------------------------------------------------------------------------
+# delta snapshots
+# ---------------------------------------------------------------------------
+
+def _tcfg(**kw) -> DynamicTableConfig:
+    base = dict(dim=8, initial_capacity=64, max_capacity=256)
+    base.update(kw)
+    return DynamicTableConfig(**base)
+
+
+def _touch(table, rng, n_ids: int, hi: int = 500):
+    ids = rng.integers(0, hi, size=n_ids)
+    rows = table.translate(ids)
+    table.apply_row_grads(
+        rows, rng.normal(size=(len(ids), table.cfg.dim))
+        .astype(np.float32))
+
+
+class TestDeltaSnapshots:
+    def test_chain_reconstructs_bit_identical(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=4)
+        rng = np.random.default_rng(0)
+        kinds = []
+        for _ in range(7):
+            _touch(t, rng, 20)
+            kinds.append(store.publish(t)["kind"])
+        assert kinds == ["full", "delta", "delta", "delta",
+                         "full", "delta", "delta"]
+        rt, info = store.reconstruct(cfg)
+        assert not info["chain_broken"]
+        assert info["applied_deltas"] == 2
+        assert states_equal(t.state_dict(), rt.state_dict())
+
+    def test_deltas_are_row_sparse(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=16)
+        rng = np.random.default_rng(1)
+        _touch(t, rng, 40)
+        full = store.publish(t)
+        _touch(t, rng, 4, hi=40)              # few rows move
+        delta = store.publish(t)
+        assert full["kind"] == "full" and delta["kind"] == "delta"
+        assert delta["bytes"] < full["bytes"] / 4
+
+    def test_growth_forces_full(self, tmp_path):
+        cfg = _tcfg(initial_capacity=16, max_capacity=64)
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=32)
+        rng = np.random.default_rng(2)
+        _touch(t, rng, 8, hi=20)
+        assert store.publish(t)["kind"] == "full"
+        grows_before = t.grows
+        while t.grows == grows_before:        # force a growth
+            _touch(t, rng, 30, hi=4000)
+        assert t.state_delta() is None        # capacity changed
+        assert store.publish(t)["kind"] == "full"
+        rt, info = store.reconstruct(cfg)
+        assert states_equal(t.state_dict(), rt.state_dict())
+        assert not info["chain_broken"]
+
+    def test_broken_link_serves_intact_prefix(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=16)
+        rng = np.random.default_rng(3)
+        states = []
+        for _ in range(4):
+            _touch(t, rng, 20)
+            store.publish(t)
+            states.append(t.state_dict())
+        # tear delta seq 3 (post-commit corruption: crc catches it)
+        path = store._path("delta", 3)
+        size = os.path.getsize(path)
+        with open(path, "rb+") as f:
+            f.truncate(size - size // 3)
+        rt, info = store.reconstruct(cfg)
+        assert info["chain_broken"]
+        assert info["served_seq"] == 2        # longest intact prefix
+        assert states_equal(states[1], rt.state_dict())
+
+    def test_corrupt_full_falls_back_to_prior_full(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=2)
+        rng = np.random.default_rng(4)
+        states = []
+        for _ in range(4):                    # full,delta,full,delta
+            _touch(t, rng, 20)
+            store.publish(t)
+            states.append(t.state_dict())
+        with open(store._path("full", 3), "rb+") as f:
+            f.truncate(10)
+        rt, info = store.reconstruct(cfg)
+        assert info["base_seq"] == 1 and info["chain_broken"]
+        # deltas after the torn full parent-link PAST it, so the walk
+        # from the older full stops at seq 2
+        assert info["served_seq"] == 2
+        assert states_equal(states[1], rt.state_dict())
+
+    def test_no_intact_full_raises(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=8)
+        rng = np.random.default_rng(5)
+        _touch(t, rng, 20)
+        store.publish(t)
+        with open(store._path("full", 1), "rb+") as f:
+            f.truncate(5)
+        with pytest.raises(DeltaChainError):
+            store.reconstruct(cfg)
+
+    def test_publish_fault_raise_is_retry_safe(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=8)
+        rng = np.random.default_rng(6)
+        _touch(t, rng, 20)
+        sched = FaultSchedule(rules=[
+            FaultRule(site="delta.publish", hits=(1,))])
+        with faults.inject(sched):
+            with pytest.raises(OSError):
+                store.publish(t)
+            # nothing committed; the retry publishes cleanly
+            assert store._scan() == []
+            info = store.publish(t)
+        assert info["kind"] == "full"
+        rt, _ = store.reconstruct(cfg)
+        assert states_equal(t.state_dict(), rt.state_dict())
+
+    def test_publish_fault_corrupt_caught_by_crc(self, tmp_path):
+        cfg = _tcfg()
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=8)
+        rng = np.random.default_rng(7)
+        _touch(t, rng, 20)
+        store.publish(t)
+        good = t.state_dict()
+        _touch(t, rng, 5)
+        sched = FaultSchedule(rules=[
+            FaultRule(site="delta.publish", action="corrupt",
+                      hits=(1,))])
+        with faults.inject(sched):
+            store.publish(t)                  # commits, then tears
+        rt, info = store.reconstruct(cfg)
+        assert info["chain_broken"] and info["served_seq"] == 1
+        assert states_equal(good, rt.state_dict())
+
+    @pytest.mark.slow
+    def test_million_row_delta_bit_identity(self, tmp_path):
+        """10⁶-row table, <1% rows moving per interval: the delta is
+        tiny relative to the full and reconstruction is bit-identical
+        — the at-scale claim, proven not assumed."""
+        n = 1 << 20
+        cfg = _tcfg(dim=4, initial_capacity=n, max_capacity=n)
+        t = DynamicTable(cfg)
+        store = DeltaSnapshotStore(str(tmp_path), full_every=64)
+        rng = np.random.default_rng(8)
+        _touch(t, rng, 200_000, hi=2_000_000)   # populate a head
+        full = store.publish(t)
+        moved = 0
+        while moved < 4000:                      # <1% of 2^20 rows
+            before = t.dirty_rows
+            _touch(t, rng, 1000, hi=30_000)      # hot head only
+            moved = t.dirty_rows if t.dirty_rows else before
+        dirty = t.dirty_rows
+        assert dirty < n // 100
+        delta = store.publish(t)
+        assert delta["kind"] == "delta"
+        assert delta["bytes"] < full["bytes"] // 50
+        rt, info = store.reconstruct(cfg)
+        assert not info["chain_broken"]
+        assert states_equal(t.state_dict(), rt.state_dict())
+
+
+# ---------------------------------------------------------------------------
+# canary controller
+# ---------------------------------------------------------------------------
+
+def _policy(**kw) -> RolloutPolicy:
+    base = dict(
+        slo=tv_slo.SLO("p", "latency", objective=0.9, threshold_s=0.1,
+                       windows=((8.0, 2.0, 2.0),)),
+        fire_consecutive=2, clear_hold_s=1.0, cooldown_s=0.5,
+        interval_s=0.1, min_evidence=3)
+    base.update(kw)
+    return RolloutPolicy(**base)
+
+
+def _recs(t: float, version: str, latency: float, n: int = 6) -> list:
+    return [{"wall": t - i * 0.1, "latency_s": latency, "ok": True,
+             "model_version": version} for i in range(n)]
+
+
+class TestRolloutController:
+    def test_canary_waits_for_serving_evidence(self):
+        c = RolloutController(["0", "1"], base_step=1, target_step=2,
+                              policy=_policy(), clock=lambda: 0.0)
+        assert c.decide(now=100.0, records=[]) is None
+        assert c.state == "baseline"
+        d = c.decide(now=101.0, records=_recs(101.0, "1@bb", 0.01))
+        assert d.action == "advance" and d.replica == "0"
+        assert c.assignment == {"0": 2, "1": 1}
+
+    def _started(self, replicas=("0", "1", "2"), **pol):
+        c = RolloutController(list(replicas), base_step=1,
+                              target_step=2, policy=_policy(**pol),
+                              clock=lambda: 0.0)
+        c.decide(now=100.0, records=_recs(100.0, "1@bb", 0.01))
+        assert c.state == "ramping"
+        return c
+
+    def test_promotes_replica_by_replica_on_clear(self):
+        c = self._started()
+        t, actions = 100.0, []
+        for _ in range(60):
+            t += 0.2
+            d = c.decide(now=t, records=(
+                _recs(t, "2@aa", 0.01) + _recs(t, "1@bb", 0.01)))
+            if d:
+                actions.append((d.action, d.replica))
+            if c.done:
+                break
+        assert actions == [("advance", "1"), ("advance", "2"),
+                           ("promote", None)]
+        assert c.state == "promoted"
+        assert c.assignment == {"0": 2, "1": 2, "2": 2}
+
+    def test_rollback_on_canary_burn_with_debounce(self):
+        c = self._started(replicas=("0", "1"))
+        burning = lambda t: (_recs(t, "2@aa", 5.0)
+                             + _recs(t, "1@bb", 0.01))
+        t = 100.6                             # past the cooldown
+        assert c.decide(now=t, records=burning(t)) is None
+        assert c._fire_streak == 1            # debounced, not yet
+        d = c.decide(now=t + 0.2, records=burning(t + 0.2))
+        assert d.action == "rollback" and d.reason == "slo_burn"
+        assert c.state == "rolled_back"
+        assert c.assignment == {"0": 1, "1": 1}
+
+    def test_holds_when_baseline_burns_too(self):
+        c = self._started(replicas=("0", "1"))
+        both = lambda t: (_recs(t, "2@aa", 5.0)
+                          + _recs(t, "1@bb", 5.0))
+        t = 100.6
+        for _ in range(10):
+            t += 0.2
+            assert c.decide(now=t, records=both(t)) is None
+        assert c.state == "ramping"           # infra, not the version
+
+    def test_no_advance_without_canary_traffic(self):
+        c = self._started(replicas=("0", "1"))
+        t = 100.6
+        for _ in range(20):
+            t += 0.2
+            # plenty of healthy BASELINE traffic, zero canary evidence
+            assert c.decide(now=t,
+                            records=_recs(t, "1@bb", 0.01)) is None
+        assert c.state == "ramping" and c.moved == ["0"]
+
+    def test_assignment_file_roundtrip(self, tmp_path):
+        path = str(tmp_path / "rollout-target.json")
+        c = RolloutController(["0"], base_step=1, target_step=2,
+                              policy=_policy(), clock=lambda: 50.0,
+                              assignment_path=path,
+                              records_fn=lambda: [])
+        assert read_assignment(path) is None  # nothing written yet
+        c.tick()                              # publish + write
+        a = read_assignment(path)
+        assert a["assignment"] == {"0": 1}
+        assert a["target_step"] == 2 and a["state"] == "baseline"
+        assert a["published_wall"] == 50.0
+        c.decide(now=51.0, records=_recs(51.0, "1@bb", 0.01))
+        c.write_assignment()
+        a2 = read_assignment(path)
+        assert a2["assignment"] == {"0": 2}
+        assert a2["seq"] > a["seq"]
+
+
+# ---------------------------------------------------------------------------
+# accounting: freshness closes at swap, transitions priced
+# ---------------------------------------------------------------------------
+
+class TestRolloutAccounting:
+    def test_freshness_closes_at_swap_not_publish(self):
+        events = {
+            "supervisor": [{"ev": "rollout.publish", "wall": 100.0,
+                            "step": 2, "freshness_s": 0.5}],
+            0: [{"ev": "serve.swap", "wall": 103.0, "step": 2,
+                 "mode": "swap"}],
+            1: [{"ev": "serve.swap", "wall": 110.0, "step": 2,
+                 "mode": "restart"},
+                {"ev": "serve.swap", "wall": 99.0, "step": 2,
+                 "mode": "restart"},          # pre-publish: ignored
+                {"ev": "serve.swap", "wall": 104.0, "step": 1,
+                 "mode": "swap"}],            # other step: ignored
+        }
+        recs = tv_slo.freshness_records_from_events(events)
+        assert len(recs) == 2                 # one per adopting replica
+        by_mode = {r["mode"]: r for r in recs}
+        assert by_mode["swap"]["freshness_s"] == pytest.approx(3.5)
+        # the restart adopter honestly reports its respawn-sized gap
+        assert by_mode["restart"]["freshness_s"] == pytest.approx(10.5)
+
+    def test_freshness_legacy_without_swaps(self):
+        events = {0: [{"ev": "stream.snapshot_published", "wall": 100.0,
+                       "freshness_s": 1.25, "lag_events": 3}]}
+        recs = tv_slo.freshness_records_from_events(events)
+        assert len(recs) == 1
+        assert recs[0]["freshness_s"] == 1.25
+
+    def test_unadopted_publish_produces_no_record(self):
+        events = {
+            "supervisor": [{"ev": "rollout.publish", "wall": 100.0,
+                            "step": 2, "freshness_s": 0.0}],
+            0: [{"ev": "serve.swap", "wall": 101.0, "step": 1,
+                 "mode": "restart"}],
+        }
+        assert tv_slo.freshness_records_from_events(events) == []
+
+    def test_swap_priced_into_rollout_bucket(self):
+        assert "rollout" in goodput.BADPUT_BUCKETS
+        events = {0: [
+            {"ev": "run.start", "wall": 100.0, "pid": 0},
+            {"ev": "serve.step", "wall": 101.0, "dur_s": 0.5, "pid": 0},
+            {"ev": "serve.swap", "wall": 101.4, "dur_s": 0.3, "pid": 0},
+            {"ev": "serve.step", "wall": 102.0, "dur_s": 0.5, "pid": 0},
+        ]}
+        led = goodput.ledger_from_events(events)
+        assert led["badput_s"]["rollout"] == pytest.approx(0.3)
+        assert led["goodput_s"] == pytest.approx(1.0)
+        identity = abs(led["wall_s"] - (led["goodput_s"]
+                                        + sum(led["badput_s"].values())))
+        assert identity < 1e-9
+
+    def test_live_ledger_accepts_rollout_record(self):
+        t = [100.0]
+        led = goodput.GoodputLedger(register=False,
+                                    clock=lambda: t[0])
+        t[0] = 101.0                          # wall to claim against
+        led.record("rollout", 0.25)
+        assert led.snapshot()["badput_s"]["rollout"] == \
+            pytest.approx(0.25)
+
+    def test_slo_records_carry_model_version(self):
+        events = {0: [{"ev": "serve.request", "wall": 100.0,
+                       "id": "r1", "latency_s": 0.05, "ok": True,
+                       "model_version": "2@abcd1234"}]}
+        recs = tv_slo.records_from_events(events)
+        assert recs[0]["model_version"] == "2@abcd1234"
+        assert version_step(recs[0]["model_version"]) == 2

@@ -51,6 +51,11 @@ Series:
 - ``autoscale/<metric>`` — the ``AUTOSCALE_r*.json`` closed-loop rows
   (bench.py --autoscale): spike→scale-up latency and SLO recovery time
   gate INVERTED (a slower loop fails), goodput fraction gates normally;
+- ``rollout/<metric>`` — the ``ROLLOUT_r*.json`` live-rollout rows
+  (bench.py --rollout): hot-swap publish→servable freshness p99,
+  in-engine install pause, bad-canary detect→rollback time, delta
+  publish cost and delta/full size ratio — ALL inverted (a slower or
+  fatter rollout path regresses);
 - goodput/badput columns (``bench/goodput_frac``,
   ``serving/goodput_frac``, ``serving/badput_replay_frac``,
   ``serving/slo_p99_budget_consumed`` — the last two inverted): present
@@ -323,6 +328,33 @@ def load_autoscale_history(repo: str = REPO) \
     return series
 
 
+def load_rollout_history(repo: str = REPO) \
+        -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from ROLLOUT_r*.json (ISSUE 17): the
+    live-rollout path's costs. EVERY series is ``lower_is_better`` —
+    publish→servable freshness, the install pause, detect→rollback
+    and the delta publish cost/ratio all regress by growing."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "ROLLOUT_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            metric = row.get("metric")
+            if not isinstance(row.get("value"), (int, float)) \
+                    or not metric:
+                continue
+            name = metric.removeprefix("rollout_")
+            series.setdefault(f"rollout/{name}", {})[rnd] = {
+                "value": row.get("value"), "unit": row.get("unit"),
+                "lower_is_better": True}
+    return series
+
+
 def load_online_history(repo: str = REPO) \
         -> "dict[str, dict[int, dict]]":
     """``{series: {round: row}}`` from ONLINE_r*.json (ISSUE 15): per
@@ -451,6 +483,7 @@ def main(argv=None) -> int:
     series.update(load_data_history(args.repo))
     series.update(load_autoscale_history(args.repo))
     series.update(load_online_history(args.repo))
+    series.update(load_rollout_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

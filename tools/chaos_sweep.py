@@ -90,6 +90,22 @@ in-run (the final published snapshot covers the whole stream within
 the freshness budget, with at least one snapshot served after the last
 recovery), and the goodput identity holds (±1%, recovery priced).
 
+``--rollout`` sweeps the LIVE-ROLLOUT axis (ISSUE 17): each seed runs
+the canary rollout harness (examples/live_rollout.py — supervised
+serving replicas hot-swapping weights under an SLO-gated
+RolloutController) twice: once with a seed-derived SIGKILL landing
+mid-swap/mid-canary, and once with the canary version made
+deliberately slow (``--bad-canary``). A seed survives only when
+every seeded request is served exactly (zero dropped across the kill,
+the requeue, and any rollback), every completion byte-matches the
+PURE output of the version it is stamped with (no mixed-version token
+streams), the goodput identity holds within ±1% with swap transitions
+priced into the ``rollout`` bucket, and the bad-canary run AUTO-ROLLS
+BACK on SLO burn. A third, in-process leg injects seeded faults into
+the delta-snapshot publish path (``delta.publish`` raise + corrupt):
+pre-commit failures must be retry-safe and post-commit tears must be
+caught by crc with the longest intact chain served bit-identically.
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -106,6 +122,7 @@ Usage::
     python tools/chaos_sweep.py --serve --seeds 3     # serving sweep
     python tools/chaos_sweep.py --serve --disagg --seeds 3  # disagg
     python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
+    python tools/chaos_sweep.py --rollout --seeds 3   # live-rollout sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -905,6 +922,181 @@ def run_spike_seed(seed: int, *, budget: int, train_workers: int,
     return ok, dt
 
 
+def _rollout_summary_gate(run_dir: str, *,
+                          expect_rollback: bool = False) -> "list[str]":
+    """Gates recomputed by examples/live_rollout.py's ``analyze``
+    (coverage from completion-log unions, version identity against
+    pure-engine references, the priced ledger) — this just enforces
+    the thresholds."""
+    import json
+    bad = []
+    try:
+        with open(os.path.join(run_dir, "rollout-summary.json")) as f:
+            s = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"no rollout summary: {e}"]
+    req = s.get("requests", {})
+    if req.get("dropped", 1) != 0:
+        bad.append(f"{req.get('dropped')} request(s) DROPPED "
+                   f"({req.get('missing_ids')})")
+    ver = s.get("versions", {})
+    if ver.get("mixed_or_wrong", 1) != 0:
+        bad.append(f"{ver.get('mixed_or_wrong')} completion(s) with "
+                   f"mixed/wrong-version tokens ({ver.get('examples')})")
+    if ver.get("unversioned", 1) != 0:
+        bad.append(f"{ver.get('unversioned')} completion(s) missing a "
+                   f"model_version stamp")
+    led = s.get("ledger", {})
+    err = led.get("identity_error_frac")
+    if err is None or err > 0.01:
+        bad.append(f"ledger identity off by {err} (> 1%)")
+    if expect_rollback and not s.get("rollout", {}).get("rolled_back"):
+        bad.append(f"bad canary was NOT rolled back "
+                   f"(state={s.get('rollout', {}).get('state')})")
+    if not expect_rollback and s.get("swaps", {}).get("hot", 0) \
+            + s.get("swaps", {}).get("restart", 0) == 0:
+        bad.append("no swap ever happened (canary never started)")
+    return bad
+
+
+def _delta_fault_gate(seed: int) -> "list[str]":
+    """Seeded faults on the ``delta.publish`` site: a pre-commit raise
+    must leave nothing behind (retry publishes cleanly) and a
+    post-commit corrupt must be caught by crc, with reconstruction
+    serving the longest intact chain bit-identically."""
+    import pickle
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.checkpoint import (
+        DeltaSnapshotStore, states_equal)
+    from distributed_tensorflow_tpu.embedding.dynamic import (
+        DynamicTable, DynamicTableConfig)
+    from distributed_tensorflow_tpu.resilience import faults
+    from distributed_tensorflow_tpu.resilience.faults import (
+        FaultRule, FaultSchedule)
+
+    bad = []
+    tmp = tempfile.mkdtemp(prefix=f"chaos_delta_s{seed}_")
+    rng = np.random.default_rng(seed)
+    cfg = DynamicTableConfig(dim=8, initial_capacity=128,
+                             max_capacity=512)
+    table = DynamicTable(cfg)
+    store = DeltaSnapshotStore(tmp, full_every=3)
+
+    def _touch(n):
+        ids = rng.integers(0, 900, size=n)
+        rows = table.translate(ids)
+        table.apply_row_grads(
+            rows, rng.normal(size=(len(ids), cfg.dim))
+            .astype(np.float32))
+
+    publishes = 6
+    raise_at = int(rng.integers(1, publishes + 1))
+    sched = FaultSchedule(rules=[
+        FaultRule(site="delta.publish", hits=(raise_at,))])
+    fired = 0
+    with faults.inject(sched):
+        for _ in range(publishes):
+            _touch(24)
+            try:
+                store.publish(table)
+            except OSError:
+                fired += 1
+                store.publish(table)      # pre-commit: retry is clean
+    if fired != 1:
+        bad.append(f"raise fault fired {fired}x (expected 1 at "
+                   f"publish #{raise_at})")
+    good_state = table.state_dict()
+    rt, info = store.reconstruct(cfg)
+    if info["chain_broken"]:
+        bad.append(f"chain broken after retried publishes: {info}")
+    elif not states_equal(good_state, rt.state_dict()):
+        bad.append("post-retry reconstruction is not bit-identical")
+    # post-commit tear on the NEXT publish: crc must catch it and the
+    # chain must fall back to the last intact record
+    _touch(24)
+    sched = FaultSchedule(rules=[
+        FaultRule(site="delta.publish", action="corrupt", hits=(1,))])
+    with faults.inject(sched):
+        store.publish(table)
+    rt, info = store.reconstruct(cfg)
+    if not info["chain_broken"]:
+        bad.append("post-commit tear was NOT detected")
+    elif not states_equal(good_state, rt.state_dict()):
+        bad.append("torn-chain fallback is not bit-identical to the "
+                   "last intact publish")
+    if not bad:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        bad.append(f"(delta dir kept: {tmp})")
+    return bad
+
+
+def run_rollout_seed(seed: int, *, replicas: int, duration: float,
+                     keep_dirs: bool) -> tuple[bool, float]:
+    """One live-rollout seed: a kill run (SIGKILL mid-swap/mid-canary),
+    a bad-canary run (must auto-rollback on burn), and the in-process
+    delta-publish fault leg (module docstring, ``--rollout``)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    ok = True
+    run_dirs = []
+    legs = [
+        ("kill", ["--kills", "1"], False),
+        ("bad-canary", ["--bad-canary"], True),
+    ]
+    for name, extra, expect_rollback in legs:
+        if not ok:
+            break
+        run_dir = tempfile.mkdtemp(prefix=f"chaos_rollout_s{seed}_"
+                                          f"{name.replace('-', '')}_")
+        run_dirs.append(run_dir)
+        cmd = [sys.executable,
+               os.path.join(REPO, "examples", "live_rollout.py"),
+               "--seed", str(seed), "--replicas", str(replicas),
+               "--duration", str(duration),
+               "--telemetry-dir", run_dir,
+               "--ckpt-dir", os.path.join(run_dir, "ckpt"),
+               *extra]
+        proc = subprocess.run(cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if proc.returncode != 0:
+            ok = False
+            tail = proc.stdout.decode(errors="replace") \
+                .splitlines()[-20:]
+            print(f"--- seed {seed} ({name}) FAILED "
+                  f"(rc={proc.returncode}) ---")
+            print("\n".join(tail))
+            break
+        violations = _rollout_summary_gate(
+            run_dir, expect_rollback=expect_rollback)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: rollout gates FAILED ({name}) ---")
+            for v in violations:
+                print(f"    {v}")
+    if ok:
+        violations = _delta_fault_gate(seed)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: delta-publish fault gate "
+                  f"FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        for d in run_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    elif not ok and run_dirs:
+        print(f"    (run dir kept for inspection: {run_dirs[-1]})")
+    return ok, dt
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -949,6 +1141,16 @@ def main(argv=None) -> int:
                          "exactly-once stream-offset accounting, "
                          "freshness-SLO re-clear, and the goodput "
                          "identity are gated per seed")
+    ap.add_argument("--rollout", action="store_true",
+                    help="sweep the live-rollout axis "
+                         "(examples/live_rollout.py): per seed a "
+                         "SIGKILL mid-swap/mid-canary, a bad-canary "
+                         "run that must auto-rollback, and seeded "
+                         "delta-publish faults; zero-dropped, "
+                         "no-mixed-version, priced-transition and "
+                         "chain-honesty gates")
+    ap.add_argument("--duration", type=float, default=18.0,
+                    help="--rollout: serving duration per run (s)")
     ap.add_argument("--events", type=int, default=480,
                     help="--online: stream events per run")
     ap.add_argument("--freshness-budget", type=float, default=10.0,
@@ -1002,12 +1204,17 @@ def main(argv=None) -> int:
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
-                             args.spike, args.online)) > 1:
-        ap.error("--kill, --serve, --data, --spike and --online are "
-                 "separate sweep axes")
+                             args.spike, args.online,
+                             args.rollout)) > 1:
+        ap.error("--kill, --serve, --data, --spike, --online and "
+                 "--rollout are separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.online:
+        if args.rollout:
+            ok, dt = run_rollout_seed(s, replicas=args.workers,
+                                      duration=args.duration,
+                                      keep_dirs=args.keep_dirs)
+        elif args.online:
             ok, dt = run_online_seed(
                 s, events=args.events, budget=args.restart_budget,
                 keep_dirs=args.keep_dirs,
