@@ -498,6 +498,25 @@ def _time_steps(step, state, batch, *, iters: int, reps: int):
     return best
 
 
+def _persistent_state_bytes(state) -> int:
+    """Measured per-device bytes of the persistent training state
+    (params + optimizer slots + counters): each leaf contributes its
+    actual per-device shard (``sharding.shard_shape``), so replicated
+    leaves count full size and dp/pp-sharded leaves count 1/N — the
+    quantity the ZeRO level actually changes."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        shape = getattr(leaf, "shape", ())
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            shape = sharding.shard_shape(shape)
+        size = 1
+        for d in shape:
+            size *= int(d)
+        total += size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
 def run_scaling(out_path: str | None = None, max_devices: int | None = None):
     """Scaling-curve bench (ISSUE 6): tokens/s and images/s vs device
     count {1,2,4,8} with an efficiency column, persisted as
@@ -645,10 +664,169 @@ def run_scaling(out_path: str | None = None, max_devices: int | None = None):
             print(json.dumps(r))
         rows.extend(p_rows)
 
+    # -- interleaved virtual stages: measured vs analytic bubble at pp=4.
+    # Basis: a same-run pp=1 run of the same model/schedule machinery is
+    # the zero-bubble reference (shared-host compute is constant across
+    # device counts, the efficiency_basis above) — measured_bubble =
+    # 1 - T(pp=1)/T(pp=4). Same-run baselines only: timing bases never
+    # cross runs or hosts (PR 14 rule).
+    if limit >= 4:
+        from distributed_tensorflow_tpu.models.transformer import (
+            make_pipelined_train_step as _mk_pp)
+        from distributed_tensorflow_tpu.parallel.pipeline import (
+            bubble_fraction as _bf)
+        il_cfg = (t_cfg if on_tpu
+                  else TransformerConfig.tiny(n_layers=8))
+        n_micro, gb = 8, 8
+        il_batch = {"tokens": synthetic_tokens(gb, il_cfg.max_seq_len,
+                                               il_cfg.vocab_size)}
+        mesh1 = make_mesh({"pp": 1}, devices=devices[:1])
+        state, step = _mk_pp(il_cfg, mesh1, gb, num_microbatches=n_micro,
+                             schedule="1f1b")
+        t_base = _time_steps(step, state, il_batch,
+                             iters=max(2, iters - 1), reps=reps)
+        il_rows = []
+        for sched, kw, name, v in (("1f1b", {}, "1f1b", 1),
+                                   ("interleaved", {"interleave": 2},
+                                    "interleaved-v2", 2)):
+            mesh = make_mesh({"pp": 4}, devices=devices[:4])
+            state, step = _mk_pp(il_cfg, mesh, gb,
+                                 num_microbatches=n_micro,
+                                 schedule=sched, **kw)
+            dt = _time_steps(step, state, il_batch,
+                             iters=max(2, iters - 1), reps=reps)
+            il_rows.append({
+                "workload": "transformer-pp-il",
+                "metric": "tokens_per_sec", "devices": 4,
+                "global_batch": gb, "schedule": name,
+                "bubble_analytic": round(_bf(4, n_micro, sched,
+                                             interleave=v), 4),
+                "measured_bubble": round(max(0.0, 1.0 - t_base / dt), 4),
+                "baseline_pp1_step_ms": round(t_base * 1e3, 2),
+                "throughput": round(gb * il_cfg.max_seq_len / dt, 1),
+                "step_time_ms": round(dt * 1e3, 2)})
+        base = il_rows[0]["throughput"]
+        for r in il_rows:
+            r["vs_1f1b"] = round(r["throughput"] / base, 3)
+            telemetry.event("scaling.row", **r)
+            print(json.dumps(r))
+            print(f"  analytic bubble {r['bubble_analytic']:.4f} | "
+                  f"measured {r['measured_bubble']:.4f}  "
+                  f"[{r['schedule']}]")
+        rows.extend(il_rows)
+
+    # -- memory frontier: max trainable params per device budget ---------
+    # For each technique, walk a d_model ladder and keep the largest
+    # config whose MEASURED persistent state (params + Adam slots, real
+    # shard shapes) fits a fixed per-device budget; prove the frontier
+    # config actually steps; and report the step-time tax each technique
+    # pays at a common (smallest-rung) config. Device budgets are
+    # simulated — virtual CPU devices share host RAM, so the frontier is
+    # defined by measured state bytes, not an allocator OOM.
+    if limit >= 8:
+        from distributed_tensorflow_tpu.models.transformer import (
+            make_pipelined_train_step as _mk_pp)
+        from distributed_tensorflow_tpu.parallel.zero import (
+            zero_state_bytes)
+        budget_mib = 32.0
+        budget = int(budget_mib * (1 << 20))
+        ladder = (64, 128, 192, 256, 320, 384, 448, 512)
+
+        def mf_cfg(d):
+            return TransformerConfig.tiny(d_model=d, n_layers=4,
+                                          n_heads=4, d_ff=4 * d,
+                                          vocab_size=512, max_seq_len=64)
+
+        def mf_build(tech, d):
+            cfg = mf_cfg(d)
+            if tech == "zero2+offload":
+                mesh = make_mesh({"dp": 2, "pp": 4},
+                                 devices=devices[:8])
+                state, step = _mk_pp(cfg, mesh, 8, num_microbatches=2,
+                                     schedule="1f1b", zero=2,
+                                     offload_activations=True)
+            else:
+                mesh = make_mesh({"dp": 8}, devices=devices[:8])
+                level = {"replicated": 0, "zero1": 1, "zero2": 2}[tech]
+                state, step = make_sharded_train_step(
+                    cfg, mesh, global_batch=8, zero=level)
+            batch = {"tokens": synthetic_tokens(8, cfg.max_seq_len,
+                                                cfg.vocab_size)}
+            return state, step, batch
+
+        mf_rows = []
+        tax_base = None
+        rep_params = None
+        for tech in ("replicated", "zero1", "zero2", "zero2+offload"):
+            chosen = None
+            t_common = None
+            for d in ladder:
+                state, step, batch = mf_build(tech, d)
+                bytes_dev = _persistent_state_bytes(state)
+                # transient gradient buffer, real shard shapes: the
+                # replicated and ZeRO-1 paths materialize the full
+                # (mesh-local) grad tree before the update; ZeRO-2
+                # reduce-scatters it so only the dp-shard lands; the
+                # pipelined path accumulates full local stage grads in
+                # the schedule before ZeRO slices them.
+                grad_bytes = _persistent_state_bytes(state["params"])
+                if tech == "zero2":
+                    grad_bytes //= 8
+                n_params = sum(
+                    int(l.size) for l in
+                    jax.tree_util.tree_leaves(state["params"]))
+                if d == ladder[0]:
+                    t_common = _time_steps(step, state, batch,
+                                           iters=2, reps=2)
+                if bytes_dev + grad_bytes > budget:
+                    del state, step
+                    break
+                chosen = (d, n_params, bytes_dev, grad_bytes)
+                del state, step
+            d, n_params, bytes_dev, grad_bytes = chosen
+            # the frontier config must actually STEP (compile + run)
+            state, step, batch = mf_build(tech, d)
+            state, m = step(state, batch)
+            jax.block_until_ready(m["loss"])
+            del state, step
+            if tech == "replicated":
+                tax_base = t_common
+                rep_params = n_params
+            level = {"replicated": 0, "zero1": 1, "zero2": 2,
+                     "zero2+offload": 2}[tech]
+            row = {
+                "workload": "memfrontier",
+                "metric": "max_trainable_params", "devices": 8,
+                "technique": tech, "budget_mib": budget_mib,
+                "max_trainable_params": int(n_params), "d_model": d,
+                "state_bytes_per_dev": int(bytes_dev),
+                "grad_bytes_per_dev": int(grad_bytes),
+                "analytic_state_bytes": (
+                    None if tech == "zero2+offload"
+                    else zero_state_bytes(n_params, 8, level,
+                                          grad_bytes=0)),
+                "params_vs_replicated": round(n_params / rep_params, 2),
+                "step_time_ms_common": round(t_common * 1e3, 2),
+                "step_time_mult": round(t_common / tax_base, 3),
+                "steps_ok": True,
+            }
+            mf_rows.append(row)
+            telemetry.event("scaling.row", **row)
+            print(json.dumps(row))
+        rows.extend(mf_rows)
+
     result = {
         "bench": "scaling",
         "backend": backend,
         "host_cpus": os.cpu_count(),
+        # Host-speed era for cross-round ABSOLUTE-throughput gating
+        # (PR 14 rule: timing bases never cross runs or hosts — and by
+        # extension, rounds captured on a demonstrably different-speed
+        # host don't regression-gate each other's raw throughput; bump
+        # this string when the box measurably changes speed, as it did
+        # between the r06 and r07 captures). Same-run ratios
+        # (efficiency, bubbles, taxes, param floors) stay era-free.
+        "timing_era": "cpu1core-r07",
         "device_counts": counts,
         "efficiency_basis": (
             "shared-host-compute: virtual devices time-share the host "

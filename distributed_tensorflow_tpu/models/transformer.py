@@ -761,7 +761,8 @@ def state_shardings_for(model, tx, mesh: Mesh, example_tokens,
 
 def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
                             global_batch: int, seed: int = 0,
-                            step_factory=None, grad_sync: str = "auto"):
+                            step_factory=None, grad_sync: str = "auto",
+                            zero: int = 0):
     """Initialize sharded state and return (state, jitted step_fn).
 
     The returned step consumes batches of shape (global_batch, seq);
@@ -791,6 +792,15 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     ``step_factory(cfg, model, tx)`` lets variants (BERT MLM) swap the
     per-step loss while reusing all sharding/jit wiring.
+
+    ``zero`` selects ZeRO optimizer-state sharding over the dp axis
+    (parallel/zero.py; params stay replicated over dp, Adam slots exist
+    only for each rank's 1/N bucket slice — bit-identical to replicated
+    Adam). Level 1 all-reduces gradients as usual; level 2
+    reduce-scatters them so the full gradient buffer never materializes
+    either. On meshes that are not exactly ("dp",), gradient sync stays
+    with GSPMD and levels 1/2 behave identically (slots sharded, grads
+    compiler-managed).
     """
     from distributed_tensorflow_tpu.cluster.topology import \
         data_axes as mesh_data_axes
@@ -799,6 +809,24 @@ def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
     if grad_sync not in ("auto", "bucketed", "gspmd", "none"):
         raise ValueError(f"grad_sync={grad_sync!r}; expected auto/"
                          f"bucketed/gspmd/none")
+    if zero not in (0, 1, 2):
+        raise ValueError(f"zero={zero!r}; expected 0, 1, or 2")
+    if zero:
+        if step_factory is not None:
+            raise ValueError("zero= is not supported with step_factory")
+        if cfg.moe_experts > 0:
+            raise NotImplementedError("zero= with MoE is not supported")
+        if cfg.fused_optimizer:
+            raise ValueError("zero= replaces the optimizer update; set "
+                             "fused_optimizer=False")
+        if grad_sync != "auto":
+            raise ValueError("zero= owns the gradient sync schedule; "
+                             "leave grad_sync='auto'")
+        if tuple(mesh.axis_names) == ("dp",):
+            return _make_zero_dp_train_step(cfg, mesh, global_batch,
+                                            seed, level=zero)
+        return _make_zero_gspmd_train_step(cfg, mesh, global_batch,
+                                           seed, level=zero)
     if grad_sync in ("bucketed", "none") and not pure_dp:
         raise ValueError(
             f"grad_sync={grad_sync!r} needs a pure data-parallel mesh "
@@ -940,18 +968,205 @@ def _make_bucketed_dp_train_step(cfg: TransformerConfig, mesh: Mesh,
     return state, wrapped_step
 
 
+def _make_zero_dp_train_step(cfg: TransformerConfig, mesh: Mesh,
+                             global_batch: int, seed: int = 0,
+                             *, level: int = 1):
+    """Pure data-parallel train step with ZeRO-sharded optimizer state
+    (parallel/zero.py). Like :func:`_make_bucketed_dp_train_step` the
+    whole step runs under shard_map with replicated params, but Adam's
+    mu/nu exist only as each rank's 1/N slice of the packed parameter
+    buckets. Level 1 syncs gradients with the same bucketed MEAN
+    allreduce as the replicated path (bit-identical grads); level 2
+    reduce-scatters the same packed buckets instead, so each rank only
+    materializes its gradient shard. After the sliced update an
+    all-gather over dp rebuilds the parameters — bit-identical to
+    replicated Adam (tests/test_zero.py)."""
+    from distributed_tensorflow_tpu import telemetry as _telemetry
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        GradientBucketer, ReduceOp)
+    from distributed_tensorflow_tpu.parallel.collectives import (
+        all_reduce as collectives_all_reduce)
+    from distributed_tensorflow_tpu.parallel.zero import (
+        ZeroPartition, zero_opt_state)
+
+    if tuple(mesh.axis_names) != ("dp",):
+        raise ValueError(f"ZeRO explicit dp path needs a ('dp',) mesh, "
+                         f"got {tuple(mesh.axis_names)}")
+    n_shards = mesh.size
+    if global_batch % n_shards:
+        raise ValueError(f"global_batch={global_batch} not divisible by "
+                         f"dp={n_shards}")
+    cfg_local = dataclasses.replace(cfg, mesh=None)
+    model = TransformerLM(cfg_local)
+    tx = make_optimizer(cfg)
+    loss_fn = make_loss_fn(cfg_local, model)
+    bucketer = GradientBucketer(("dp",))
+
+    rng = jax.random.PRNGKey(seed)
+    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
+    replicated = NamedSharding(mesh, P())
+
+    def init_params(rng):
+        return model.init(rng, tokens_shape)["params"]
+
+    params_abstract = jax.eval_shape(init_params, rng)
+    param_shardings = jax.tree_util.tree_map(
+        lambda _: replicated, params_abstract)
+    params = jax.jit(init_params, out_shardings=param_shardings)(rng)
+
+    leaves_abs, _ = jax.tree_util.tree_flatten(params_abstract)
+    # same bucket plan as the bucketer's gradient sync, so the level-2
+    # reduce-scatter runs over the very buffers level 1 would pmean
+    partition = ZeroPartition(leaves_abs, n_shards)
+    opt_state, opt_shardings, opt_specs = zero_opt_state(
+        tx, partition, mesh, axes=("dp",))
+    _telemetry.event("zero.partition", axis="dp", level=int(level),
+                     **partition.summary())
+
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    state_shardings = {"params": param_shardings,
+                       "opt_state": opt_shardings, "step": replicated}
+    state_spec = {"params": jax.tree_util.tree_map(
+                      lambda _: P(), params_abstract),
+                  "opt_state": opt_specs, "step": P()}
+
+    def spmd_step(state, batch):
+        params = state["params"]
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch["tokens"])
+        loss = collectives_all_reduce(loss, ("dp",), ReduceOp.MEAN)
+        rank = jax.lax.axis_index("dp")
+        if level == 1:
+            grads = bucketer.all_reduce(grads, op=ReduceOp.MEAN)
+            g_shards = partition.shard(
+                partition.pack(jax.tree_util.tree_leaves(grads)), rank)
+        else:
+            g_shards = partition.reduce_scatter_mean(
+                jax.tree_util.tree_leaves(grads), "dp")
+        pl, td = jax.tree_util.tree_flatten(params)
+        p_shards = partition.shard(partition.pack(pl), rank)
+        updates, new_opt = tx.update(g_shards, state["opt_state"],
+                                     p_shards)
+        new_shards = optax.apply_updates(p_shards, updates)
+        flats = partition.all_gather_flats(new_shards, "dp")
+        new_params = jax.tree_util.tree_unflatten(
+            td, partition.unpack(flats))
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    batch_spec = {"tokens": P("dp")}
+    shard_step = jax.shard_map(
+        spmd_step, mesh=mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False)
+    batch_shardings = {"tokens": NamedSharding(mesh, P("dp"))}
+    step_jit = jax.jit(
+        shard_step,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=safe_donate_argnums((0,)))
+
+    def wrapped_step(state, batch):
+        with mesh:
+            return step_jit(state, batch)
+
+    return state, wrapped_step
+
+
+def _make_zero_gspmd_train_step(cfg: TransformerConfig, mesh: Mesh,
+                                global_batch: int, seed: int = 0,
+                                *, level: int = 1):
+    """ZeRO optimizer-state sharding on a general mesh (dp×tp, single
+    device, dcn hybrids) as a split program: the gradient computation
+    stays a GSPMD jit exactly like the replicated path (so grads are
+    bit-identical to it), and the optimizer update runs as a nested
+    shard_map (parallel/zero.make_zero_update) that slices each dp
+    rank's bucket shard of the mesh-local parameter blocks, updates it,
+    and all-gathers over dp alone. Gradient sync is compiler-managed
+    here, so levels 1 and 2 both shard only the slots."""
+    from distributed_tensorflow_tpu.cluster.topology import \
+        data_axes as mesh_data_axes
+    from distributed_tensorflow_tpu.parallel.zero import make_zero_update
+
+    del level  # grads are GSPMD-synced: levels differ only on pure dp
+    if cfg.mesh is None:
+        cfg = dataclasses.replace(cfg, mesh=mesh)
+    model = TransformerLM(cfg)
+    tx = make_optimizer(cfg)
+    rng = jax.random.PRNGKey(seed)
+    tokens_shape = jnp.zeros((global_batch, cfg.max_seq_len), jnp.int32)
+
+    shardings = state_shardings_for(model, tx, mesh, tokens_shape)
+    param_shardings = shardings["params"]
+    param_specs = jax.tree_util.tree_map(
+        lambda ns: ns.spec, param_shardings,
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+    replicated = NamedSharding(mesh, P())
+    rules = mesh_axis_rules(mesh)
+
+    def init_params(rng):
+        return model.init(rng, tokens_shape)["params"]
+
+    with mesh, nn_partitioning.axis_rules(rules):
+        params_abstract = jax.eval_shape(init_params, rng)
+        params = jax.jit(init_params,
+                         out_shardings=param_shardings)(rng)
+
+    opt_state, opt_shardings, zero_update = make_zero_update(
+        tx, mesh, param_specs, params_abstract, axis_name="dp")
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    state_shardings = {"params": param_shardings,
+                       "opt_state": opt_shardings, "step": replicated}
+
+    loss_fn = make_loss_fn(cfg, model)
+    data_axes = mesh_data_axes(mesh)
+    seq_axis = "sp" if "sp" in mesh.shape else None
+    batch_shardings = {"tokens": NamedSharding(
+        mesh, P(data_axes if data_axes else None, seq_axis))}
+
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"],
+                                                  batch["tokens"])
+        new_params, new_opt = zero_update(state["params"], grads,
+                                          state["opt_state"])
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1},
+                {"loss": loss})
+
+    with mesh, nn_partitioning.axis_rules(rules):
+        step_jit = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(state_shardings, replicated),
+            donate_argnums=safe_donate_argnums((0,)))
+
+    def wrapped_step(state, batch):
+        with mesh, nn_partitioning.axis_rules(rules):
+            return step_jit(state, batch)
+
+    return state, wrapped_step
+
+
 def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
                               global_batch: int, num_microbatches: int,
-                              seed: int = 0, schedule: str = "gpipe"):
+                              seed: int = 0, schedule: str = "gpipe",
+                              interleave: int = 2, zero: int = 0,
+                              offload_activations=False):
     """Pipeline parallelism for the flagship transformer over a dp×pp
     mesh (parallel/pipeline.py; the reference has NO pipeline
     parallelism — SURVEY.md §2.8 row PP). ``schedule`` picks "gpipe"
     (forward pipeline + autodiff reverse; bubble (S-1)/(M+S-1),
-    activation memory O(M)) or "1f1b" (interleaved
+    activation memory O(M)), "1f1b" (interleaved
     one-forward-one-backward with per-stage rematerialization; bubble
     2(S-1)/(M+2(S-1)) in the lockstep realization, activation memory
-    O(S) — see parallel/pipeline.py). Both schedules compute the same
-    objective; 1F1B is loss-parity-tested against GPipe.
+    O(S) — see parallel/pipeline.py), or "interleaved" (Megatron-style
+    virtual stages: each pp rank holds ``interleave`` non-adjacent
+    layer chunks, bubble (vW+W-2)/(Mv+vW+W-2) — below plain 1F1B for
+    v>=2). All schedules compute the same objective; 1F1B and
+    interleaved are loss-parity-tested against GPipe.
 
     - The scan-over-layers parameter stack (L, ...) regroups to
       (pp, L/pp, ...) with the stage axis sharded over "pp": each device
@@ -963,14 +1178,35 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
     - Embedding + final norm + logits run as plain GSPMD ops outside the
       shard_map (batch sharded over dp, replicated over pp).
 
+    ``offload_activations`` (1F1B only) re-realizes the schedule as a
+    host-driven cycle loop whose per-stage activation stash spills to
+    HOST memory between a microbatch's forward and backward
+    (parallel/offload.py): device activation residency drops from
+    O(min(M, 2S-1)) microbatches per rank to O(1). ``True`` spills
+    (async device->host copies through the ``offload.spill`` chaos
+    fault site); ``"device"`` runs the same host-driven loop with the
+    stash kept as device arrays — the two are bit-identical end to end
+    (the spill itself changes nothing), and vs the fused single-jit
+    schedule losses are bit-identical with params agreeing to float
+    tolerance (cross-program fusion artifact, see parallel/offload.py).
+
     Returns (state, step_fn) like make_sharded_train_step.
     """
     from distributed_tensorflow_tpu.parallel.pipeline import (
-        make_1f1b_fn, make_pipelined_fn)
+        make_1f1b_fn, make_interleaved_1f1b_fn, make_pipelined_fn)
 
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"schedule={schedule!r}; expected 'gpipe' or "
-                         f"'1f1b'")
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(f"schedule={schedule!r}; expected 'gpipe', "
+                         f"'1f1b', or 'interleaved'")
+    if offload_activations not in (False, True, "device"):
+        raise ValueError(f"offload_activations={offload_activations!r}; "
+                         f"expected False, True, or 'device'")
+    if offload_activations and schedule != "1f1b":
+        raise ValueError(
+            "offload_activations requires schedule='1f1b': GPipe keeps "
+            "O(M) activations alive inside autodiff (nothing discrete "
+            "to spill) and the interleaved stash ring is not yet "
+            "host-realized")
     if not cfg.scan_layers:
         raise ValueError("pipeline path requires scan_layers=True")
     if cfg.moe_experts > 0:
@@ -979,22 +1215,30 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
             "aux-loss 'losses' collection cannot escape the shard_map "
             "stage body — use make_sharded_train_step on a dp×ep mesh")
     n_stages = mesh.shape.get("pp", 1)
-    if cfg.n_layers % n_stages:
+    n_chunks = int(interleave) if schedule == "interleaved" else 1
+    if n_chunks < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if cfg.n_layers % (n_stages * n_chunks):
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
-                         f"pp={n_stages}")
+                         f"pp*interleave={n_stages * n_chunks}")
     if global_batch % num_microbatches:
         raise ValueError(f"global_batch={global_batch} not divisible by "
                          f"num_microbatches={num_microbatches}")
     mb = global_batch // num_microbatches
     n_dp = mesh.shape.get("dp", 1)
-    if schedule == "1f1b" and mb % n_dp:
-        # the 1F1B schedule runs the microbatch dim through shard_map,
+    if schedule in ("1f1b", "interleaved") and mb % n_dp:
+        # these schedules run the microbatch dim through shard_map,
         # which needs exact divisibility (GPipe's GSPMD constraint pads)
         raise ValueError(
-            f"schedule='1f1b' needs the microbatch size "
+            f"schedule={schedule!r} needs the microbatch size "
             f"(global_batch/num_microbatches = {mb}) divisible by "
             f"dp={n_dp}; raise global_batch or lower num_microbatches")
-    per_stage = cfg.n_layers // n_stages
+    if schedule == "interleaved" and num_microbatches % n_stages:
+        raise ValueError(
+            f"schedule='interleaved' needs num_microbatches "
+            f"({num_microbatches}) divisible by pp={n_stages} "
+            f"(microbatches flow in groups of pp per chunk)")
+    per_stage = cfg.n_layers // (n_stages * n_chunks)
     # One pipeline.schedule event per built step: the compiled schedule
     # is a single fused program, so the trace assembler renders its
     # analytic per-stage timeline (pipeline.schedule_spans) from this
@@ -1005,9 +1249,13 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
     _telemetry.event("pipeline.schedule", schedule=schedule,
                      n_stages=int(n_stages),
                      n_micro=int(num_microbatches),
+                     interleave=int(n_chunks),
+                     offload=bool(offload_activations),
                      bubble_fraction=round(_bubble(n_stages,
                                                    num_microbatches,
-                                                   schedule), 6))
+                                                   schedule,
+                                                   interleave=n_chunks),
+                                           6))
     # inside the shard_map region blocks run per-shard: no nested
     # sharding machinery, direct attention kernel
     cfg_local = dataclasses.replace(cfg, mesh=None)
@@ -1019,10 +1267,20 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
     params = model.init(rng, tokens_shape)["params"]
     params = params.unfreeze() if hasattr(params, "unfreeze") else dict(params)
 
-    # regroup the layer stack: (L, ...) -> (pp, L/pp, ...)
-    params["layers"] = jax.tree_util.tree_map(
-        lambda p: p.reshape(n_stages, per_stage, *p.shape[1:]),
-        params["layers"])
+    # regroup the layer stack: (L, ...) -> (pp, L/pp, ...); interleaved
+    # adds a chunk axis — (L, ...) -> (v, pp, L/(v*pp), ...) -> swap to
+    # (pp, v, ...) so model stage j*pp + k lands on worker k, chunk j
+    # (the NON-adjacent assignment the schedule requires).
+    if schedule == "interleaved":
+        params["layers"] = jax.tree_util.tree_map(
+            lambda p: jnp.swapaxes(
+                p.reshape(n_chunks, n_stages, per_stage, *p.shape[1:]),
+                0, 1),
+            params["layers"])
+    else:
+        params["layers"] = jax.tree_util.tree_map(
+            lambda p: p.reshape(n_stages, per_stage, *p.shape[1:]),
+            params["layers"])
 
     replicated = NamedSharding(mesh, P())
     stage_sharded = NamedSharding(mesh, P("pp"))
@@ -1035,10 +1293,28 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
                                     param_shardings)
 
     tx = make_optimizer(cfg)
-    opt_state = tx.init(params)
-    opt_shardings = _shard_like(
-        jax.eval_shape(lambda: opt_state),
-        jax.tree_util.tree_structure(params), param_shardings, replicated)
+    if zero:
+        if zero not in (1, 2):
+            raise ValueError(f"zero={zero!r}; expected 0, 1, or 2")
+        # ZeRO over dp composes with the pipeline: layer grads come out
+        # of the schedule already pmean'd over dp, so the sharded update
+        # slices — never re-reduces — them. The full replicated slot
+        # tree is never materialized.
+        from distributed_tensorflow_tpu.parallel.zero import (
+            make_zero_update)
+        param_specs = jax.tree_util.tree_map(
+            lambda ns: ns.spec, param_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        params_abstract = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
+        opt_state, opt_shardings, zero_update = make_zero_update(
+            tx, mesh, param_specs, params_abstract, axis_name="dp")
+    else:
+        opt_state = tx.init(params)
+        opt_shardings = _shard_like(
+            jax.eval_shape(lambda: opt_state),
+            jax.tree_util.tree_structure(params), param_shardings,
+            replicated)
     state = {"params": params, "opt_state": opt_state,
              "step": jnp.zeros((), jnp.int32)}
     state_shardings = {"params": param_shardings,
@@ -1058,7 +1334,7 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
     mb_spec = P(None, "dp" if "dp" in mesh.shape else None)
     norm = RMSNorm(cfg.dtype)
 
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "interleaved"):
         def head_fn(head_params, y_mb, tokens_mb):
             """Per-microbatch loss head on the last stage's output:
             final norm + tied-embedding logits + shifted CE."""
@@ -1068,9 +1344,74 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
                                 embed).astype(jnp.float32)
             return next_token_loss(logits, tokens_mb)
 
-        pipelined_1f1b = make_1f1b_fn(mesh, stage_fn, head_fn,
-                                      param_spec=P("pp"),
-                                      data_spec=mb_spec)
+        if offload_activations:
+            # host-driven realization: one jitted cycle program called
+            # C times with the stash routed through the host store, a
+            # jitted finalize, and a jitted optimizer apply. The step is
+            # NOT one fused jit — that is the point: the host sits on
+            # the spill path between forward and backward.
+            from distributed_tensorflow_tpu.parallel.offload import (
+                Offloaded1F1B)
+            runner = Offloaded1F1B(
+                mesh, stage_fn, head_fn, param_spec=P("pp"),
+                data_spec=mb_spec,
+                spill=offload_activations != "device")
+
+            def embed_lookup(embed, tokens):
+                x = embed.astype(cfg.dtype)[tokens]     # (B, S, D)
+                x = x.reshape(num_microbatches, mb, *x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, mb_spec))
+
+            embed_jit = jax.jit(embed_lookup)
+
+            if zero:
+                def apply_fn(params, grads, opt_state):
+                    return zero_update(params, grads, opt_state)
+            else:
+                def apply_fn(params, grads, opt_state):
+                    updates, opt_state = tx.update(grads, opt_state,
+                                                   params)
+                    return (optax.apply_updates(params, updates),
+                            opt_state)
+
+            apply_jit = jax.jit(
+                apply_fn, out_shardings=(param_shardings, opt_shardings))
+
+            def offload_step(state, batch):
+                with mesh:
+                    tokens = batch["tokens"]
+                    params = state["params"]
+                    x_mb, embed_vjp = jax.vjp(
+                        lambda e: embed_jit(e, tokens), params["embed"])
+                    t_mb = jax.device_put(
+                        tokens.reshape(num_microbatches, mb,
+                                       tokens.shape[1]),
+                        NamedSharding(mesh, mb_spec))
+                    head_params = {"final_norm": params["final_norm"],
+                                   "embed": params["embed"]}
+                    loss, g_layers, g_head, g_x = runner.value_and_grads(
+                        params["layers"], head_params, x_mb, t_mb)
+                    (g_embed_in,) = embed_vjp(g_x.astype(x_mb.dtype))
+                    grads = {"layers": g_layers,
+                             "final_norm": g_head["final_norm"],
+                             "embed": g_embed_in + g_head["embed"]}
+                    new_params, new_opt = apply_jit(
+                        params, grads, state["opt_state"])
+                    return ({"params": new_params, "opt_state": new_opt,
+                             "step": state["step"] + 1},
+                            {"loss": loss})
+
+            return state, offload_step
+
+        if schedule == "interleaved":
+            pipelined_1f1b = make_interleaved_1f1b_fn(
+                mesh, stage_fn, head_fn, n_chunks=n_chunks,
+                param_spec=P("pp"), data_spec=mb_spec)
+        else:
+            pipelined_1f1b = make_1f1b_fn(mesh, stage_fn, head_fn,
+                                          param_spec=P("pp"),
+                                          data_spec=mb_spec)
 
         def value_and_grads(params, tokens):
             def embed_lookup(embed):
@@ -1114,9 +1455,13 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh: Mesh,
 
     def train_step(state, batch):
         loss, grads = value_and_grads(state["params"], batch["tokens"])
-        updates, opt_state = tx.update(grads, state["opt_state"],
-                                       state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
+        if zero:
+            new_params, opt_state = zero_update(state["params"], grads,
+                                                state["opt_state"])
+        else:
+            updates, opt_state = tx.update(grads, state["opt_state"],
+                                           state["params"])
+            new_params = optax.apply_updates(state["params"], updates)
         return ({"params": new_params, "opt_state": opt_state,
                  "step": state["step"] + 1},
                 {"loss": loss})

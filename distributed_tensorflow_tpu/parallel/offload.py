@@ -1,0 +1,338 @@
+"""Host offload of the 1F1B activation stash.
+
+The fused 1F1B schedule (parallel/pipeline.py) keeps a
+min(M, 2S-1)-slot ring of stage inputs on DEVICE between a
+microbatch's forward and its backward. This module re-realizes the
+same schedule as a host-driven loop over cycles so that ring moves to
+HOST memory: one jitted cycle program (the cycle index is a traced
+scalar — a single compilation serves every cycle) emits each rank's
+stashed stage input, the host spills it (``copy_to_host_async`` — the
+copy overlaps the next cycle's dispatch, riding the same async-dispatch
+machinery the hybrid-mesh collectives use), and re-feeds it exactly
+2(S-1-k) cycles later when rank k's backward needs it. Device-side
+activation residency drops from O(min(M, 2S-1)) microbatches per rank
+to O(1): the current cycle's input and output.
+
+Schedule identities (same as the fused body): rank k runs forward
+f = c - k and backward b = c - (2S-2-k) at cycle c; the input of
+backward b at rank k was rank k's forward input at cycle b + k =
+c - 2(S-1-k). Rank S-1's spill round-trip would be same-cycle, so its
+backward reads its own forward input directly in-body and its rows
+never touch the store.
+
+The arithmetic inside the cycle program is the fused scan body's,
+accumulated in the same order (each per-cycle psum has exactly one
+non-zero contributor, and adding zeros is exact in IEEE float), and a
+device->host->device round trip preserves bits — so turning the spill
+on (host stash) vs off (device stash, ``spill=False``) is bit-identical
+end to end, which tests/test_offload.py pins. Against the FUSED
+single-jit 1F1B step the losses are bit-identical too, but final
+params agree only to float tolerance: the embed-grad scatter-add and
+optimizer fuse differently in one whole-step XLA program than in the
+split programs here (~1e-9 — the same program-structure artifact the
+ZeRO tests document; see parallel/zero.py).
+
+Failure surface: every spill passes the ``offload.spill`` chaos fault
+site (resilience/faults.py). A failed spill is retried once; a double
+failure is recorded and surfaces as :class:`OffloadSpillError` at the
+cycle that needs the lost activation — the consumer sees a clean,
+attributable error, never a hang or silently wrong activations
+(tools/chaos_sweep.py --offload gates this).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.resilience import faults
+
+
+class OffloadSpillError(RuntimeError):
+    """An activation spill failed (twice) and its consumer needed it."""
+
+
+class _FailedSpill:
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+class ActivationSpillStore:
+    """Host-side store of per-cycle activation stash entries.
+
+    ``put`` starts an async device->host copy and keeps the handle (the
+    host transfer overlaps subsequent device work; ``get`` materializes
+    it, by then usually complete). Entries older than the longest
+    consumer distance are dropped so host residency is O(S) entries.
+    """
+
+    def __init__(self, *, spill: bool = True):
+        self.spill = bool(spill)
+        self._entries: dict[int, object] = {}
+        self.puts = 0
+        self.retries = 0
+        self.failures = 0
+        self.spilled_bytes = 0
+
+    def put(self, cycle: int, value) -> None:
+        self.puts += 1
+        err: BaseException | None = None
+        for attempt in (0, 1):
+            try:
+                faults.fire("offload.spill", tag=f"c{cycle}")
+                if self.spill:
+                    value.copy_to_host_async()
+                if attempt:
+                    self.retries += 1
+                self._entries[cycle] = value
+                return
+            except Exception as e:  # FaultInjected or a real copy failure
+                err = e
+        self.failures += 1
+        self._entries[cycle] = _FailedSpill(err)
+
+    def get(self, cycle: int):
+        entry = self._entries.get(cycle)
+        if isinstance(entry, _FailedSpill):
+            raise OffloadSpillError(
+                f"activation stash entry for cycle {cycle} was lost: "
+                f"its spill failed twice") from entry.error
+        if entry is None:
+            raise OffloadSpillError(
+                f"activation stash entry for cycle {cycle} is missing "
+                f"(already dropped or never spilled)")
+        if self.spill:
+            arr = np.asarray(entry)
+            self.spilled_bytes += arr.nbytes
+            return arr
+        return entry
+
+    def drop_through(self, cycle: int) -> None:
+        """Free every entry with key <= cycle."""
+        for key in [k for k in self._entries if k <= cycle]:
+            del self._entries[key]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class Offloaded1F1B:
+    """Host-driven 1F1B with the activation stash spilled to host.
+
+    Same contract as :func:`parallel.pipeline.make_1f1b_fn`:
+    ``value_and_grads(stacked_params, head_params, x_microbatches,
+    targets_microbatches) -> (loss, stacked_param_grads, head_grads,
+    x_grads)``, with stage params stacked on a leading pp-sharded axis.
+    ``spill=False`` keeps the stash entries as device arrays (the host
+    loop and every compiled program are unchanged — only the residency
+    moves), which is the control arm of the on/off bit-identity test.
+    """
+
+    def __init__(self, mesh: Mesh, stage_fn: Callable, head_fn: Callable,
+                 *, axis_name: str = "pp",
+                 param_spec: P | None = None,
+                 data_spec: P | None = None,
+                 spill: bool = True):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.S = mesh.shape[axis_name]
+        self.stage_fn = stage_fn
+        self.head_fn = head_fn
+        self.param_spec = P(axis_name) if param_spec is None else param_spec
+        self.data_spec = P() if data_spec is None else data_spec
+        self.spill = bool(spill)
+        self.batch_axes = tuple(
+            a for a in jax.tree_util.tree_leaves(
+                tuple(self.data_spec),
+                is_leaf=lambda x: isinstance(x, str))
+            if isinstance(a, str) and a in mesh.shape)
+        # activation arrays (S|M, mb, ...) share the data_spec's
+        # microbatch-dim sharding behind their leading axis
+        rest = tuple(self.data_spec)[1:]
+        self.act_spec = P(axis_name, *rest)
+        self._cycle_jit = None
+        self._finalize_jit = None
+        self.last_stats: dict = {}
+
+    # -- compiled programs -------------------------------------------------
+
+    def _build(self):
+        S = self.S
+        axis_name = self.axis_name
+        stage_fn, head_fn = self.stage_fn, self.head_fn
+        batch_axes = self.batch_axes
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def cycle(params_local, head_params, x_mb, t_mb, carry,
+                  stash_in, c):
+            params_local = jax.tree_util.tree_map(
+                lambda p: jnp.squeeze(p, axis=0), params_local)
+            fwd_in, bwd_in, gparams, ghead, gx, loss_sum = carry
+            fwd_in = jnp.squeeze(fwd_in, axis=0)
+            bwd_in = jnp.squeeze(bwd_in, axis=0)
+            gparams = jax.tree_util.tree_map(
+                lambda g: jnp.squeeze(g, axis=0), gparams)
+            stash_loc = jnp.squeeze(stash_in, axis=0)
+            stage = jax.lax.axis_index(axis_name)
+            M = x_mb.shape[0]
+            x_dtype = x_mb.dtype
+            is_last = stage == S - 1
+
+            # forward sub-tick (identical to the fused body)
+            f = c - stage
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(f, 0, M - 1), axis=0, keepdims=False)
+            fwd_in = jnp.where(stage == 0, inject, fwd_in)
+            stash_out = fwd_in  # spilled by the host after this cycle
+            out = stage_fn(params_local, fwd_in)
+            next_fwd_in = jax.lax.ppermute(out, axis_name, perm_fwd)
+
+            # backward sub-tick: rank S-1's stash round-trip would be
+            # same-cycle, so it reads its own forward input directly
+            b = c - (2 * S - 2 - stage)
+            active_b = (b >= 0) & (b < M)
+            binp = jnp.where(is_last, fwd_in,
+                             stash_loc.astype(x_dtype))
+            out_b, stage_vjp = jax.vjp(stage_fn, params_local, binp)
+            tgt = jax.lax.dynamic_index_in_dim(
+                t_mb, jnp.clip(b, 0, M - 1), axis=0, keepdims=False)
+            loss_b, head_vjp = jax.vjp(
+                lambda hp, y: head_fn(hp, y, tgt), head_params, out_b)
+            dhead, dy = head_vjp(jnp.asarray(1.0 / M, loss_b.dtype))
+            g_out = jnp.where(is_last, dy, bwd_in)
+            g_out = jnp.where(active_b, g_out, jnp.zeros_like(g_out))
+            dparams, dx = stage_vjp(g_out)
+            gparams = jax.tree_util.tree_map(jnp.add, gparams, dparams)
+            take_head = is_last & active_b
+            # every psum below has exactly ONE non-zero contributor per
+            # cycle, so per-cycle reduction == the fused end-of-scan
+            # psum bit-for-bit (adding zeros is exact)
+            ghead = jax.tree_util.tree_map(
+                lambda a, d: a + jax.lax.psum(
+                    jnp.where(take_head, d, 0), axis_name), ghead, dhead)
+            loss_sum = loss_sum + jax.lax.psum(
+                jnp.where(take_head, loss_b.astype(jnp.float32), 0.0),
+                axis_name)
+            take_x = (stage == 0) & active_b
+            dx0 = jax.lax.psum(
+                jnp.where(take_x, dx, jnp.zeros_like(dx)), axis_name)
+            b0 = c - (2 * S - 2)
+            gx = jnp.where(
+                (b0 >= 0) & (b0 < M),
+                jax.lax.dynamic_update_index_in_dim(
+                    gx, dx0.astype(gx.dtype), jnp.clip(b0, 0, M - 1),
+                    axis=0),
+                gx)
+            next_bwd_in = jax.lax.ppermute(dx, axis_name, perm_bwd)
+
+            carry = (jnp.expand_dims(next_fwd_in, 0),
+                     jnp.expand_dims(next_bwd_in, 0),
+                     jax.tree_util.tree_map(
+                         lambda g: jnp.expand_dims(g, 0), gparams),
+                     ghead, gx, loss_sum)
+            return carry, jnp.expand_dims(stash_out, 0)
+
+        carry_specs = (self.act_spec, self.act_spec, self.param_spec,
+                       P(), self.data_spec, P())
+        cycle_sm = jax.shard_map(
+            cycle, mesh=self.mesh,
+            in_specs=(self.param_spec, P(), self.data_spec,
+                      self.data_spec, carry_specs, self.act_spec, P()),
+            out_specs=(carry_specs, self.act_spec),
+            check_vma=False)
+        self._cycle_jit = jax.jit(cycle_sm)
+
+        def finalize(carry):
+            _, _, gparams, ghead, gx, loss_sum = carry
+            loss = loss_sum / gx.shape[0]
+            if batch_axes:
+                loss = jax.lax.pmean(loss, batch_axes)
+                gparams = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, batch_axes), gparams)
+                ghead = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, batch_axes), ghead)
+                n_batch = 1
+                for a in batch_axes:
+                    n_batch *= jax.lax.psum(1, a)
+                gx = gx / n_batch
+            return loss, gparams, ghead, gx
+
+        finalize_sm = jax.shard_map(
+            finalize, mesh=self.mesh,
+            in_specs=(carry_specs,),
+            out_specs=(P(), self.param_spec, P(), self.data_spec),
+            check_vma=False)
+        self._finalize_jit = jax.jit(finalize_sm)
+
+    # -- host loop ---------------------------------------------------------
+
+    def value_and_grads(self, stacked_params, head_params, x_mb, t_mb):
+        from distributed_tensorflow_tpu import telemetry
+
+        if self._cycle_jit is None:
+            self._build()
+        S = self.S
+        M = x_mb.shape[0]
+        mb_shape = tuple(x_mb.shape[1:])
+        C = M + 2 * (S - 1)
+        dtype = x_mb.dtype
+        act_sharding = NamedSharding(self.mesh, self.act_spec)
+        carry = (
+            jax.device_put(jnp.zeros((S,) + mb_shape, dtype),
+                           act_sharding),
+            jax.device_put(jnp.zeros((S,) + mb_shape, dtype),
+                           act_sharding),
+            jax.tree_util.tree_map(jnp.zeros_like, stacked_params),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)),
+                head_params),
+            jax.device_put(
+                jnp.zeros((M,) + mb_shape, dtype),
+                NamedSharding(self.mesh, self.data_spec)),
+            jnp.zeros((), jnp.float32),
+        )
+        store = ActivationSpillStore(spill=self.spill)
+        for c in range(C):
+            stash_in = self._assemble(store, c, S, M, mb_shape, dtype)
+            carry, stash_out = self._cycle_jit(
+                stacked_params, head_params, x_mb, t_mb, carry,
+                stash_in, jnp.asarray(c, jnp.int32))
+            store.put(c, stash_out)
+            # entries older than the longest consumer distance are dead
+            store.drop_through(c - 2 * (S - 1))
+        loss, gparams, ghead, gx = self._finalize_jit(carry)
+        self.last_stats = {
+            "cycles": C, "puts": store.puts, "retries": store.retries,
+            "failures": store.failures,
+            "spilled_bytes": store.spilled_bytes,
+            "resident_entries": len(store)}
+        telemetry.event("offload.step", spill=self.spill,
+                        **self.last_stats)
+        return loss, gparams, ghead, gx
+
+    def _assemble(self, store: ActivationSpillStore, c: int, S: int,
+                  M: int, mb_shape: tuple, dtype):
+        """Stash rows each rank's backward reads at cycle c: rank k's
+        entry was written at cycle c - 2(S-1-k). Rank S-1 reads in-body
+        and its row stays zero."""
+        if self.spill:
+            rows = np.zeros((S,) + mb_shape, jnp.dtype(dtype).name)
+            for k in range(S - 1):
+                b = c - (2 * S - 2 - k)
+                if 0 <= b < M:
+                    rows[k] = store.get(c - 2 * (S - 1 - k))[k]
+            return rows
+        rows = jnp.zeros((S,) + mb_shape, dtype)
+        for k in range(S - 1):
+            b = c - (2 * S - 2 - k)
+            if 0 <= b < M:
+                entry = store.get(c - 2 * (S - 1 - k))
+                rows = rows.at[k].set(jnp.asarray(entry)[k])
+        return rows

@@ -28,6 +28,18 @@ Two schedules (pick via :func:`bubble_fraction` / the transformer's
   O(M) activation memory for O(S); on asynchronous hardware the same
   order realizes the classic (S-1)/(M+S-1) bubble with t_f-granular
   warmup.
+- **Interleaved 1F1B**
+  (:func:`pipeline_interleaved_1f1b_value_and_grad`): Megatron-style
+  virtual pipeline stages — each of the W workers holds v
+  NON-adjacent model chunks (worker k owns model stages k, W+k, …,
+  (v-1)W+k), so a microbatch crosses every worker v times and the
+  warmup/drain ramps shrink by ~1/v. In the lockstep realization the
+  schedule spans Mv + vW + W - 2 cycles — bubble fraction
+  (vW + W - 2)/(Mv + vW + W - 2), strictly below plain 1F1B's
+  2(W-1)/(M+2(W-1)) for v >= 2 — at the cost of v× more
+  stage-boundary traffic and a v-chunk parameter gather per cycle.
+  v=1 degenerates to plain 1F1B exactly. Requires M % W == 0
+  (microbatches flow in groups of W per chunk).
 """
 
 from __future__ import annotations
@@ -93,18 +105,155 @@ def pipeline_apply(stage_fn: Callable, params_local, x_microbatches,
 
 
 def bubble_fraction(n_stages: int, n_micro: int,
-                    schedule: str = "gpipe") -> float:
-    """Idle fraction of the pipeline schedule (docstring formulas)."""
+                    schedule: str = "gpipe", *,
+                    interleave: int = 1) -> float:
+    """Idle fraction of the pipeline schedule (docstring formulas).
+
+    ``n_stages`` counts WORKERS (pp ranks). For ``schedule=
+    "interleaved"`` each worker holds ``interleave`` virtual chunks, so
+    the model has ``n_stages * interleave`` stages total and the bubble
+    is (vW + W - 2)/(Mv + vW + W - 2) — strictly below plain 1F1B's for
+    v >= 2, equal at v=1.
+    """
     s, m = int(n_stages), int(n_micro)
+    v = int(interleave)
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {v}")
     if schedule == "gpipe":
         return (s - 1) / (m + s - 1)
     if schedule == "1f1b":
         return 2 * (s - 1) / (m + 2 * (s - 1))
+    if schedule == "interleaved":
+        return (v * s + s - 2) / (m * v + v * s + s - 2)
     raise ValueError(f"unknown schedule {schedule!r}")
 
 
+def schedule_table(n_stages: int, n_micro: int, schedule: str = "gpipe",
+                   *, interleave: int = 1) -> "list[dict]":
+    """Flat unit-of-work table of one pipeline step.
+
+    Each entry is ``{"worker", "cycle", "lane", "mb", "stage"}`` — one
+    microbatch's forward or backward of one MODEL stage on one worker at
+    one lockstep cycle. ``lane`` is ``"fwd"``, ``"bwd"``, or
+    ``"fwd+bwd"`` (GPipe's fused sweep, where the reverse schedule is
+    implicit under autodiff); ``stage`` is the model-stage index, which
+    equals the worker for non-interleaved schedules and ``chunk *
+    n_workers + worker`` for interleaved. Feed the result to
+    :func:`validate_schedule`; :func:`schedule_spans` renders the same
+    table as per-worker busy intervals.
+    """
+    s, m = int(n_stages), int(n_micro)
+    v = int(interleave)
+    if s < 1 or m < 1 or v < 1:
+        raise ValueError(
+            f"need n_stages>=1, n_micro>=1, interleave>=1, got {s}/{m}/{v}")
+    table: list[dict] = []
+    if schedule == "gpipe":
+        for k in range(s):
+            for j in range(m):
+                table.append({"worker": k, "cycle": j + k,
+                              "lane": "fwd+bwd", "mb": j, "stage": k})
+    elif schedule == "1f1b":
+        for k in range(s):
+            for j in range(m):
+                table.append({"worker": k, "cycle": j + k,
+                              "lane": "fwd", "mb": j, "stage": k})
+                table.append({"worker": k, "cycle": j + 2 * s - 2 - k,
+                              "lane": "bwd", "mb": j, "stage": k})
+    elif schedule == "interleaved":
+        if m % s != 0:
+            raise ValueError(
+                f"interleaved needs n_micro % n_workers == 0, got {m}/{s}")
+        w = s
+        for k in range(w):
+            for j in range(v):
+                for g in range(m // w):
+                    for r in range(w):
+                        mb = g * w + r
+                        table.append({
+                            "worker": k,
+                            "cycle": g * v * w + j * w + r + k,
+                            "lane": "fwd", "mb": mb, "stage": j * w + k})
+                        table.append({
+                            "worker": k,
+                            "cycle": (v * w - 1) + g * v * w
+                            + (v - 1 - j) * w + r + (w - 1 - k),
+                            "lane": "bwd", "mb": mb, "stage": j * w + k})
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return table
+
+
+def validate_schedule(table: "list[dict]") -> "list[str]":
+    """Physical-validity check of a :func:`schedule_table`.
+
+    Verifies (a) no worker runs two units in the same (cycle, lane) — a
+    ``fwd+bwd`` entry books both lanes; (b) every (microbatch, model
+    stage) runs exactly one forward, and exactly one backward when the
+    schedule has explicit backward entries; (c) dependencies — a
+    microbatch's forward of stage s+1 is strictly after its forward of
+    stage s, its backward of stage s strictly after its backward of
+    stage s+1, and the last stage's backward no earlier than its own
+    forward (same cycle allowed: the lockstep body writes the forward
+    then reads it in the backward sub-tick).
+
+    Returns human-readable violations; an empty list means valid.
+    """
+    problems: list[str] = []
+    if not table:
+        return ["empty schedule"]
+    booked: set = set()
+    for e in table:
+        lanes = ("fwd", "bwd") if e["lane"] == "fwd+bwd" else (e["lane"],)
+        for lane in lanes:
+            key = (e["worker"], e["cycle"], lane)
+            if key in booked:
+                problems.append(
+                    f"worker {e['worker']} double-booked: cycle "
+                    f"{e['cycle']} lane {lane}")
+            booked.add(key)
+    occ: dict = {}
+    for e in table:
+        lane = "fwd" if e["lane"] == "fwd+bwd" else e["lane"]
+        occ.setdefault((e["mb"], e["stage"], lane), []).append(e["cycle"])
+    n_stage = max(e["stage"] for e in table) + 1
+    mbs = sorted({e["mb"] for e in table})
+    has_bwd = any(e["lane"] == "bwd" for e in table)
+    for mb in mbs:
+        for st in range(n_stage):
+            fwd = occ.get((mb, st, "fwd"), [])
+            if len(fwd) != 1:
+                problems.append(
+                    f"mb {mb} stage {st}: {len(fwd)} fwd units (want 1)")
+                continue
+            if st > 0:
+                prev = occ.get((mb, st - 1, "fwd"), [])
+                if prev and fwd[0] < prev[0] + 1:
+                    problems.append(
+                        f"mb {mb}: fwd stage {st} at cycle {fwd[0]} not "
+                        f"after stage {st - 1} at {prev[0]}")
+            if not has_bwd:
+                continue
+            bwd = occ.get((mb, st, "bwd"), [])
+            if len(bwd) != 1:
+                problems.append(
+                    f"mb {mb} stage {st}: {len(bwd)} bwd units (want 1)")
+                continue
+            if st == n_stage - 1 and bwd[0] < fwd[0]:
+                problems.append(
+                    f"mb {mb}: last-stage bwd at cycle {bwd[0]} before "
+                    f"its fwd at {fwd[0]}")
+            nxt = occ.get((mb, st + 1, "bwd"), [])
+            if nxt and bwd[0] < nxt[0] + 1:
+                problems.append(
+                    f"mb {mb}: bwd stage {st} at cycle {bwd[0]} not "
+                    f"after stage {st + 1} at {nxt[0]}")
+    return problems
+
+
 def schedule_spans(n_stages: int, n_micro: int, schedule: str = "gpipe",
-                   *, t_cycle_s: float = 1.0) -> "list[list[dict]]":
+                   *, t_cycle_s: float = 1.0,
+                   interleave: int = 1) -> "list[list[dict]]":
     """Analytic per-stage busy spans of one pipeline step.
 
     The compiled schedule runs as ONE fused XLA program — individual
@@ -147,6 +296,15 @@ def schedule_spans(n_stages: int, n_micro: int, schedule: str = "gpipe",
                 if fwd or bwd:
                     busy(k, c, "fwd+bwd" if fwd and bwd
                          else "fwd" if fwd else "bwd")
+    elif schedule == "interleaved":
+        # rows index WORKERS; render from the unit-of-work table so the
+        # executable decode arithmetic and the drawn timeline share one
+        # source of truth.
+        cells: dict = {}
+        for e in schedule_table(s, m, "interleaved", interleave=interleave):
+            cells.setdefault((e["worker"], e["cycle"]), set()).add(e["lane"])
+        for (k, c), lanes in sorted(cells.items()):
+            busy(k, c, "fwd+bwd" if len(lanes) == 2 else next(iter(lanes)))
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
     return spans
@@ -297,6 +455,196 @@ def pipeline_1f1b_value_and_grad(stage_fn: Callable, head_fn: Callable,
             n_batch *= jax.lax.psum(1, a)
         gx = gx / n_batch
     return loss, gparams, ghead, gx
+
+
+def pipeline_interleaved_1f1b_value_and_grad(
+        stage_fn: Callable, head_fn: Callable, params_chunks, head_params,
+        x_microbatches, targets_microbatches, *, n_chunks: int,
+        axis_name: str = "pp", batch_axes: tuple = ()):
+    """Interleaved 1F1B (virtual pipeline stages): loss and grads in one
+    lockstep sweep. Must run inside a shard_map region binding
+    ``axis_name``.
+
+    Worker k of W holds ``n_chunks`` (= v) NON-adjacent model chunks on
+    the leading axis of ``params_chunks``: chunk j is model stage
+    ``j*W + k``, so a microbatch crosses every worker v times and the
+    warmup/drain ramps shrink by ~1/v. Same rings as plain 1F1B
+    (forward i->i+1, backward i->i-1) — chunk-boundary hops are the
+    same wrap-around hop plain 1F1B already makes, and the schedule
+    identities guarantee every wrapped value is either consumed exactly
+    one cycle later or masked (stage-0 injection on the forward ring,
+    head cotangent on the backward ring).
+
+    Cycle c decode (mixed radix, worker k): forward unit q = c - k ->
+    group g = q // (vW), chunk j = (q % vW) // W, offset r = q % W,
+    microbatch m = g*W + r of model stage j*W + k; backward unit
+    q' = c - (vW-1) - (W-1-k) with the chunk index mirrored
+    (j = v-1 - (q' % vW) // W). Stage inputs live in a
+    min(Mv, 2vW-1)-slot ring keyed by forward unit number. Requires
+    M % W == 0. Total cycles Mv + vW + W - 2 — bubble fraction
+    (vW + W - 2)/(Mv + vW + W - 2); v=1 degenerates to plain 1F1B
+    exactly (same cycles, same arithmetic).
+
+    Returns ``(loss, chunk_param_grads_local, head_param_grads,
+    x_microbatch_grads)`` — chunk grads keep the leading v axis,
+    per-worker (pp-sharded); everything else as in
+    :func:`pipeline_1f1b_value_and_grad`.
+    """
+    W = jax.lax.psum(1, axis_name)
+    k = jax.lax.axis_index(axis_name)
+    v = int(n_chunks)
+    if v < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {v}")
+    M = x_microbatches.shape[0]
+    if M % W != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs n_micro % n_workers == 0, "
+            f"got {M} % {W}")
+    mb_shape = x_microbatches.shape[1:]
+    S_tot = v * W
+    K = max(1, min(M * v, 2 * S_tot - 1))
+    C = M * v + S_tot + W - 2
+
+    perm_fwd = [(i, (i + 1) % W) for i in range(W)]
+    perm_bwd = [(i, (i - 1) % W) for i in range(W)]
+    x_dtype = x_microbatches.dtype
+
+    def chunk_params(j):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(
+                p, j, axis=0, keepdims=False), params_chunks)
+
+    def cycle(carry, c):
+        fwd_in, bwd_in, stash, gparams, ghead, gx, loss_sum = carry
+
+        # -- forward sub-tick -------------------------------------------
+        q = c - k
+        active_f = (q >= 0) & (q < M * v)
+        qc = jnp.clip(q, 0, M * v - 1)
+        j_f = (qc % S_tot) // W
+        m_f = (qc // S_tot) * W + qc % W
+        inject = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(m_f, 0, M - 1), axis=0, keepdims=False)
+        fwd_in = jnp.where((k == 0) & (j_f == 0), inject, fwd_in)
+        slot_f = jnp.where(active_f, qc % K, 0)
+        stash = jnp.where(
+            active_f,
+            jax.lax.dynamic_update_index_in_dim(
+                stash, fwd_in.astype(stash.dtype), slot_f, axis=0),
+            stash)
+        out = stage_fn(chunk_params(j_f), fwd_in)
+        next_fwd_in = jax.lax.ppermute(out, axis_name, perm_fwd)
+
+        # -- backward sub-tick ------------------------------------------
+        q2 = c - (S_tot - 1) - (W - 1 - k)
+        active_b = (q2 >= 0) & (q2 < M * v)
+        q2c = jnp.clip(q2, 0, M * v - 1)
+        j_b = (v - 1) - (q2c % S_tot) // W
+        m_b = (q2c // S_tot) * W + q2c % W
+        # forward unit that stashed this chunk's input
+        n_b = (q2c // S_tot) * S_tot + j_b * W + q2c % W
+        slot_b = jnp.where(active_b, n_b % K, 0)
+        binp = jax.lax.dynamic_index_in_dim(stash, slot_b, axis=0,
+                                            keepdims=False).astype(x_dtype)
+        out_b, stage_vjp = jax.vjp(stage_fn, chunk_params(j_b), binp)
+        tgt = jax.lax.dynamic_index_in_dim(
+            targets_microbatches, jnp.clip(m_b, 0, M - 1), axis=0,
+            keepdims=False)
+        loss_b, head_vjp = jax.vjp(
+            lambda hp, y: head_fn(hp, y, tgt), head_params, out_b)
+        dhead, dy = head_vjp(jnp.asarray(1.0 / M, loss_b.dtype))
+        is_head = (k == W - 1) & (j_b == v - 1)
+        g_out = jnp.where(is_head, dy, bwd_in)
+        g_out = jnp.where(active_b, g_out, jnp.zeros_like(g_out))
+        dparams, dx = stage_vjp(g_out)
+        gparams = jax.tree_util.tree_map(
+            lambda a, d: a.at[jnp.clip(j_b, 0, v - 1)].add(d),
+            gparams, dparams)
+        take_head = is_head & active_b
+        ghead = jax.tree_util.tree_map(
+            lambda a, d: a + jnp.where(take_head, d, 0), ghead, dhead)
+        loss_sum = loss_sum + jnp.where(
+            take_head, loss_b.astype(jnp.float32), 0.0)
+        take_x = (k == 0) & (j_b == 0) & active_b
+        gx = jnp.where(
+            take_x,
+            jax.lax.dynamic_update_index_in_dim(
+                gx, dx.astype(gx.dtype), jnp.clip(m_b, 0, M - 1), axis=0),
+            gx)
+        next_bwd_in = jax.lax.ppermute(dx, axis_name, perm_bwd)
+
+        return (next_fwd_in, next_bwd_in, stash, gparams, ghead, gx,
+                loss_sum), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, x_dtype),                        # fwd_in
+        jnp.zeros(mb_shape, x_dtype),                        # bwd_in
+        jnp.zeros((K,) + mb_shape, x_dtype),                 # stash
+        jax.tree_util.tree_map(jnp.zeros_like, params_chunks),
+        jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)),
+            head_params),
+        jnp.zeros((M,) + mb_shape, x_dtype),                 # gx
+        jnp.zeros((), jnp.float32),                          # loss_sum
+    )
+    (_, _, _, gparams, ghead, gx, loss_sum), _ = jax.lax.scan(
+        cycle, carry0, jnp.arange(C))
+
+    loss = jax.lax.psum(loss_sum, axis_name) / M
+    ghead = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), ghead)
+    gx = jax.lax.psum(gx, axis_name)
+    if batch_axes:
+        loss = jax.lax.pmean(loss, batch_axes)
+        gparams = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, batch_axes), gparams)
+        ghead = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, batch_axes), ghead)
+        n_batch = 1
+        for a in batch_axes:
+            n_batch *= jax.lax.psum(1, a)
+        gx = gx / n_batch
+    return loss, gparams, ghead, gx
+
+
+def make_interleaved_1f1b_fn(mesh: Mesh, stage_fn: Callable,
+                             head_fn: Callable, *, n_chunks: int,
+                             axis_name: str = "pp",
+                             param_spec: P | None = None,
+                             data_spec: P | None = None):
+    """shard_map wrapper for
+    :func:`pipeline_interleaved_1f1b_value_and_grad`. Stacked params
+    carry axes ``(n_workers, n_chunks, ...)`` with the leading worker
+    axis sharded over ``axis_name``; grads come back in the same
+    layout."""
+    if param_spec is None:
+        param_spec = P(axis_name)
+    if data_spec is None:
+        data_spec = P()
+    batch_axes = tuple(
+        a for a in jax.tree_util.tree_leaves(
+            tuple(data_spec), is_leaf=lambda x: isinstance(x, str))
+        if isinstance(a, str) and a in mesh.shape)
+
+    def run(stacked_params, head_params, x_mb, targets_mb):
+        def inner(params_local, head_params, x_local, t_local):
+            params_local = jax.tree_util.tree_map(
+                lambda p: jnp.squeeze(p, axis=0), params_local)
+            loss, gp, gh, gx = pipeline_interleaved_1f1b_value_and_grad(
+                stage_fn, head_fn, params_local, head_params,
+                x_local, t_local, n_chunks=n_chunks, axis_name=axis_name,
+                batch_axes=batch_axes)
+            gp = jax.tree_util.tree_map(
+                lambda g: jnp.expand_dims(g, axis=0), gp)
+            return loss, gp, gh, gx
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(param_spec, P(), data_spec, data_spec),
+            out_specs=(P(), param_spec, P(), data_spec),
+            check_vma=False)(stacked_params, head_params, x_mb, targets_mb)
+
+    return run
 
 
 def make_1f1b_fn(mesh: Mesh, stage_fn: Callable, head_fn: Callable, *,

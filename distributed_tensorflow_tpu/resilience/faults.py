@@ -68,6 +68,15 @@ Instrumented sites:
                           ``delay`` stalls it past the lease budget;
                           either must end in the dispatcher re-issuing
                           the lease and an exactly-once epoch
+``offload.spill``         parallel/offload.ActivationSpillStore.put,
+                          once per spilled 1F1B cycle (tag=``c<cycle>``)
+                          — a ``raise`` fails the device->host
+                          activation copy; the store retries once, and
+                          a double failure must surface as a clean
+                          ``OffloadSpillError`` on the cycle that needs
+                          the lost stash entry — never a hang, never
+                          silently wrong activations
+                          (tools/chaos_sweep.py --offload)
 ========================  ====================================================
 
 Determinism: hit counters are kept per ``(site, tag)`` **and** per site

@@ -328,3 +328,27 @@ def test_strategy_gradient_bucketer_defaults(devices):
                       data_axis_names=("dcn", "dp"))
     hb = hybrid.gradient_bucketer()
     assert hb.outer_axis == "dcn" and hb.inner_axis == "dp"
+
+
+def test_reduce_scatter_mean_bitwise_equals_pmean_slice(mesh8):
+    """ZeRO-2's gradient sync claim: reduce-scattering a packed bucket
+    (psum_scatter + /N) hands each rank exactly the bits pmean-then-
+    slice of the same buffer would — so ZeRO-2 grads ARE the replicated
+    grads' own shards (parallel/zero.py relies on this)."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(8, 96)), jnp.float32)
+
+    def body(v):
+        v = v[0]
+        shard = coll.reduce_scatter(v, "dp", axis=0, op=ReduceOp.MEAN)
+        n = jax.lax.psum(1, "dp")
+        r = jax.lax.axis_index("dp")
+        ref = jax.lax.dynamic_slice_in_dim(
+            jax.lax.pmean(v, "dp"), r * (v.shape[0] // n),
+            v.shape[0] // n)
+        return shard[None], ref[None]
+
+    got, ref = jax.jit(jax.shard_map(
+        body, mesh=mesh8, in_specs=P("dp"),
+        out_specs=(P("dp"), P("dp")), check_vma=False))(x)
+    assert np.array_equal(np.asarray(got), np.asarray(ref))

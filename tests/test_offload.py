@@ -1,0 +1,140 @@
+"""Host-offloaded 1F1B activation stash (parallel/offload.py).
+
+Claims pinned here: spilling the stash to host vs keeping it on device
+is bit-identical end to end (the spill path moves bytes, never changes
+them); the host-driven realization matches the fused single-jit 1F1B
+step loss-for-loss from identical params (params drift only at the
+cross-program fusion artifact, ~1e-9 — see parallel/zero.py for the
+same phenomenon); a failed spill retries once and a double failure
+surfaces as a clean ``OffloadSpillError`` on the consumer — never a
+hang, never silently wrong activations.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.cluster.topology import make_mesh
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, make_pipelined_train_step, synthetic_tokens)
+from distributed_tensorflow_tpu.parallel.offload import (
+    ActivationSpillStore, OffloadSpillError)
+from distributed_tensorflow_tpu.resilience import faults
+
+CFG = TransformerConfig.tiny(n_layers=4)
+GB, M = 8, 4
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return synthetic_tokens(GB, CFG.max_seq_len, CFG.vocab_size, seed=3)
+
+
+@pytest.fixture(scope="module")
+def spill_runner(devices):
+    """One offloading step builder reused across tests (fault injection
+    acts at runtime, so the same compiled programs serve every case)."""
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    state, step = make_pipelined_train_step(
+        CFG, mesh, GB, M, schedule="1f1b", offload_activations=True)
+    return mesh, state, step
+
+
+def _run(state, step, tokens, n=2):
+    losses = []
+    for _ in range(n):
+        state, m = step(state, {"tokens": tokens})
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def _leaves_equal(pa, pb):
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False
+    return True
+
+
+def test_offload_on_off_bit_identical(spill_runner, tokens, devices):
+    """spill=True (host stash) vs 'device' (device stash, same host-
+    driven loop) after 2 steps: every param leaf bit-identical."""
+    mesh, state0, step = spill_runner
+    s_spill, l_spill = _run(state0, step, tokens)
+    state_d, step_d = make_pipelined_train_step(
+        CFG, mesh, GB, M, schedule="1f1b", offload_activations="device")
+    s_dev, l_dev = _run(state_d, step_d, tokens)
+    assert l_spill == l_dev
+    assert _leaves_equal(s_spill["params"], s_dev["params"])
+
+
+def test_offload_matches_fused_1f1b(spill_runner, tokens, devices):
+    """vs the fused single-jit 1F1B step: first-step loss bit-identical
+    (identical params in, same schedule arithmetic), params allclose."""
+    mesh, state0, step = spill_runner
+    s_off, l_off = _run(state0, step, tokens)
+    state_f, step_f = make_pipelined_train_step(
+        CFG, mesh, GB, M, schedule="1f1b")
+    s_fused, l_fused = _run(state_f, step_f, tokens)
+    assert l_off[0] == l_fused[0]
+    np.testing.assert_allclose(l_off, l_fused, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s_off["params"]),
+                    jax.tree_util.tree_leaves(s_fused["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_offload_spill_fault_retries_bit_identical(spill_runner, tokens,
+                                                   devices):
+    """A single injected spill failure is absorbed by the retry: the
+    run's params are bit-identical to the fault-free run."""
+    mesh, state0, step = spill_runner
+    base, _ = _run(state0, step, tokens)
+    sched = faults.FaultSchedule(seed=7, rules=(
+        faults.FaultRule(site="offload.spill", tag="c3", hits=(1,),
+                         max_fires=1),))
+    with faults.inject(sched) as reg:
+        faulted, _ = _run(state0, step, tokens)
+    assert any(e[0] == "offload.spill" for e in reg.events())
+    assert _leaves_equal(base["params"], faulted["params"])
+
+
+def test_offload_double_spill_failure_raises_cleanly(spill_runner,
+                                                     tokens, devices):
+    """Both attempts failing surfaces OffloadSpillError at the cycle
+    that needed the lost entry — a clean consumer-side error."""
+    mesh, state0, step = spill_runner
+    sched = faults.FaultSchedule(seed=7, rules=(
+        faults.FaultRule(site="offload.spill", tag="c3", hits=(1, 2),
+                         max_fires=2),))
+    with faults.inject(sched):
+        with pytest.raises(OffloadSpillError, match="cycle 3"):
+            _run(state0, step, tokens, n=1)
+
+
+def test_spill_store_unit():
+    class FakeArr:
+        def __init__(self, v):
+            self.v = v
+
+        def copy_to_host_async(self):
+            pass
+
+        def __array__(self, dtype=None):
+            return np.asarray(self.v, dtype=dtype)
+
+    store = ActivationSpillStore(spill=True)
+    store.put(0, FakeArr([1.0, 2.0]))
+    assert np.array_equal(store.get(0), [1.0, 2.0])
+    store.drop_through(0)
+    with pytest.raises(OffloadSpillError, match="missing"):
+        store.get(0)
+
+
+def test_offload_invalid_combinations(devices):
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    for kw in ({"schedule": "gpipe", "offload_activations": True},
+               {"schedule": "interleaved", "offload_activations": True},
+               {"schedule": "1f1b", "offload_activations": "bogus"}):
+        with pytest.raises(ValueError):
+            make_pipelined_train_step(CFG, mesh, GB, M, **kw)

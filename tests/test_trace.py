@@ -421,6 +421,62 @@ def test_bench_trend_regression_gate(tmp_path, capsys):
     assert "skipped round r05" in capsys.readouterr().out
 
 
+def _write_scaling_round(repo, n, rows, era=None):
+    payload = {"bench": "scaling", "rows": rows}
+    if era is not None:
+        payload["timing_era"] = era
+    with open(os.path.join(repo, f"SCALING_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def test_bench_trend_scaling_eras_and_memfrontier_floor(tmp_path,
+                                                        capsys):
+    """ISSUE 18 trend semantics: raw scaling throughput only gates
+    within one host-speed ``timing_era`` (PR 14's no-cross-host rule
+    applied across rounds), while the memfrontier max-trainable-params
+    FLOOR and the inverted step-time-tax series gate across all
+    rounds — a shrinking frontier or a growing tax fails regardless of
+    which box measured it."""
+    import tools.bench_trend as bt
+    repo = str(tmp_path)
+
+    def tput(v):
+        return {"workload": "transformer", "metric": "tokens_per_sec",
+                "devices": 8, "throughput": v, "efficiency_pct": 100.0}
+
+    def mf(params, mult):
+        return {"workload": "memfrontier",
+                "metric": "max_trainable_params", "devices": 8,
+                "technique": "zero2", "max_trainable_params": params,
+                "step_time_mult": mult, "steps_ok": True}
+
+    # era-less fast box, then a slower era: -60% throughput passes
+    # because the rounds are not comparable bases for each other
+    _write_scaling_round(repo, 1, [tput(1000.0), mf(100, 1.0)])
+    _write_scaling_round(repo, 2, [tput(400.0), mf(100, 1.0)],
+                         era="slowbox")
+    assert bt.main(["--repo", repo, "--check"]) == 0
+    capsys.readouterr()
+    # same era: -50% throughput now fails
+    _write_scaling_round(repo, 3, [tput(200.0), mf(100, 1.0)],
+                         era="slowbox")
+    assert bt.main(["--repo", repo, "--check"]) == 1
+    assert "transformer" in capsys.readouterr().err
+    # the param floor is era-free: a cross-era shrink still fails ...
+    _write_scaling_round(repo, 3, [tput(400.0), mf(60, 1.0)],
+                         era="otherbox")
+    assert bt.main(["--repo", repo, "--check"]) == 1
+    assert "memfrontier" in capsys.readouterr().err
+    # ... and so does a growing step-time tax (inverted series)
+    _write_scaling_round(repo, 3, [tput(400.0), mf(100, 2.0)],
+                         era="otherbox")
+    assert bt.main(["--repo", repo, "--check"]) == 1
+    assert "memfrontier_mult" in capsys.readouterr().err
+    _write_scaling_round(repo, 3, [tput(390.0), mf(110, 0.95)],
+                         era="slowbox")
+    assert bt.main(["--repo", repo, "--check"]) == 0
+
+
 # ---------------------------------------------------------------------------
 # profiler <-> telemetry step correlation (satellite)
 # ---------------------------------------------------------------------------

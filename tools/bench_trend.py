@@ -19,6 +19,18 @@ Series:
   (value + mfu/step-time extras when present);
 - ``scaling/<workload>/<metric>/dev<NN>[/sched]`` — every row of each
   ``SCALING_r*.json`` keyed like tools/scaling_sweep.py's row_key;
+  interleaved rows (ISSUE 18) add an inverted
+  ``.../measured_bubble`` series (a pipeline bubble that grows fails);
+  memory-frontier rows key as
+  ``scaling/memfrontier/<technique>/dev<NN>`` gating
+  ``max_trainable_params`` as a FLOOR plus an inverted
+  ``scaling/memfrontier_mult/<technique>/dev<NN>`` step-time-tax
+  series — both absent-tolerant for r01–r06 files that predate them;
+  raw-throughput scaling values regression-gate only within the same
+  ``timing_era`` (a field the capture stamps; bumped when the host
+  measurably changes speed — the PR 14 "timing bases never cross
+  runs or hosts" rule applied across rounds), while same-run ratios
+  and param floors stay era-free and gate across all rounds;
 - ``serving/<metric>/<point>`` + ``serving/p50_latency_ms/<point>`` /
   ``serving/p99_latency_ms/<point>`` — the ``SERVING_r*.json``
   request-level rows (tools/serve_sweep.py); the latency series gate
@@ -131,7 +143,38 @@ def load_scaling_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
                 data = json.load(f)
         except (OSError, ValueError):
             continue
+        # host-speed era (PR 14 rule): raw-throughput values only
+        # regression-gate against rounds captured in the SAME era —
+        # r06-era rounds (no field) never gate an r07-era value. Same-
+        # run ratios (bubbles, taxes, param floors) stay era-free.
+        era = data.get("timing_era")
         for row in data.get("rows", []):
+            # memory-frontier rows (ISSUE 18) carry no throughput: the
+            # gated value is the max trainable param count itself (a
+            # floor — shrinking the frontier regresses) plus the
+            # per-technique step-time tax, inverted (a technique whose
+            # tax GROWS >10% fails). Historical r01–r06 files have no
+            # memfrontier rows, so the series just starts at the first
+            # round that carries them (absent-tolerant).
+            if row.get("workload") == "memfrontier":
+                tech = row.get("technique") or "unknown"
+                key = f"dev{row.get('devices'):02d}"
+                if isinstance(row.get("max_trainable_params"),
+                              (int, float)):
+                    series.setdefault(
+                        f"scaling/memfrontier/{tech}/{key}", {})[rnd] = {
+                        "value": row["max_trainable_params"],
+                        "d_model": row.get("d_model"),
+                        "params_vs_replicated":
+                            row.get("params_vs_replicated"),
+                    }
+                if isinstance(row.get("step_time_mult"), (int, float)):
+                    series.setdefault(
+                        f"scaling/memfrontier_mult/{tech}/{key}",
+                        {})[rnd] = {
+                        "value": row["step_time_mult"],
+                        "lower_is_better": True}
+                continue
             key = (f"scaling/{row.get('workload')}/{row.get('metric')}"
                    f"/dev{row.get('devices'):02d}")
             if row.get("schedule"):
@@ -140,7 +183,14 @@ def load_scaling_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
                 "value": row.get("throughput"),
                 "efficiency_pct": row.get("efficiency_pct"),
                 "overlap_eff": row.get("overlap_eff"),
+                "timing_era": era,
             }
+            # interleaved rows (ISSUE 18): the measured bubble is its
+            # own inverted series — a schedule whose bubble grows fails
+            if isinstance(row.get("measured_bubble"), (int, float)):
+                series.setdefault(f"{key}/measured_bubble", {})[rnd] = {
+                    "value": row["measured_bubble"],
+                    "lower_is_better": True}
     return series
 
 
@@ -406,8 +456,14 @@ def check_regressions(series: "dict[str, dict[int, dict]]",
         ordered = sorted(rounds)
         latest = ordered[-1]
         latest_v = rounds[latest].get("value")
+        # absolute-timing series carry a host-speed era: only rounds
+        # captured in the latest round's era are comparable bases
+        # (series without the field — ratios, floors, non-scaling
+        # benches — compare across all rounds as before)
+        latest_era = rounds[latest].get("timing_era")
         prior = {r: rounds[r].get("value") for r in ordered[:-1]
-                 if isinstance(rounds[r].get("value"), (int, float))}
+                 if isinstance(rounds[r].get("value"), (int, float))
+                 and rounds[r].get("timing_era") == latest_era}
         if not prior or not isinstance(latest_v, (int, float)):
             continue
         lower_better = any(rounds[r].get("lower_is_better")

@@ -106,6 +106,18 @@ the delta-snapshot publish path (``delta.publish`` raise + corrupt):
 pre-commit failures must be retry-safe and post-commit tears must be
 caught by crc with the longest intact chain served bit-identically.
 
+``--offload`` sweeps the ACTIVATION-SPILL axis (ISSUE 18): each seed
+runs a fresh 2-device 1F1B pipeline with host-offloaded activations
+(``offload_activations=True``) in a subprocess and injects seeded
+faults into the ``offload.spill`` site at a seed-chosen cycle. Leg 1:
+a SINGLE spill failure must be absorbed by the store's retry with the
+run's params bit-identical to the fault-free run (the retry re-copies
+the same device buffer — no recompute, no drift). Leg 2: a DOUBLE
+failure on the same cycle must surface as a clean ``OffloadSpillError``
+on the cycle that consumes the lost stash entry — never a hang (the
+subprocess is killed on timeout and the seed fails), never silently
+wrong activations.
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -123,6 +135,7 @@ Usage::
     python tools/chaos_sweep.py --serve --disagg --seeds 3  # disagg
     python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
     python tools/chaos_sweep.py --rollout --seeds 3   # live-rollout sweep
+    python tools/chaos_sweep.py --offload --seeds 3   # activation-spill sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -1097,6 +1110,99 @@ def run_rollout_seed(seed: int, *, replicas: int, duration: float,
     return ok, dt
 
 
+# Child body for --offload: must live in its own process so the
+# 2-virtual-device XLA flag is set before jax initializes. Prints
+# OFFLOAD-OK / OFFLOAD-FAIL lines; exit code is the verdict.
+_OFFLOAD_CHILD = r"""
+import sys
+
+import numpy as np
+import jax
+
+from distributed_tensorflow_tpu.cluster.topology import make_mesh
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig, make_pipelined_train_step, synthetic_tokens)
+from distributed_tensorflow_tpu.parallel.offload import OffloadSpillError
+from distributed_tensorflow_tpu.resilience import faults
+
+seed = int(sys.argv[1])
+cfg = TransformerConfig.tiny(n_layers=4)
+mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+tokens = synthetic_tokens(8, cfg.max_seq_len, cfg.vocab_size, seed=3)
+state0, step = make_pipelined_train_step(
+    cfg, mesh, 8, 4, schedule="1f1b", offload_activations=True)
+# S=2, M=4 -> 6 cycles; only cycles 0..M-1 write stash entries a later
+# cycle consumes (the tail entries are warmup garbage nobody reads), so
+# the seeded target must land there for the double failure to surface
+rng = np.random.default_rng(seed)
+target = int(rng.integers(0, 4))
+batch = {"tokens": tokens}
+base, _ = step(state0, batch)
+
+sched = faults.FaultSchedule(seed=seed, rules=(
+    faults.FaultRule(site="offload.spill", tag=f"c{target}",
+                     hits=(1,), max_fires=1),))
+with faults.inject(sched) as reg:
+    retried, _ = step(state0, batch)
+if not any(e[0] == "offload.spill" for e in reg.events()):
+    print("OFFLOAD-FAIL: single-spill fault never fired")
+    sys.exit(1)
+for a, b in zip(jax.tree_util.tree_leaves(base["params"]),
+                jax.tree_util.tree_leaves(retried["params"])):
+    if not np.array_equal(np.asarray(a), np.asarray(b)):
+        print("OFFLOAD-FAIL: params diverged after the retried spill "
+              "(retry must be a byte-for-byte re-copy)")
+        sys.exit(1)
+print(f"OFFLOAD-OK: single spill failure at c{target} absorbed "
+      f"bit-identically")
+
+sched = faults.FaultSchedule(seed=seed, rules=(
+    faults.FaultRule(site="offload.spill", tag=f"c{target}",
+                     hits=(1, 2), max_fires=2),))
+try:
+    with faults.inject(sched):
+        step(state0, batch)
+except OffloadSpillError as e:
+    print(f"OFFLOAD-OK: double spill failure surfaced cleanly: {e}")
+    sys.exit(0)
+print("OFFLOAD-FAIL: double spill failure did NOT raise "
+      "OffloadSpillError")
+sys.exit(1)
+"""
+
+
+def run_offload_seed(seed: int, *, timeout_s: float = 600.0) \
+        -> tuple[bool, float]:
+    """One activation-spill chaos seed (module docstring, --offload):
+    retry-absorption and clean-double-failure legs in a 2-virtual-
+    device subprocess; a hung consumer fails via the timeout."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _OFFLOAD_CHILD, str(seed)],
+            cwd=REPO, env=env, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        ok = proc.returncode == 0
+        out = proc.stdout.decode(errors="replace")
+    except subprocess.TimeoutExpired as e:
+        ok = False
+        out = ((e.stdout or b"").decode(errors="replace")
+               + f"\nOFFLOAD-FAIL: HUNG (> {timeout_s:.0f}s) — a lost "
+                 f"stash entry must error, not stall the consumer")
+    for line in out.splitlines():
+        if line.startswith("OFFLOAD-"):
+            print(f"    seed {seed}: {line}")
+    if not ok:
+        tail = out.splitlines()[-15:]
+        print(f"--- seed {seed} FAILED ---")
+        print("\n".join(tail))
+    return ok, time.monotonic() - t0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -1149,6 +1255,14 @@ def main(argv=None) -> int:
                          "delta-publish faults; zero-dropped, "
                          "no-mixed-version, priced-transition and "
                          "chain-honesty gates")
+    ap.add_argument("--offload", action="store_true",
+                    help="sweep seeded faults on the offload.spill "
+                         "site of the host-offloaded 1F1B activation "
+                         "stash: a single spill failure must be "
+                         "retry-absorbed bit-identically, a double "
+                         "failure must raise a clean OffloadSpillError "
+                         "on the consuming cycle (never hang, never "
+                         "silently wrong activations)")
     ap.add_argument("--duration", type=float, default=18.0,
                     help="--rollout: serving duration per run (s)")
     ap.add_argument("--events", type=int, default=480,
@@ -1204,13 +1318,15 @@ def main(argv=None) -> int:
     if args.shrink and args.workers < 2:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
-                             args.spike, args.online,
-                             args.rollout)) > 1:
-        ap.error("--kill, --serve, --data, --spike, --online and "
-                 "--rollout are separate sweep axes")
+                             args.spike, args.online, args.rollout,
+                             args.offload)) > 1:
+        ap.error("--kill, --serve, --data, --spike, --online, "
+                 "--rollout and --offload are separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.rollout:
+        if args.offload:
+            ok, dt = run_offload_seed(s)
+        elif args.rollout:
             ok, dt = run_rollout_seed(s, replicas=args.workers,
                                       duration=args.duration,
                                       keep_dirs=args.keep_dirs)
