@@ -2311,6 +2311,122 @@ def run_rollout(out_path: str | None = None, *, seed: int = 0,
     return rows
 
 
+def run_day(out_path: str | None = None, *, seed: int = 0,
+            keep_dir: bool = False, domain_spread: bool = True):
+    """Production-day scorecard bench (ISSUE 19): one seeded
+    compressed diurnal day through a supervisor-run shared fleet
+    (testing/day_sim.py — night / ramp / peak / flash spike / rack loss
+    at peak / night), scored purely from the run's own event logs by
+    telemetry/audit.audit_day:
+
+    - ``day_goodput_frac`` — the whole day's fleet goodput, identity
+      (``wall == goodput + Σ badput``) gated to ±1% first;
+    - ``day_rack_mttr_s`` — whole-rack kill → reformed generation
+      start (inverted by tools/bench_trend.py);
+    - ``day_max_slo_budget_consumed`` — the worst SLO's budget spend,
+      every bad record itemized by attributed cause (inverted);
+    - ``day_unattributed_frac`` — the share of bad records matching NO
+      cause window (inverted; >5% fails the audit outright: some
+      subsystem degraded service without logging why).
+
+    The per-phase goodput cut, the per-cause budget table, and the
+    rack-loss restore tiers ride in ``extra``. The audit gates
+    (identity, unattributed cap, warm host/peer rack restore, zero
+    drops) must pass or the bench emits nothing — a day that cannot be
+    explained is not a result. Thread-backed sim: runs in-process."""
+    import tempfile
+
+    from distributed_tensorflow_tpu.telemetry import (
+        audit as tv_audit, events as tv_events)
+    from distributed_tensorflow_tpu.testing.day_sim import DaySim
+
+    run_dir = tempfile.mkdtemp(prefix="bench_day_")
+    sim = DaySim(seed=seed, logdir=run_dir,
+                 domain_spread=domain_spread)
+    result = sim.run()
+    if result["error"] is not None:
+        print(f"day: supervisor error: {result['error']} "
+              f"(run dir kept: {run_dir})", file=sys.stderr)
+        return []
+    audit = tv_audit.audit_day(tv_events.read_run(run_dir))
+    fails = tv_audit.check_audit(
+        audit, require_warm_restore=domain_spread,
+        goodput_floor=0.5)
+    if fails:
+        for f in fails:
+            print(f"day: AUDIT GATE FAILED: {f}", file=sys.stderr)
+        print(f"day: run dir kept: {run_dir}", file=sys.stderr)
+        return []
+    if not domain_spread:
+        # the negative control: show what the warm-restore gate (not
+        # applied above — this mode exists to demonstrate the failure)
+        # says about the blind-ring restore
+        for f in tv_audit.check_audit(audit, require_warm_restore=True):
+            print(f"day: [no-domain-spread] warm gate would fail: {f}",
+                  file=sys.stderr)
+    led = audit["ledger"]
+    rack = audit["rack_loss"] or {}
+    worst = max((res["budget_consumed"]
+                 for res in audit["slos"].values()), default=None)
+    extra = {
+        "seed": seed,
+        "domain_spread": domain_spread,
+        "identity_error_frac": led["identity_error_frac"],
+        "badput_s": led["badput_s"],
+        "phases": [{k: ph.get(k) for k in
+                    ("phase", "dur_s", "rate_rps", "wall_s",
+                     "goodput_frac")}
+                   for ph in audit["phases"]],
+        "slo_by_cause": {
+            name: {"budget_consumed": res["budget_consumed"],
+                   "bad": res["bad"],
+                   "by_cause": {c: v["bad"] for c, v in
+                                res["by_cause"].items() if v["bad"]},
+                   "unattributed": res["unattributed"]["bad"]}
+            for name, res in audit["slos"].items()},
+        "rack": {"domain": rack.get("domain"),
+                 "victims": rack.get("victims"),
+                 "restore_tiers": rack.get("restore_tiers"),
+                 "warm": rack.get("warm")},
+        "requests": audit["requests"],
+        "generations": result["generations"],
+        "scales_applied": result["scales_applied"],
+    }
+    rows = []
+    for metric, value, unit in (
+            ("day_goodput_frac", led["goodput_frac"], "frac"),
+            ("day_rack_mttr_s", rack.get("mttr_s"), "s"),
+            ("day_max_slo_budget_consumed", worst, "x"),
+            ("day_unattributed_frac",
+             audit["max_unattributed_frac"], "frac")):
+        if not isinstance(value, (int, float)):
+            print(f"day: no measurement for {metric} "
+                  f"(run dir kept: {run_dir})", file=sys.stderr)
+            keep_dir = True
+            continue
+        row = {"metric": metric, "value": value, "unit": unit,
+               "vs_baseline": None, "extra": extra}
+        rows.append(row)
+        print(json.dumps(row))
+    from distributed_tensorflow_tpu import telemetry
+    telemetry.event("day.row", seed=seed,
+                    goodput_frac=led["goodput_frac"],
+                    rack_mttr_s=rack.get("mttr_s"),
+                    max_slo_budget=worst,
+                    unattributed_frac=audit["max_unattributed_frac"],
+                    restore_tiers=rack.get("restore_tiers"))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "day",
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": rows}, f, indent=1)
+            f.write("\n")
+    if not keep_dir:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return rows
+
+
 def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -2432,7 +2548,7 @@ if __name__ == "__main__":
                         choices=["all", "transformer", "resnet50", "bert",
                                  "input_pipeline", "scaling", "serving",
                                  "fleet", "data_service", "autoscale",
-                                 "online", "rollout"],
+                                 "online", "rollout", "day"],
                         help="'all' (the driver default) emits resnet50, "
                              "bert, and input_pipeline rows, then the "
                              "transformer headline last; single names "
@@ -2481,6 +2597,19 @@ if __name__ == "__main__":
                              "training+serving fleet: scale-up "
                              "latency, SLO recovery, goodput through "
                              "the transition)")
+    parser.add_argument("--day", action="store_true",
+                        help="run the production-day scorecard bench "
+                             "(seeded compressed diurnal curve with a "
+                             "flash spike and a whole-rack loss at "
+                             "peak; goodput identity, cause-itemized "
+                             "SLO budget spend, rack-loss MTTR + "
+                             "restore tier — all audited from logs)")
+    parser.add_argument("--no-domain-spread", action="store_true",
+                        help="with --day: revert the peer-snapshot "
+                             "ring to placement-blind (the rack kill "
+                             "then takes an owner AND its replica; "
+                             "the warm-restore audit gate fails — "
+                             "the negative control)")
     parser.add_argument("--rollout", action="store_true",
                         help="run the live-rollout bench (hot-swap vs "
                              "restart-adoption publish->servable "
@@ -2536,6 +2665,9 @@ if __name__ == "__main__":
         run_autoscale(out_path=args.out, seed=args.seed)
     elif args.rollout or args.workload == "rollout":
         run_rollout(out_path=args.out, seed=args.seed)
+    elif args.day or args.workload == "day":
+        run_day(out_path=args.out, seed=args.seed,
+                domain_spread=not args.no_domain_spread)
     elif args.online or args.workload == "online":
         run_online(out_path=args.out, seed=args.seed,
                    total_events=args.events or 6144)

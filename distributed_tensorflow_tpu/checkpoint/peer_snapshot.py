@@ -147,6 +147,54 @@ def ring_replicator(pid: int, world: int) -> int:
     return (pid - 1) % world
 
 
+def assign_replicators(world: int,
+                       domains: "Mapping[int, object] | None" = None
+                       ) -> "dict[int, int]":
+    """{owner: replicator} — the placement policy of the replica ring.
+
+    Without ``domains`` this is the historical blind ring
+    (``replicator = (owner - 1) % world``) byte for byte. With a
+    ``{pid: failure_domain}`` map, every owner's replicator is placed
+    OUTSIDE the owner's failure domain whenever any other domain has a
+    member — so a whole-domain loss (rack power, ToR switch) can never
+    take a snapshot and its only replica together, which is exactly
+    what the blind ring lets happen when adjacent pids share a rack.
+    Replicas are spread by load (fewest replicas held, lowest pid to
+    break ties), so one replicator may hold several owners' replicas
+    when domains are unequal — deterministic for a given (world,
+    domains), and every participant computes the identical assignment
+    with no extra coordination.
+    """
+    if world < 2:
+        return {}
+    if not domains:
+        return {o: (o - 1) % world for o in range(world)}
+    dom = {p: str(domains[p]) if p in domains else f"__solo{p}"
+           for p in range(world)}
+    load = {p: 0 for p in range(world)}
+    out: "dict[int, int]" = {}
+    for owner in range(world):
+        cands = [p for p in range(world)
+                 if p != owner and dom[p] != dom[owner]]
+        if not cands:                     # single-domain fleet: any
+            cands = [p for p in range(world) if p != owner]  # peer
+        pick = min(cands, key=lambda p: (load[p], p))
+        out[owner] = pick
+        load[pick] += 1
+    return out
+
+
+def replica_sources(pid: int, world: int,
+                    domains: "Mapping[int, object] | None" = None
+                    ) -> "tuple[int, ...]":
+    """The owners whose snapshots ``pid`` must replicate under
+    :func:`assign_replicators` (the inverse map; possibly several, or
+    none, when domains are unequal)."""
+    return tuple(sorted(o for o, r in
+                        assign_replicators(world, domains).items()
+                        if r == pid))
+
+
 class SnapshotStore:
     """Bounded retention of host snapshots (own + peer replicas).
 
@@ -240,14 +288,18 @@ class SnapshotStore:
 # ---------------------------------------------------------------------------
 
 def exchange(store: SnapshotStore, snap: HostSnapshot, agent, *,
-             timeout_s: float = 60.0) -> bool:
+             timeout_s: float = 60.0,
+             domains: "Mapping[int, object] | None" = None) -> bool:
     """Collective ring replication for one snapshot step: publish this
     worker's packed snapshot under a per-(step, worker) KV key and store
-    a replica of the ring source's. Every worker snapshots the same
-    steps (the save cadence is deterministic), so the blocking fetch is
-    a near-lockstep rendezvous. A missing peer (died mid-run) degrades
+    a replica of every owner :func:`assign_replicators` assigned to this
+    worker (exactly the ring source without ``domains``; with a domain
+    map, replicas are placed across failure domains — possibly several
+    owners, possibly none). Every worker snapshots the same steps (the
+    save cadence is deterministic), so the blocking fetches are a
+    near-lockstep rendezvous. A missing peer (died mid-run) degrades
     to no-replica-update — the supervisor will reform shortly anyway.
-    Returns True when the replica was stored.
+    Returns True when every assigned replica was stored.
     """
     if not getattr(agent, "is_distributed", False) or agent.num_processes < 2:
         return False
@@ -255,17 +307,19 @@ def exchange(store: SnapshotStore, snap: HostSnapshot, agent, *,
     faults.fire("peer.exchange", tag=str(pid), exc=OSError,
                 msg=f"injected peer-exchange failure (worker {pid})")
     _kv_put_blob(agent, f"peer_snap/s{snap.step}/w{pid}", pack(snap))
-    src = ring_source(pid, world)
-    try:
-        data = _kv_get_blob(agent, f"peer_snap/s{snap.step}/w{src}",
-                            timeout_s=timeout_s)
-    except Exception:
-        return False              # peer dead/slow: replica skipped
-    try:
-        store.put(unpack(data))
-    except (ValueError, KeyError):
-        return False              # torn/alien payload: replica skipped
-    return True
+    ok = False
+    for src in replica_sources(pid, world, domains):
+        try:
+            data = _kv_get_blob(agent, f"peer_snap/s{snap.step}/w{src}",
+                                timeout_s=timeout_s)
+        except Exception:
+            return False          # peer dead/slow: replica skipped
+        try:
+            store.put(unpack(data))
+        except (ValueError, KeyError):
+            return False          # torn/alien payload: replica skipped
+        ok = True
+    return ok
 
 
 # ---------------------------------------------------------------------------

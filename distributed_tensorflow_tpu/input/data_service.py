@@ -214,12 +214,21 @@ class DataServiceDispatcher:
 
     def __init__(self, agent, provider: SplitProvider,
                  cfg: DataServiceConfig, *, num_workers: int,
-                 epochs: int = 1, reg=None):
+                 epochs: int = 1, reg=None,
+                 domains: "dict[int, str] | None" = None):
         self.agent = agent
         self.provider = provider
         self.cfg = cfg
         self.num_workers = int(num_workers)
         self.epochs = int(epochs)
+        #: optional {worker_id: failure_domain} placement map: leases
+        #: are spread across domains (least-loaded domain first, then
+        #: least-loaded worker within it) and a dead worker's lease is
+        #: re-issued OUTSIDE its domain when any other domain has a
+        #: live member — a rack loss then stalls only that rack's
+        #: in-flight splits, never a whole epoch's worth piled on one
+        #: survivor rack.
+        self.domains = dict(domains) if domains else None
         self.reader = _hb.ShardedKVHeartbeats(
             agent, shard_size=cfg.hb_shard_size,
             summary_stale_s=cfg.lease_timeout_s,
@@ -317,13 +326,19 @@ class DataServiceDispatcher:
         for split, worker in sorted(self._leases.items()):
             if worker in live_set or split in self._done:
                 continue
-            new = self._least_loaded(live)
+            # a lease lost to a (likely whole-domain) failure is
+            # re-placed outside the dead worker's domain when possible:
+            # if the rest of that rack is about to be declared dead
+            # too, re-issuing into it would just re-lose the lease
+            new = self._least_loaded(live, avoid_domain=self._domain_of(worker))
             self._leases[split] = new
             self.splits_reassigned += 1
             self._m_reassigned.increment()
             _events.event("data.reassign", job=self.cfg.job,
                           epoch=self.epoch, split=split,
-                          from_worker=worker, to_worker=new)
+                          from_worker=worker, to_worker=new,
+                          from_domain=self._domain_of(worker),
+                          to_domain=self._domain_of(new))
 
     def _assign_unleased(self, live: "list[int]"):
         for split in self.provider.epoch_order(self.epoch):
@@ -331,12 +346,38 @@ class DataServiceDispatcher:
                 continue
             self._leases[split] = self._least_loaded(live)
 
-    def _least_loaded(self, live: "list[int]") -> int:
+    def _domain_of(self, worker: int) -> "str | None":
+        if not self.domains:
+            return None
+        return self.domains.get(worker)
+
+    def _least_loaded(self, live: "list[int]", *,
+                      avoid_domain: "str | None" = None) -> int:
         load = {w: 0 for w in live}
         for w in self._leases.values():
             if w in load:
                 load[w] += 1
-        return min(sorted(load), key=lambda w: load[w])
+        cands = sorted(load)
+        if self.domains:
+            if avoid_domain is not None:
+                outside = [w for w in cands
+                           if self._dom_key(w) != avoid_domain]
+                if outside:
+                    cands = outside
+            dom_load: "dict[str, int]" = {}
+            for w in cands:
+                d = self._dom_key(w)
+                dom_load[d] = dom_load.get(d, 0) + load[w]
+            best = min(sorted(dom_load), key=lambda d: dom_load[d])
+            cands = [w for w in cands if self._dom_key(w) == best]
+        return min(cands, key=lambda w: load[w])
+
+    def _dom_key(self, worker: int) -> str:
+        """Placement key of a worker: its mapped domain, or a singleton
+        pseudo-domain when unmapped (an unmapped worker never blocks
+        domain spreading, never aliases another worker's domain)."""
+        d = (self.domains or {}).get(worker)
+        return d if d is not None else f"__w{worker}"
 
     def _publish_assignments(self):
         by_worker: "dict[int, list]" = {}

@@ -69,6 +69,76 @@ _SIGKILL = 9
 #: supervisor stall detail: "no heartbeat for X.Xs (budget Ys)"
 _STALL_RE = re.compile(r"no heartbeat for ([0-9.]+)s \(budget ([0-9.]+)")
 
+#: Per-task env var naming the failure domain (rack) the simulated
+#: worker is placed in — the placement fact placement-aware layers
+#: (peer-snapshot ring, data-service leases) consume.
+ENV_FAILURE_DOMAIN = "DTX_FAILURE_DOMAIN"
+
+
+class DomainTopology:
+    """pid → failure domain (rack/host) mapping of a simulated fleet.
+
+    Contiguous block placement — ``rack = pid // workers_per_domain`` —
+    deliberately mirrors how real schedulers pack consecutive task ids
+    onto the same rack, which is exactly the placement that makes the
+    blind ``(pid - 1) % N`` replica ring lose data under a rack kill
+    (adjacent pids share a domain, so an owner and its replicator die
+    together). The last domain may be short when ``num_workers`` is not
+    a multiple of ``workers_per_domain``.
+    """
+
+    def __init__(self, num_workers: int, *, workers_per_domain: int = 4,
+                 prefix: str = "rack"):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        if workers_per_domain < 1:
+            raise ValueError(f"workers_per_domain must be >= 1, got "
+                             f"{workers_per_domain}")
+        self.num_workers = int(num_workers)
+        self.workers_per_domain = int(workers_per_domain)
+        self.prefix = prefix
+
+    @property
+    def num_domains(self) -> int:
+        return -(-self.num_workers // self.workers_per_domain)
+
+    def domain_of(self, pid: int) -> str:
+        if not 0 <= pid < self.num_workers:
+            raise ValueError(f"pid {pid} outside fleet of "
+                             f"{self.num_workers}")
+        return f"{self.prefix}{pid // self.workers_per_domain}"
+
+    @property
+    def domains(self) -> "list[str]":
+        return [f"{self.prefix}{d}" for d in range(self.num_domains)]
+
+    def members(self, domain: str) -> "list[int]":
+        return [p for p in range(self.num_workers)
+                if self.domain_of(p) == domain]
+
+    def as_map(self) -> "dict[int, str]":
+        """{pid: domain} — the wire/placement-policy shape
+        (checkpoint/peer_snapshot.assign_replicators, the data-service
+        dispatcher's ``domains=``)."""
+        return {p: self.domain_of(p) for p in range(self.num_workers)}
+
+    def shrink(self, num_workers: int) -> "DomainTopology":
+        """The same placement over a resized fleet (elastic scale keeps
+        machines where they are; slots beyond the new size vanish)."""
+        return DomainTopology(num_workers,
+                              workers_per_domain=self.workers_per_domain,
+                              prefix=self.prefix)
+
+
+@dataclasses.dataclass(frozen=True)
+class DomainKill:
+    """One correlated failure: every worker of ``domain`` dies at once,
+    ``after_s`` seconds into the run."""
+
+    domain: str
+    victims: tuple
+    after_s: float
+
 
 class _SimKilled(BaseException):
     """Raised inside a worker thread whose task was terminated (it is a
@@ -185,6 +255,12 @@ class SimTaskContext:
         except ValueError:
             return 0
 
+    @property
+    def domain(self) -> "str | None":
+        """The failure domain (rack) this task is placed in, when the
+        runner was given a :class:`DomainTopology`."""
+        return self.env.get(ENV_FAILURE_DOMAIN)
+
     def check_kill(self):
         if self._kill.is_set():
             raise _SimKilled()
@@ -234,7 +310,8 @@ class SimRunner:
     def __init__(self, fn: Callable, cluster_spec, *, args=(),
                  kwargs=None, env=None, devices_per_process=1,
                  timeout: float = 300.0, agent_factory=None,
-                 on_generation=None):
+                 on_generation=None,
+                 topology: "DomainTopology | None" = None):
         del devices_per_process
         self._fn = fn
         self._spec = {k: list(v) for k, v in cluster_spec.items()}
@@ -245,6 +322,9 @@ class SimRunner:
         self._agent_factory = agent_factory or (
             lambda pid, n: SimAgent(coordination._LocalService(), pid, n))
         self._on_generation = on_generation
+        #: failure-domain placement of this generation's tasks; each
+        #: task sees its own domain in ``env[ENV_FAILURE_DOMAIN]``
+        self.topology = topology
         self._tasks: dict[tuple[str, int], _SimTask] = {}
         self._task_env: dict[tuple[str, int], dict] = {}
         self.history: list[mpr.TaskResult] = []
@@ -265,7 +345,10 @@ class SimRunner:
         n = self.num_tasks
         agent = self._agent_factory(key[1], n)
         self.agents.append(agent)
-        ctx = SimTaskContext(pid=key[1], num_workers=n, env=dict(env),
+        env = dict(env)
+        if self.topology is not None and key[1] < self.topology.num_workers:
+            env[ENV_FAILURE_DOMAIN] = self.topology.domain_of(key[1])
+        ctx = SimTaskContext(pid=key[1], num_workers=n, env=env,
                              agent=agent, _kill=task.kill)
         prev_stack = None
         with contextlib.suppress(ValueError, RuntimeError):
@@ -324,6 +407,11 @@ class SimRunner:
                     (t, len(v)) for t, v in self._spec.items()):
                 raise ValueError("reform must keep the cluster shape")
             self._spec = new
+            if self.topology is not None:
+                # elastic resize keeps machines where they are: the
+                # same block placement over the new worker count
+                self.topology = self.topology.shrink(
+                    len(self._spec.get("worker", [])) or 1)
         self._tasks.clear()
         merged_env = dict(self._env)
         merged_env.update(env or {})
@@ -346,6 +434,22 @@ class SimRunner:
         t = self._tasks[(task_type, task_id)]
         t.kill.set()
         t.mark_exit(-_SIGKILL)
+
+    def terminate_domain(self, domain: str) -> "list[int]":
+        """Correlated kill: every live worker placed in ``domain`` exits
+        AT ONCE (all exits marked before any thread gets a chance to
+        run — the supervisor observes one simultaneous multi-worker
+        failure, not a cascade). Returns the task ids killed."""
+        if self.topology is None:
+            raise ValueError("terminate_domain needs a topology")
+        killed = []
+        for pid in self.topology.members(domain):
+            t = self._tasks.get(("worker", pid))
+            if t is not None and t.exitcode is None:
+                t.kill.set()
+                t.mark_exit(-_SIGKILL)
+                killed.append(pid)
+        return killed
 
     def terminate_all(self):
         for t in self._tasks.values():
@@ -427,6 +531,29 @@ def seeded_fleet_schedule(seed: int, num_workers: int, *,
         else:
             raise ValueError(f"unknown fleet fault kind {kind!r}")
     return faults.FaultSchedule(rules=tuple(rules), seed=seed)
+
+
+def seeded_domain_kill_plan(seed: int, topology: DomainTopology, *,
+                            kills: int = 1,
+                            after_range: "tuple[float, float]" = (0.5, 1.5),
+                            eligible: "tuple | list | None" = None
+                            ) -> "list[DomainKill]":
+    """Seed-derived CORRELATED failures: each kill takes a whole
+    failure domain down at once (a rack loses power: every worker in
+    it exits together — the failure mode the placement policy exists
+    for, which independent per-worker kill plans can never produce).
+    Victim domains and kill instants are a pure function of the seed
+    (the resilience/faults.py string-seeded discipline); ``eligible``
+    restricts the candidate domains (e.g. racks that hold trainers)."""
+    rng = random.Random(f"dtx-domain-kill:{seed}")
+    cands = list(eligible) if eligible is not None else topology.domains
+    if not cands:
+        return []
+    victims = rng.sample(cands, k=min(kills, len(cands)))
+    return [DomainKill(domain=d,
+                       victims=tuple(topology.members(d)),
+                       after_s=round(rng.uniform(*after_range), 3))
+            for d in victims]
 
 
 # ---------------------------------------------------------------------------
@@ -947,7 +1074,9 @@ class DataServiceSim:
                  hb_shard_size: int = 32, seed: int = 0,
                  consumer_batch: int = 0,
                  consumer_step_s: float = 0.0,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 topology: "DomainTopology | None" = None):
+        self.topology = topology
         self.num_workers = num_workers
         self.num_splits = num_splits
         self.epochs = epochs
@@ -1050,7 +1179,9 @@ class DataServiceSim:
             with elastic.generation_override(self.generation):
                 disp = self._ds.DataServiceDispatcher(
                     self._agent(n), self.provider, self.cfg,
-                    num_workers=n, epochs=self.epochs)
+                    num_workers=n, epochs=self.epochs,
+                    domains=(self.topology.as_map()
+                             if self.topology is not None else None))
                 disp_holder["disp"] = disp
                 while not stop.is_set():
                     try:

@@ -355,3 +355,86 @@ def test_fetch_wait_priced_as_infeed_badput(tmp_path):
     walked = goodput.ledger_from_run(str(run_dir))
     assert abs(walked["identity_error_s"]) <= 0.01 * walked["wall_s"]
     assert walked["badput_s"]["infeed_wait"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Domain-aware lease placement (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_DOMS = {0: "r0", 1: "r0", 2: "r1", 3: "r1"}
+
+
+def _dispatcher(tmp_path, domains):
+    provider, _ = _file_provider(tmp_path)
+    cfg = dsvc.DataServiceConfig(job=f"dom{bool(domains)}")
+    agent = fleet_sim.SimAgent(coordination._LocalService(), 0, 1)
+    return dsvc.DataServiceDispatcher(agent, provider, cfg,
+                                      num_workers=4, domains=domains)
+
+
+def test_dispatcher_spreads_leases_across_domains(tmp_path):
+    disp = _dispatcher(tmp_path, _DOMS)
+    live = [0, 1, 2, 3]
+    picks = []
+    for split in range(4):
+        w = disp._least_loaded(live)
+        picks.append(w)
+        disp._leases[split] = w
+    # least-loaded DOMAIN first, then least-loaded worker within it:
+    # the racks alternate instead of filling r0 first
+    assert picks == [0, 2, 1, 3]
+    by_dom = {}
+    for w in picks:
+        by_dom[_DOMS[w]] = by_dom.get(_DOMS[w], 0) + 1
+    assert by_dom == {"r0": 2, "r1": 2}
+
+
+def test_dispatcher_blind_placement_packs_by_worker(tmp_path):
+    disp = _dispatcher(tmp_path, None)
+    live = [0, 1, 2, 3]
+    picks = []
+    for split in range(4):
+        w = disp._least_loaded(live)
+        picks.append(w)
+        disp._leases[split] = w
+    assert picks == [0, 1, 2, 3]             # historical tie-break
+
+
+def test_dispatcher_reissues_outside_dead_workers_domain(tmp_path):
+    disp = _dispatcher(tmp_path, _DOMS)
+    disp._leases = {0: 0, 1: 1}              # both leases on rack r0
+    # worker 0 died; its rackmate 1 is (for now) still heartbeating —
+    # the re-issue must jump the rack, not pile onto the survivor that
+    # is probably about to be declared dead too
+    disp._reissue_stale(live=[1, 2, 3])
+    assert disp._leases[1] == 1              # live lease untouched
+    assert disp._leases[0] in (2, 3)
+    assert _DOMS[disp._leases[0]] == "r1"
+    assert disp.splits_reassigned == 1
+
+
+def test_dispatcher_reissue_falls_back_inside_domain_when_alone(tmp_path):
+    disp = _dispatcher(tmp_path, _DOMS)
+    disp._leases = {0: 0}
+    disp._reissue_stale(live=[1])            # only the rackmate left
+    assert disp._leases[0] == 1              # degrade, don't stall
+
+
+def test_exactly_once_with_domain_topology_and_rack_mate_kill():
+    """The full service under a domain topology: a worker death inside
+    a rack still delivers every element exactly once, with the lease
+    table spread by the placement policy."""
+    schedule = faults.FaultSchedule(rules=(
+        faults.FaultRule(site="data.worker_step", action="raise",
+                         tag="1", hits=(1,)),), seed=5)
+    sim = fleet_sim.DataServiceSim(
+        _N_WORKERS, _N_SPLITS, epochs=_EPOCHS, elements_per_split=3,
+        lease_timeout_s=0.3, fault_schedule=schedule, seed=5,
+        topology=fleet_sim.DomainTopology(_N_WORKERS,
+                                          workers_per_domain=2))
+    rep = sim.run()
+    assert rep.completed, rep.error
+    assert rep.workers_died == [1]
+    assert rep.splits_reassigned >= 1
+    assert rep.duplicate_elements == 0 and rep.missing_elements == 0
+    assert rep.epoch_multisets == [sim.expected_multiset()] * _EPOCHS

@@ -1,0 +1,200 @@
+"""Cause-itemized production-day audit (ISSUE 19): phase timeline,
+attribution windows, SLO budget itemization, CI gates, and the slow
+end-to-end day scenario (domain spread passes; the blind ring fails
+the warm-restore gate)."""
+
+import pytest
+
+from distributed_tensorflow_tpu.telemetry import audit
+from distributed_tensorflow_tpu.telemetry import slo as tv_slo
+
+
+def _ev(name, wall, **fields):
+    return dict(fields, ev=name, wall=wall)
+
+
+def _day_events():
+    """A hand-built day: night -> spike -> peak_2, rack kill at 2.2,
+    recovery at 2.35, and ten completion records with one bad record
+    per attribution bucket (spike, recovery, replay, unattributed)."""
+    driver = [
+        _ev("day.phase", 0.0, phase="night", rate_rps=40.0),
+        _ev("day.phase", 1.0, phase="spike", rate_rps=1400.0),
+        _ev("day.phase", 2.0, phase="peak_2", rate_rps=250.0),
+        _ev("day.rack_kill", 2.2, domain="rack2", victims=[4, 5]),
+        _ev("day.load", 2.9, generated=10),
+        _ev("day.end", 3.0),
+    ]
+    trainer = [
+        _ev("recovery.worker_death", 2.21, task_id=4),
+        _ev("recovery.generation_start", 2.35, generation=2),
+        _ev("recovery.restore_tier", 2.4, tier="peer", step=8),
+    ]
+    records = [
+        # night, bad, outside every window -> unattributed
+        _ev("serve.request", 0.5, dur_s=0.3),
+        # spike, bad -> spike_overload
+        _ev("serve.request", 1.5, dur_s=0.3),
+        # inside the recovery window (which also lies inside the
+        # spike's drain) -> recovery wins on priority
+        _ev("serve.request", 2.5, dur_s=0.3),
+        # record-level evidence beats every window
+        _ev("serve.request", 2.5, dur_s=0.3, replayed_tokens=5),
+    ] + [_ev("serve.request", 0.1 + 0.05 * i, dur_s=0.01)
+         for i in range(6)]
+    return {"driver": driver, 4: trainer, 0: records}
+
+
+def _slo():
+    return tv_slo.SLO("lat", "latency", objective=0.9, threshold_s=0.1)
+
+
+def test_phase_spans_close_on_next_marker_and_day_end():
+    spans = audit.phase_spans(_day_events())
+    assert [s["phase"] for s in spans] == ["night", "spike", "peak_2"]
+    assert [(s["start"], s["end"]) for s in spans] == \
+        [(0.0, 1.0), (1.0, 2.0), (2.0, 3.0)]
+    assert spans[0]["rate_rps"] == 40.0
+
+
+def test_cause_windows_from_control_plane_events():
+    ws = audit.cause_windows(_day_events())
+    # recovery: kill/death onset backdated, closed at the next
+    # generation_start plus drain (both onsets merge into one window)
+    assert len(ws["recovery"]) == 1
+    lo, hi = ws["recovery"][0]
+    assert lo == pytest.approx(2.2 - 0.25)
+    assert hi == pytest.approx(2.35 + 1.0)
+    # spike phase extended by the drain margin
+    assert ws["spike_overload"] == [(1.0, pytest.approx(2.0 + 2.0))]
+    assert ws["scale_transition"] == []
+
+
+def test_attribute_priority_and_unattributed():
+    ws = audit.cause_windows(_day_events())
+    assert audit.attribute({"wall": 0.5, "latency_s": 0.3}, ws) is None
+    assert audit.attribute({"wall": 1.5, "latency_s": 0.3}, ws) \
+        == "spike_overload"
+    # recovery outranks the spike drain that also covers 2.5
+    assert audit.attribute({"wall": 2.5, "latency_s": 0.3}, ws) \
+        == "recovery"
+    # replayed_tokens beats every window
+    assert audit.attribute(
+        {"wall": 2.5, "latency_s": 0.3, "replayed_tokens": 5}, ws) \
+        == "preempt_replay"
+
+
+def test_itemize_slos_partitions_budget_exactly():
+    events = _day_events()
+    records = audit.day_records(events)
+    windows = audit.cause_windows(events)
+    slo = _slo()
+    evaluated = tv_slo.evaluate_records(records, [slo])
+    max_unattr = audit.itemize_slos(records, [slo], evaluated, windows)
+    res = evaluated["lat"]
+    assert res["requests"] == 10 and res["bad"] == 4
+    bad_by_cause = {c: v["bad"] for c, v in res["by_cause"].items()
+                    if v["bad"]}
+    assert bad_by_cause == {"recovery": 1, "spike_overload": 1,
+                            "preempt_replay": 1}
+    assert res["unattributed"]["bad"] == 1
+    assert max_unattr == pytest.approx(0.25)
+    # the per-cause spends partition budget_consumed exactly
+    spent = sum(v["budget_consumed"] for v in res["by_cause"].values())
+    spent += res["unattributed"]["budget_consumed"]
+    assert spent == pytest.approx(res["budget_consumed"], abs=1e-4)
+
+
+def test_audit_day_scorecard_fields():
+    out = audit.audit_day(_day_events(), slos=[_slo()])
+    assert [p["phase"] for p in out["phases"]] == \
+        ["night", "spike", "peak_2"]
+    rack = out["rack_loss"]
+    assert rack["domain"] == "rack2" and rack["victims"] == [4, 5]
+    assert rack["mttr_s"] == pytest.approx(0.15)
+    assert rack["restore_tiers"] == ["peer"] and rack["warm"]
+    assert out["requests"] == {"generated": 10, "completed": 10,
+                               "dropped": 0}
+    assert out["max_unattributed_frac"] == pytest.approx(0.25)
+
+
+def _audit_fixture(*, identity_frac=0.0, goodput=0.96, unattr=0.0,
+                   rack="warm", dropped=0):
+    racks = {
+        "warm": {"restore_tiers": ["host", "peer"], "warm": True,
+                 "mttr_s": 0.04},
+        "cold": {"restore_tiers": ["durable"], "warm": False,
+                 "mttr_s": 0.04},
+        "slow": {"restore_tiers": ["peer"], "warm": True, "mttr_s": 9.0},
+        None: None,
+    }
+    return {
+        "ledger": {"identity_error_frac": identity_frac,
+                   "identity_error_s": identity_frac * 10.0,
+                   "wall_s": 10.0, "goodput_frac": goodput},
+        "slos": {"lat": {"requests": 100, "bad": 10,
+                         "unattributed": {"frac_of_bad": unattr,
+                                          "bad": int(10 * unattr)}}},
+        "rack_loss": racks[rack],
+        "requests": {"generated": 100, "completed": 100 - dropped,
+                     "dropped": dropped},
+    }
+
+
+def test_check_audit_passes_clean_day():
+    assert audit.check_audit(_audit_fixture(), goodput_floor=0.5,
+                             require_warm_restore=True,
+                             max_rack_mttr_s=1.0) == []
+
+
+@pytest.mark.parametrize("kwargs,gate,needle", [
+    ({"identity_frac": 0.05}, {}, "identity broken"),
+    ({"goodput": 0.3}, {"goodput_floor": 0.5}, "below"),
+    ({"unattr": 0.5}, {}, "unattributed"),
+    ({"rack": "cold"}, {"require_warm_restore": True}, "warm tiers"),
+    ({"rack": None}, {"require_warm_restore": True}, "no rack loss"),
+    ({"rack": "slow"}, {"max_rack_mttr_s": 1.0}, "MTTR"),
+    ({"dropped": 3}, {}, "dropped"),
+])
+def test_check_audit_gates_fire(kwargs, gate, needle):
+    fails = audit.check_audit(_audit_fixture(**kwargs), **gate)
+    assert any(needle in f for f in fails), fails
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the compressed day over the real supervisor
+# ---------------------------------------------------------------------------
+
+def _run_day(tmp_path, *, domain_spread):
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    from distributed_tensorflow_tpu.testing import day_sim
+
+    logdir = str(tmp_path / ("spread" if domain_spread else "blind"))
+    rep = day_sim.DaySim(seed=1, logdir=logdir,
+                         domain_spread=domain_spread).run()
+    assert rep["completed_run"], rep["error"]
+    return audit.audit_day(tv_events.read_run(logdir))
+
+
+@pytest.mark.slow
+def test_day_domain_spread_passes_gates(tmp_path):
+    out = _run_day(tmp_path, domain_spread=True)
+    fails = audit.check_audit(out, require_warm_restore=True,
+                              goodput_floor=0.5)
+    assert fails == []
+    assert out["rack_loss"]["warm"]
+    assert out["requests"]["dropped"] == 0
+
+
+@pytest.mark.slow
+def test_day_blind_ring_fails_warm_restore_gate(tmp_path):
+    """The acceptance-criteria negative: same day, same rack kill, but
+    the blind (pid-1)%N replica ring — the kill takes owners and their
+    replicas together, the restore falls to the durable tier, and the
+    warm-restore gate fails."""
+    out = _run_day(tmp_path, domain_spread=False)
+    rack = out["rack_loss"]
+    assert rack is not None and not rack["warm"]
+    assert rack["restore_tiers"] == ["durable"]
+    fails = audit.check_audit(out, require_warm_restore=True)
+    assert any("warm tiers" in f for f in fails), fails

@@ -424,3 +424,102 @@ def test_fleet_n256_clean_run_curve_observables():
     assert rep.max_agent_ops_per_step < 256 / 2
     # every worker pays a few KV ops per step, independent of N
     assert rep.ops_per_worker_per_step < 12
+
+
+# ---------------------------------------------------------------------------
+# Failure domains (ISSUE 19): topology, correlated kill plans, the
+# runner's whole-domain terminate
+# ---------------------------------------------------------------------------
+
+def test_domain_topology_block_placement():
+    topo = fleet_sim.DomainTopology(8, workers_per_domain=2)
+    assert topo.num_domains == 4
+    assert topo.domains == ["rack0", "rack1", "rack2", "rack3"]
+    assert topo.domain_of(0) == "rack0" and topo.domain_of(5) == "rack2"
+    assert topo.members("rack2") == [4, 5]
+    assert topo.as_map() == {p: f"rack{p // 2}" for p in range(8)}
+    with pytest.raises(ValueError, match="outside"):
+        topo.domain_of(8)
+    with pytest.raises(ValueError, match="num_workers"):
+        fleet_sim.DomainTopology(0)
+    with pytest.raises(ValueError, match="workers_per_domain"):
+        fleet_sim.DomainTopology(4, workers_per_domain=0)
+
+
+def test_domain_topology_short_last_domain_and_shrink():
+    topo = fleet_sim.DomainTopology(7, workers_per_domain=3)
+    assert topo.num_domains == 3
+    assert topo.members("rack2") == [6]          # short tail domain
+    # elastic resize keeps machines where they are
+    small = topo.shrink(5)
+    assert small.members("rack1") == [3, 4]
+    assert small.num_domains == 2
+    assert all(small.domain_of(p) == topo.domain_of(p)
+               for p in range(5))
+
+
+def test_seeded_domain_kill_plan_deterministic_and_correlated():
+    topo = fleet_sim.DomainTopology(8, workers_per_domain=2)
+    plan = fleet_sim.seeded_domain_kill_plan(
+        3, topo, kills=2, after_range=(0.5, 1.5))
+    assert plan == fleet_sim.seeded_domain_kill_plan(
+        3, topo, kills=2, after_range=(0.5, 1.5))     # seed-pure
+    assert len(plan) == 2
+    assert len({k.domain for k in plan}) == 2         # distinct racks
+    for kill in plan:
+        # a kill is CORRELATED: its victims are the whole domain
+        assert list(kill.victims) == topo.members(kill.domain)
+        assert 0.5 <= kill.after_s <= 1.5
+    # eligible restricts the candidate set
+    only = fleet_sim.seeded_domain_kill_plan(
+        3, topo, kills=4, eligible=("rack1",))
+    assert [k.domain for k in only] == ["rack1"]
+
+
+def test_sim_runner_terminate_domain_kills_whole_rack():
+    def loiter(ctx):
+        while True:
+            ctx.sleep(0.05)
+
+    topo = fleet_sim.DomainTopology(4, workers_per_domain=2)
+    runner = fleet_sim.SimRunner(
+        loiter, fleet_sim.sim_cluster_spec(4), topology=topo).start()
+    try:
+        killed = runner.terminate_domain("rack1")
+        assert killed == [2, 3]
+        assert runner.alive_tasks() == [("worker", 0), ("worker", 1)]
+        # exits observed as one simultaneous failure, not a cascade
+        assert set(runner.poll()) >= {("worker", 2), ("worker", 3)}
+        # idempotent: the domain is already dead
+        assert runner.terminate_domain("rack1") == []
+    finally:
+        runner.shutdown()
+
+
+def test_sim_runner_terminate_domain_requires_topology():
+    def loiter(ctx):
+        ctx.sleep(5.0)
+
+    runner = fleet_sim.SimRunner(
+        loiter, fleet_sim.sim_cluster_spec(2)).start()
+    try:
+        with pytest.raises(ValueError, match="topology"):
+            runner.terminate_domain("rack0")
+    finally:
+        runner.shutdown()
+
+
+def test_sim_runner_stamps_domain_into_task_env():
+    seen = {}
+
+    def probe(ctx):
+        seen[ctx.pid] = ctx.domain
+
+    topo = fleet_sim.DomainTopology(4, workers_per_domain=2)
+    runner = fleet_sim.SimRunner(
+        probe, fleet_sim.sim_cluster_spec(4), topology=topo).start()
+    try:
+        runner.join(timeout=10.0)
+    finally:
+        runner.shutdown()
+    assert seen == {0: "rack0", 1: "rack0", 2: "rack1", 3: "rack1"}

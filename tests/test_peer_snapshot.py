@@ -171,3 +171,116 @@ def test_exchange_noop_single_process():
 def test_store_keep_validation():
     with pytest.raises(ValueError, match="keep"):
         ps.SnapshotStore(None, keep=0)
+
+# ---------------------------------------------------------------------------
+# Failure-domain replica placement (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_RACKS = {0: "r0", 1: "r0", 2: "r1", 3: "r1"}
+
+
+def test_assign_replicators_blind_matches_historical_ring():
+    for world in (2, 3, 4, 7):
+        assert ps.assign_replicators(world) == \
+            {o: (o - 1) % world for o in range(world)}
+        for pid in range(world):
+            assert ps.replica_sources(pid, world) == \
+                (ps.ring_source(pid, world),)
+    assert ps.assign_replicators(1) == {}
+
+
+def test_assign_replicators_spread_crosses_domains():
+    for world, wpd in ((4, 2), (6, 2), (8, 4), (7, 3)):
+        domains = {p: f"r{p // wpd}" for p in range(world)}
+        assign = ps.assign_replicators(world, domains)
+        for owner, rep in assign.items():
+            assert rep != owner
+            assert domains[rep] != domains[owner], (world, wpd, owner)
+        # deterministic: every participant computes the same map
+        assert assign == ps.assign_replicators(world, domains)
+        # the inverse covers exactly the owners
+        held = [o for p in range(world)
+                for o in ps.replica_sources(p, world, domains)]
+        assert sorted(held) == list(range(world))
+
+
+def test_assign_replicators_single_domain_falls_back_to_any_peer():
+    domains = {p: "r0" for p in range(3)}
+    assign = ps.assign_replicators(3, domains)
+    for owner, rep in assign.items():
+        assert rep != owner                  # still never self
+
+
+def _exchanged_stores(domains):
+    """The store contents ring replication leaves behind: each pid
+    holds its own snapshot plus every replica the placement assigns
+    it (byte-equivalent to the collective exchange, no threads)."""
+    world = 4
+    stores = {p: ps.SnapshotStore(None, keep=2) for p in range(world)}
+    for pid in range(world):
+        stores[pid].put(_snap(owner=pid, step=8, world=world))
+        for src in ps.replica_sources(pid, world, domains):
+            stores[pid].put(_snap(owner=src, step=8, world=world))
+    return stores
+
+
+def test_rack_kill_blind_ring_falls_to_durable():
+    """The regression the placement policy exists for: with racks of
+    adjacent pids, the blind (pid-1)%N ring puts owner 3's only
+    replica on pid 2 — the SAME rack — so killing rack r1 loses both
+    and the restore decision falls through to the durable tier."""
+    stores = _exchanged_stores(domains=None)
+    surviving = {p: stores[p].inventory() for p in (0, 1)}  # r1 dead
+    d = ps._decide(surviving, disk_best=(0, "cold://seed", "durable"))
+    assert d["source"] == "disk" and d["tier"] == "durable"
+
+
+def test_rack_kill_domain_spread_restores_from_memory():
+    """Same kill, domain-spread placement: every replica lives outside
+    its owner's rack, so the survivors still cover all four owners and
+    the restore stays at the memory tier (no durable round-trip)."""
+    stores = _exchanged_stores(domains=_RACKS)
+    surviving = {p: stores[p].inventory() for p in (0, 1)}
+    d = ps._decide(surviving, disk_best=(0, "cold://seed", "durable"))
+    assert d["source"] == "memory" and d["step"] == 8
+    held = set()
+    for p in (0, 1):
+        held.update(stores[p].inventory())
+    assert held == {0, 1, 2, 3}
+
+
+def test_exchange_collective_spreads_replicas_across_domains():
+    """The real collective over the in-process coordination service:
+    four workers exchange one snapshot step with the domain map and
+    each store ends up holding exactly the assignment's replicas."""
+    import threading
+
+    from distributed_tensorflow_tpu.cluster import coordination
+    from distributed_tensorflow_tpu.testing import day_sim
+
+    service = coordination._LocalService()
+    agents = [day_sim._PeerAgent(service, p, 4) for p in range(4)]
+    stores = {p: ps.SnapshotStore(None, keep=2) for p in range(4)}
+    oks = {}
+
+    def worker(pid):
+        oks[pid] = ps.exchange(stores[pid], _snap(owner=pid, step=3,
+                                                  world=4),
+                               agents[pid], timeout_s=10.0,
+                               domains=_RACKS)
+
+    threads = [threading.Thread(target=worker, args=(p,), daemon=True)
+               for p in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert all(oks.get(p) for p in range(4)), oks
+    # exchange stores the REPLICAS this pid was assigned (the caller
+    # puts its own capture in the store separately)
+    assign = ps.assign_replicators(4, _RACKS)
+    for pid in range(4):
+        want = {o for o, r in assign.items() if r == pid}
+        assert set(stores[pid].inventory()) == want
+        for owner in want:
+            assert _RACKS[owner] != _RACKS[pid]

@@ -68,6 +68,11 @@ Series:
   in-engine install pause, bad-canary detect→rollback time, delta
   publish cost and delta/full size ratio — ALL inverted (a slower or
   fatter rollout path regresses);
+- ``day/<metric>`` — the ``DAY_r*.json`` production-day scorecard rows
+  (bench.py --day): whole-day goodput fraction gates as a floor;
+  rack-loss MTTR, the worst SLO's budget spend and the
+  unattributed-burn share gate INVERTED (a slower rack recovery or a
+  less-explained day regresses);
 - goodput/badput columns (``bench/goodput_frac``,
   ``serving/goodput_frac``, ``serving/badput_replay_frac``,
   ``serving/slo_p99_budget_consumed`` — the last two inverted): present
@@ -405,6 +410,36 @@ def load_rollout_history(repo: str = REPO) \
     return series
 
 
+def load_day_history(repo: str = REPO) \
+        -> "dict[str, dict[int, dict]]":
+    """``{series: {round: row}}`` from DAY_r*.json (ISSUE 19): the
+    production-day scorecard. ``goodput_frac`` gates as a floor (higher
+    is better); rack-loss MTTR, the worst SLO's budget spend and the
+    unattributed-burn share are ``lower_is_better`` — a slower rack
+    recovery, a deeper budget burn or a less-explained day regresses."""
+    inverted = {"rack_mttr_s", "max_slo_budget_consumed",
+                "unattributed_frac"}
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(repo, "DAY_r*.json"))):
+        rnd = _round_of(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for row in data.get("rows", []):
+            metric = row.get("metric")
+            if not isinstance(row.get("value"), (int, float)) \
+                    or not metric:
+                continue
+            name = metric.removeprefix("day_")
+            entry = {"value": row.get("value"), "unit": row.get("unit")}
+            if name in inverted:
+                entry["lower_is_better"] = True
+            series.setdefault(f"day/{name}", {})[rnd] = entry
+    return series
+
+
 def load_online_history(repo: str = REPO) \
         -> "dict[str, dict[int, dict]]":
     """``{series: {round: row}}`` from ONLINE_r*.json (ISSUE 15): per
@@ -540,6 +575,7 @@ def main(argv=None) -> int:
     series.update(load_autoscale_history(args.repo))
     series.update(load_online_history(args.repo))
     series.update(load_rollout_history(args.repo))
+    series.update(load_day_history(args.repo))
     real = {k: v for k, v in series.items() if k != "__skipped__" and v}
     if not real:
         print(f"bench_trend: no BENCH_r*/SCALING_r* history under "

@@ -118,6 +118,20 @@ on the cycle that consumes the lost stash entry — never a hang (the
 subprocess is killed on timeout and the seed fails), never silently
 wrong activations.
 
+``--day`` sweeps the PRODUCTION-DAY axis (ISSUE 19): each seed runs
+the compressed diurnal macro-scenario (testing/day_sim.py — one
+supervisor-run serving+training fleet through night / morning ramp
+(real ``request_scale``) / peak / flash spike past capacity / a
+seeded whole-RACK kill at peak / night), then scores it purely from
+the event logs (telemetry/audit.py). A seed survives only when ZERO
+admitted requests were dropped, the goodput identity holds within 1%
+across every worker and generation, at most 5% of any SLO's bad
+records are unattributed (every budget burn must trace to a logged
+cause: recovery, scale transition, spike overload, ...), and the
+rack-loss restore came from a WARM tier — ``host`` or ``peer``, never
+``durable``: the domain-spread placement must have kept a replica
+outside the dead rack.
+
 The simulated-fleet axis of this family lives in
 ``tools/fleet_sweep.py``: seed-derived crash/stall/partition schedules
 through hundreds of in-process workers (testing/fleet_sim.py) plus the
@@ -136,6 +150,7 @@ Usage::
     python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
     python tools/chaos_sweep.py --rollout --seeds 3   # live-rollout sweep
     python tools/chaos_sweep.py --offload --seeds 3   # activation-spill sweep
+    python tools/chaos_sweep.py --day --seeds 3       # production-day sweep
 
 Everything after ``--`` is forwarded to pytest (fault-schedule mode
 only). Exit code is non-zero if any seed fails (CI-friendly).
@@ -1203,6 +1218,58 @@ def run_offload_seed(seed: int, *, timeout_s: float = 600.0) \
     return ok, time.monotonic() - t0
 
 
+def run_day_seed(seed: int, *, keep_dirs: bool = False,
+                 goodput_floor: "float | None" = None) \
+        -> tuple[bool, float]:
+    """One production-day seed (module docstring, --day): the
+    compressed diurnal macro-scenario in-process (thread-backed
+    SimRunner), scored afterwards purely from its event logs. Gates:
+    zero dropped requests, goodput identity <=1%, unattributed SLO
+    burn <=5%, rack-loss restore from a warm (host/peer) tier."""
+    import shutil
+
+    # the other axes shell out to example scripts with cwd=REPO; this
+    # one runs the thread-backed sim in-process
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu.telemetry import (
+        audit as tv_audit, events as tv_events)
+    from distributed_tensorflow_tpu.testing.day_sim import DaySim
+
+    t0 = time.monotonic()
+    run_dir = tempfile.mkdtemp(prefix=f"day_sweep_s{seed}_")
+    fails: "list[str]" = []
+    try:
+        result = DaySim(seed=seed, logdir=run_dir).run()
+        if result["error"] is not None:
+            fails.append(f"supervisor error: {result['error']}")
+        else:
+            audit = tv_audit.audit_day(tv_events.read_run(run_dir))
+            fails = tv_audit.check_audit(
+                audit, identity_tol=0.01, max_unattributed=0.05,
+                goodput_floor=goodput_floor,
+                require_warm_restore=True, require_no_drops=True)
+            if not fails:
+                rack = audit["rack_loss"]
+                led = audit["ledger"]
+                print(f"    seed {seed}: goodput "
+                      f"{led['goodput_frac']:.1%}, "
+                      f"{audit['requests']['completed']} served / "
+                      f"0 dropped, rack {rack['domain']} restored "
+                      f"{rack['restore_tiers']} in "
+                      f"{rack['mttr_s'] * 1e3:.0f}ms")
+    except Exception as e:  # noqa: BLE001
+        fails.append(f"day run raised: {e!r}")
+    ok = not fails
+    for f in fails:
+        print(f"    seed {seed}: DAY-FAIL: {f}")
+    if ok and not keep_dirs:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    seed {seed}: run dir kept: {run_dir}")
+    return ok, time.monotonic() - t0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=5,
@@ -1263,6 +1330,13 @@ def main(argv=None) -> int:
                          "failure must raise a clean OffloadSpillError "
                          "on the consuming cycle (never hang, never "
                          "silently wrong activations)")
+    ap.add_argument("--day", action="store_true",
+                    help="sweep the production-day axis "
+                         "(testing/day_sim.py): per seed a compressed "
+                         "diurnal curve with a flash spike and a "
+                         "whole-rack kill at peak; zero-dropped, "
+                         "goodput-identity, <=5%%-unattributed-burn "
+                         "and warm-tier-restore gates")
     ap.add_argument("--duration", type=float, default=18.0,
                     help="--rollout: serving duration per run (s)")
     ap.add_argument("--events", type=int, default=480,
@@ -1319,12 +1393,16 @@ def main(argv=None) -> int:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
                              args.spike, args.online, args.rollout,
-                             args.offload)) > 1:
+                             args.offload, args.day)) > 1:
         ap.error("--kill, --serve, --data, --spike, --online, "
-                 "--rollout and --offload are separate sweep axes")
+                 "--rollout, --offload and --day are separate sweep "
+                 "axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.offload:
+        if args.day:
+            ok, dt = run_day_seed(s, keep_dirs=args.keep_dirs,
+                                  goodput_floor=args.goodput_floor)
+        elif args.offload:
             ok, dt = run_offload_seed(s)
         elif args.rollout:
             ok, dt = run_rollout_seed(s, replicas=args.workers,

@@ -70,6 +70,18 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
         slos = tv_slo.default_serving_slos(
             latency_s=latency_s, ttft_s=ttft_s, windows=windows)
         slo_report = tv_slo.evaluate_records(records, slos)
+        # cause itemization (ISSUE 19): when the run logged any
+        # control-plane transition the audit can window (recovery
+        # reform, scale.applied, serve.swap, kv.migrate, a spike
+        # phase), break each serving SLO's budget spend down by
+        # attributed cause — the unattributed remainder is the share
+        # no logged transition explains
+        from distributed_tensorflow_tpu.telemetry import (
+            audit as tv_audit)
+        cause_ws = tv_audit.cause_windows(events_by_pid)
+        if any(cause_ws.values()):
+            tv_audit.itemize_slos(tv_audit.day_records(events_by_pid),
+                                  slos, slo_report, cause_ws)
 
     # online freshness SLO (ISSUE 15): update->servable burn over the
     # evaluator's snapshot stamps. Folded into the same slo dict so
@@ -181,6 +193,17 @@ def render_text(report: dict) -> str:
                        f"{res['objective']:.1%}{thr}  "
                        f"{res['bad']}/{res['requests']} bad  "
                        f"budget consumed {res['budget_consumed']:.2f}x")
+            for cause, c in (res.get("by_cause") or {}).items():
+                if c["bad"]:
+                    out.append(f"    cause {cause:<16} {c['bad']:>5} "
+                               f"bad  {c['budget_consumed']:6.2f}x "
+                               f"budget")
+            un = res.get("unattributed")
+            if un and un["bad"]:
+                out.append(f"    cause {'UNATTRIBUTED':<16} "
+                           f"{un['bad']:>5} bad  "
+                           f"{un['budget_consumed']:6.2f}x budget  "
+                           f"({un['frac_of_bad']:.1%} of bad)")
             for w in res["windows"]:
                 bl = (f"{w['burn_long']:.2f}"
                       if w["burn_long"] is not None else "-")

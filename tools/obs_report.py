@@ -251,6 +251,24 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                         "step_time": _percentiles(pid_steps),
                         "infeed_wait_s": round(pid_wait, 6)}
 
+    # failure-domain annotation (ISSUE 19): the day driver's
+    # day.topology event carries the {pid: rack} placement map; stamp
+    # it onto every recovery event so the timeline shows WHICH rack a
+    # death/restore belonged to (correlated kills become visible as one
+    # domain repeating)
+    domain_map: dict = {}
+    for events in events_by_pid.values():
+        for ev in events:
+            if ev.get("ev") == "day.topology":
+                domain_map.update(ev.get("domains") or {})
+    if domain_map:
+        for ev in recovery:
+            if ev.get("domain") is None:
+                tid = ev.get("task_id", ev.get("pid"))
+                dom = domain_map.get(str(tid))
+                if dom is not None:
+                    ev["domain"] = dom
+
     recovery.sort(key=lambda ev: ev.get("wall", 0.0))
     restore_tiers = collections.Counter(
         ev.get("tier", "?") for ev in recovery
@@ -301,6 +319,27 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                                   4) if overlap_effs else None),
         }
     bottleneck = classify_run(fractions) if fractions else None
+
+    # -- production-day audit (ISSUE 19) ---------------------------------
+    # only when a day driver ran: phase markers make the cause windows
+    # and the per-phase goodput cut meaningful
+    day_report = None
+    if any(ev.get("ev") == "day.phase" for evs in events_by_pid.values()
+           for ev in evs):
+        from distributed_tensorflow_tpu.telemetry import audit as _audit
+        a = _audit.audit_day(events_by_pid)
+        day_report = {
+            "phases": a["phases"],
+            "slos": {
+                name: {"requests": res["requests"], "bad": res["bad"],
+                       "budget_consumed": res["budget_consumed"],
+                       "by_cause": res["by_cause"],
+                       "unattributed": res["unattributed"]}
+                for name, res in a["slos"].items()},
+            "max_unattributed_frac": a["max_unattributed_frac"],
+            "rack_loss": a["rack_loss"],
+            "requests": a["requests"],
+        }
 
     # -- goodput/badput ledger (ISSUE 10) --------------------------------
     from distributed_tensorflow_tpu.telemetry import goodput as _goodput
@@ -365,6 +404,8 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
               or online_snapshots) else None,
         "phases": phases_report,
         "goodput": goodput_report,
+        "day": day_report,
+        "domains": domain_map or None,
         "bottleneck": bottleneck,
         "steps_table": step_rows,
         "infeed_wait_fraction": (round(infeed_wait / step_time_total, 4)
@@ -449,6 +490,8 @@ def _fmt_recovery_line(ev: dict) -> str:
     t = ev.get("t")
     head = f"  t+{t:8.3f}s " if isinstance(t, (int, float)) else "  "
     gen = ev.get("generation")
+    dom = ev.get("domain")
+    head += f"{'[' + str(dom) + ']':<9}" if dom is not None else ""
     tail = [name] + ([f"gen{gen}"] if gen is not None else [])
     if name == "recovery.worker_death":
         tail.append(f"{ev.get('task_type')}:{ev.get('task_id')} "
@@ -606,6 +649,38 @@ def render_text(report: dict, rollup: dict) -> str:
                    f"{gp['wall_s']:.1f}s hardware time"
                    + (f"  (badput: {bad})" if bad else "")
                    + "  — details: tools/health_report.py")
+    day = report.get("day")
+    if day:
+        out.append("production day (telemetry/audit.py):")
+        if day.get("phases"):
+            out.append(f"  {'phase':<12} {'dur':>7} {'hw-sec':>8} "
+                       f"{'goodput':>8}")
+            for ph in day["phases"]:
+                gf = (f"{ph['goodput_frac']:.1%}"
+                      if ph.get("goodput_frac") is not None else "-")
+                out.append(f"  {ph['phase']:<12} {ph['dur_s']:6.2f}s "
+                           f"{ph['wall_s']:7.2f}s {gf:>8}")
+        out.append("  SLO budget spend by cause:")
+        for name, res in day["slos"].items():
+            out.append(f"    {name}: {res['bad']}/{res['requests']} "
+                       f"bad, {res['budget_consumed']:.2f}x budget")
+            for cause, c in res["by_cause"].items():
+                if c["bad"]:
+                    out.append(f"      {cause:<16} {c['bad']:>5} bad "
+                               f"({c['budget_consumed']:.2f}x)")
+            un = res["unattributed"]
+            if un["bad"]:
+                out.append(f"      {'UNATTRIBUTED':<16} "
+                           f"{un['bad']:>5} bad "
+                           f"({un['frac_of_bad']:.1%} of bad)")
+        rack = day.get("rack_loss")
+        if rack:
+            mttr = (f"{rack['mttr_s']:.3f}s"
+                    if rack.get("mttr_s") is not None else "unrecovered")
+            out.append(f"  rack loss: {rack['domain']} (victims "
+                       f"{rack['victims']}), MTTR {mttr}, restored "
+                       f"from {rack['restore_tiers']} "
+                       f"[{'WARM' if rack['warm'] else 'COLD'}]")
     for pid, info in sorted(report["processes"].items(),
                             key=lambda kv: str(kv[0])):
         p = info["step_time"]

@@ -33,6 +33,14 @@ or when the assembled trace is not valid JSON.
 ``pipeline.schedule`` events in the run (the compiled schedule is one
 fused XLA program, so stage activity is analytic — see
 parallel/pipeline.schedule_spans).
+
+When the run contains a production-day driver's ``day.phase`` markers
+(testing/day_sim.py), synthetic "production day (audit)" tracks are
+appended automatically: one row of diurnal-phase spans plus one row
+per audit attribution cause with its merged windows
+(telemetry/audit.cause_windows) — the rack-loss recovery window and
+the spike-overload window land on the same timeline as the worker
+events they explain.
 """
 
 from __future__ import annotations
@@ -108,6 +116,66 @@ def _pipeline_tracks(events_by_pid: dict, trace: dict):
     return n
 
 
+def _day_tracks(events_by_pid: dict, trace: dict,
+                offsets: dict) -> int:
+    """Append synthetic production-day tracks when a day driver ran
+    (ISSUE 19): one row of phase spans (the diurnal curve) plus one row
+    per attribution cause with its merged windows — so the trace shows
+    WHERE the audit priced each SLO burn, on the same timeline as the
+    real worker events."""
+    from distributed_tensorflow_tpu.telemetry import audit as tv_audit
+    phases = tv_audit.phase_spans(events_by_pid)
+    if not phases:
+        return 0
+    # the same rebasing assemble_trace used: earliest aligned start
+    t0 = None
+    for pid, events in events_by_pid.items():
+        off = offsets.get(pid, 0.0)
+        for ev in events:
+            wall = ev.get("wall")
+            if not isinstance(wall, (int, float)):
+                continue
+            dur = ev.get("dur_s")
+            dur = dur if isinstance(dur, (int, float)) and dur >= 0 \
+                else 0.0
+            start = wall - off - dur
+            t0 = start if t0 is None else min(t0, start)
+    t0 = t0 or 0.0
+    pid = tv_trace._SYNTHETIC_PID_BASE + 2000
+    trace["traceEvents"].append(
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": "production day (audit)"}})
+    trace["traceEvents"].append(
+        {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+         "args": {"name": "phase"}})
+    n = 0
+    for ph in phases:
+        trace["traceEvents"].append(
+            {"ph": "X", "pid": pid, "tid": 1, "name": ph["phase"],
+             "cat": "day", "ts": round((ph["start"] - t0) * 1e6, 3),
+             "dur": round(ph["dur_s"] * 1e6, 3),
+             "args": {"rate_rps": ph.get("rate_rps")}})
+        n += 1
+    windows = tv_audit.cause_windows(events_by_pid)
+    tid = 1
+    for cause in tv_audit.CAUSES:
+        spans = windows.get(cause) or []
+        if not spans:
+            continue
+        tid += 1
+        trace["traceEvents"].append(
+            {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+             "args": {"name": f"cause: {cause}"}})
+        for lo, hi in spans:
+            trace["traceEvents"].append(
+                {"ph": "X", "pid": pid, "tid": tid, "name": cause,
+                 "cat": "day", "ts": round((lo - t0) * 1e6, 3),
+                 "dur": round(max(0.0, hi - lo) * 1e6, 3),
+                 "args": {}})
+            n += 1
+    return n
+
+
 def _migrate_pairs(mig_spans: "list[dict]") -> "dict[str, set]":
     """``{span_id: {directions seen}}`` over kv.migrate spans."""
     pairs: "dict[str, set]" = {}
@@ -164,6 +232,7 @@ def main(argv=None) -> int:
         run_id=os.path.basename(os.path.normpath(args.target)))
     n_pipeline = (_pipeline_tracks(events_by_pid, trace)
                   if args.pipeline else 0)
+    n_day = _day_tracks(events_by_pid, trace, info["offsets"])
     out_path = args.out or os.path.join(args.target, "trace.json")
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(trace, f)
@@ -189,6 +258,7 @@ def main(argv=None) -> int:
         "missing_generations": comp["missing"],
         "torn_tails": info["torn_tails"],
         "pipeline_spans": n_pipeline,
+        "day_spans": n_day,
         "kv_migrate_spans": len(mig_spans),
         "kv_migrate_pairs": mig_pairs,
     }
@@ -211,6 +281,9 @@ def main(argv=None) -> int:
             print(f"  torn tail tolerated: {path}")
         if n_pipeline:
             print(f"  pipeline: {n_pipeline} analytic stage spans")
+        if n_day:
+            print(f"  production day: {n_day} phase + cause-window "
+                  f"spans")
         if mig_spans:
             print(f"  kv.migrate: {len(mig_spans)} spans, "
                   f"{mig_pairs} export->adopt flow arrows")
