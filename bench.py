@@ -1176,6 +1176,205 @@ def run_serving(out_path: str | None = None, *, qps: float | None = None,
     return row
 
 
+def run_serving_router(out_path: str | None = None, *, seed: int = 0,
+                       duration_s: float = 6.0):
+    """Multi-tenant routed-serving bench (ISSUE 20): the cache-affinity
+    router in front of TWO in-process continuous-batching engines,
+    driven by the seeded two-class tenant workload
+    (serving/router.py:seeded_tenant_workload — per-session shared
+    prefixes are the affinity material).
+
+    The same workload runs twice — ``policy="affinity"`` then
+    ``policy="random"`` over fresh engines — and the row records both
+    sides' token-level prefix-cache hit rates plus ``affinity_uplift``,
+    the measured advantage session-affinity routing buys over spraying
+    the same sessions across replicas (each replica then cold-misses
+    the other's prefixes). Emits one row PER PRIORITY CLASS
+    (interactive / batch) from the affinity phase: per-class p50/p99
+    latency, tokens/s, and the per-tenant share of generated tokens —
+    the split a single aggregate row would hide (batch latency is
+    allowed to be an order of magnitude worse; averaging the classes
+    together would alarm on nothing and miss real interactive
+    regressions). Rows carry ``router: true`` so
+    tools/bench_trend.py keys them as their own measurement points
+    (hit-rate floors non-inverted, per-class p99 inverted).
+    """
+    import random as _random
+
+    from distributed_tensorflow_tpu import telemetry
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.serving import (
+        InferenceEngine, Router, TenantConfig, seeded_tenant_workload)
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+
+    backend = jax.default_backend()
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    block_size = 8
+    engine_kw = dict(num_blocks=96, block_size=block_size, max_slots=8,
+                     max_prompt_len=32)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # quotas stay infinite here: the bench measures routing + priority,
+    # not admission control (quota rejects are the chaos harness's and
+    # unit tests' job) — every request must complete so the two phases
+    # serve identical workloads
+    tenants = (
+        TenantConfig(name="inter", pclass="interactive", weight=2.0,
+                     slo_latency_s=2.0),
+        TenantConfig(name="batch", pclass="batch", weight=1.0,
+                     slo_latency_s=15.0),
+    )
+    rates = {"inter": 4.0, "batch": 2.5}
+    workload = seeded_tenant_workload(
+        seed, duration_s=duration_s, tenants=tenants, rates=rates,
+        sessions_per_tenant=4, session_prefix_blocks=3,
+        block_size=block_size, vocab_size=cfg.vocab_size)
+    by_id = {r.id: r for r in workload}
+
+    def pct(vals, q):
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))] \
+            if vals else None
+
+    def run_phase(policy: str):
+        """One full pass of the seeded workload through a fresh router
+        + two fresh engines (cold caches — the phases must not share
+        prefix state or the comparison is meaningless)."""
+        engines = [InferenceEngine(cfg, params,
+                                   queue_capacity=len(workload) + 1,
+                                   prefix_caching=True, **engine_kw)
+                   for _ in range(2)]
+        # compile warmup off the telemetry record AND off the clock
+        # (same discipline as run_serving: a warmup request's latency
+        # is compile time)
+        tv_dir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+        if tv_dir:
+            tv_events.shutdown()
+        warm = []
+        for eng in engines:
+            eng.generate([[1, 2, 3]], max_new_tokens=2)
+            wp = [1] * min(2 * block_size, eng.max_prompt_len)
+            eng.generate([wp], max_new_tokens=2)   # extend path
+            eng.generate([wp], max_new_tokens=2)   # CoW partial-tail
+            warm.append(eng.stats())
+        if tv_dir:
+            tv_events.configure(tv_dir)
+
+        router = Router(
+            replicas=(0, 1), tenants=tenants,
+            submit_fn=lambda r, req, meta: engines[r].submit(req),
+            policy=policy, block_size=block_size,
+            tick_token_budget=96, seed=seed)
+        done: dict[str, dict] = {}
+        pending = list(workload)
+        t0 = time.perf_counter()
+        while len(done) < len(workload):
+            now = time.perf_counter() - t0
+            while pending and pending[0].arrival_s <= now:
+                req = pending.pop(0)
+                router.offer(req, now=now)
+            router.dispatch(now=now)
+            if all(e.scheduler.idle for e in engines):
+                if pending:               # ahead of schedule: wait
+                    time.sleep(max(0.0,
+                                   pending[0].arrival_s - now))
+                continue
+            finished = []
+            for eng in engines:
+                if eng.scheduler.idle:
+                    continue
+                for rec in eng.step():
+                    rid = rec["id"]
+                    if rid in by_id:
+                        rec["latency_s"] = ((time.perf_counter() - t0)
+                                            - by_id[rid].arrival_s)
+                        done[rid] = rec
+                        finished.append(rid)
+            router.note_completed(finished)
+        span = time.perf_counter() - t0
+        # fleet-wide token-level hit rate over the measured window
+        hit = look = 0
+        for eng, w in zip(engines, warm):
+            pc = eng.stats().get("prefix_cache") or {}
+            wpc = w.get("prefix_cache") or {}
+            hit += pc.get("hit_tokens", 0) - wpc.get("hit_tokens", 0)
+            look += (pc.get("lookup_tokens", 0)
+                     - wpc.get("lookup_tokens", 0))
+        stats = router.stats()
+        router.close()
+        return {"done": done, "span": span,
+                "hit_rate": round(hit / look if look else 0.0, 4),
+                "router": stats}
+
+    aff = run_phase("affinity")
+    rnd = run_phase("random")
+    uplift = round(aff["hit_rate"] - rnd["hit_rate"], 4)
+    print(f"router bench: affinity hit {aff['hit_rate']:.3f} vs "
+          f"random {rnd['hit_rate']:.3f} (uplift {uplift:+.3f}); "
+          f"route reasons {aff['router']['route_reasons']}",
+          file=sys.stderr)
+
+    total_tokens = sum(len(r.get("tokens") or ())
+                       for r in aff["done"].values())
+    tenant_share = {}
+    for cfg_t in tenants:
+        t_toks = sum(len(r.get("tokens") or ())
+                     for rid, r in aff["done"].items()
+                     if by_id[rid].tenant == cfg_t.name)
+        tenant_share[cfg_t.name] = round(
+            t_toks / total_tokens if total_tokens else 0.0, 4)
+
+    rows = []
+    for pclass in ("interactive", "batch"):
+        ids = [rid for rid in aff["done"]
+               if by_id[rid].pclass == pclass]
+        lats = sorted(aff["done"][rid]["latency_s"] for rid in ids)
+        toks = sum(len(aff["done"][rid].get("tokens") or ())
+                   for rid in ids)
+        qps_target = sum(rates[t.name] for t in tenants
+                         if t.pclass == pclass)
+        row = {
+            "metric": "serving_tokens_per_sec",
+            "value": round(toks / aff["span"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "extra": {
+                "backend": backend,
+                "router": True,
+                "pclass": pclass,
+                "policy": "affinity",
+                "n_requests": len(ids),
+                "qps_target": qps_target,
+                "qps_achieved": round(len(ids) / aff["span"], 2),
+                "p50_latency_ms": round(pct(lats, 0.50) * 1e3, 2),
+                "p99_latency_ms": round(pct(lats, 0.99) * 1e3, 2),
+                "tokens_generated": toks,
+                "seed": seed,
+                # the hit-rate floor bench_trend gates non-inverted —
+                # identical on both class rows (it's a fleet property)
+                "cache_hit_rate": aff["hit_rate"],
+                "random_hit_rate": rnd["hit_rate"],
+                "affinity_uplift": uplift,
+                "tenant_token_share": tenant_share,
+                "route_reasons": aff["router"]["route_reasons"],
+            },
+        }
+        telemetry.event("serving.row", metric=row["metric"],
+                        value=row["value"],
+                        **{k: v for k, v in row["extra"].items()
+                           if isinstance(v, (int, float, str))})
+        print(json.dumps(row))
+        rows.append(row)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"bench": "serving", "backend": backend,
+                       "host_cpus": os.cpu_count(), "seed": seed,
+                       "rows": rows}, f, indent=1)
+            f.write("\n")
+    return rows
+
+
 def run_serving_disagg(out_path: str | None = None, *,
                        n_requests: int | None = None, seed: int = 0,
                        qps: float | None = None,
@@ -2312,7 +2511,8 @@ def run_rollout(out_path: str | None = None, *, seed: int = 0,
 
 
 def run_day(out_path: str | None = None, *, seed: int = 0,
-            keep_dir: bool = False, domain_spread: bool = True):
+            keep_dir: bool = False, domain_spread: bool = True,
+            two_tenant: bool = False):
     """Production-day scorecard bench (ISSUE 19): one seeded
     compressed diurnal day through a supervisor-run shared fleet
     (testing/day_sim.py — night / ramp / peak / flash spike / rack loss
@@ -2342,7 +2542,8 @@ def run_day(out_path: str | None = None, *, seed: int = 0,
 
     run_dir = tempfile.mkdtemp(prefix="bench_day_")
     sim = DaySim(seed=seed, logdir=run_dir,
-                 domain_spread=domain_spread)
+                 domain_spread=domain_spread,
+                 two_tenant=two_tenant)
     result = sim.run()
     if result["error"] is not None:
         print(f"day: supervisor error: {result['error']} "
@@ -2392,6 +2593,8 @@ def run_day(out_path: str | None = None, *, seed: int = 0,
         "generations": result["generations"],
         "scales_applied": result["scales_applied"],
     }
+    if result.get("two_tenant"):
+        extra["two_tenant"] = result["two_tenant"]
     rows = []
     for metric, value, unit in (
             ("day_goodput_frac", led["goodput_frac"], "frac"),
@@ -2561,6 +2764,12 @@ if __name__ == "__main__":
                         help="run the request-level serving bench "
                              "(p50/p99 latency + tokens/s at --qps "
                              "through the continuous-batching engine)")
+    parser.add_argument("--router", action="store_true",
+                        help="with --serving: multi-tenant routed "
+                             "serving — the cache-affinity router over "
+                             "two in-process engines, per-priority-"
+                             "class rows plus the affinity-vs-random "
+                             "hit-rate uplift")
     parser.add_argument("--disagg", action="store_true",
                         help="with --serving: disaggregated prefill/"
                              "decode under a seeded prefill burst — "
@@ -2610,6 +2819,12 @@ if __name__ == "__main__":
                              "then takes an owner AND its replica; "
                              "the warm-restore audit gate fails — "
                              "the negative control)")
+    parser.add_argument("--day-tenants", action="store_true",
+                        help="with --day: stamp the serving stream "
+                             "two-tenant (interactive + batch); batch "
+                             "admits after interactive each tick — "
+                             "the router frontend's shed-first policy "
+                             "on the diurnal curve")
     parser.add_argument("--rollout", action="store_true",
                         help="run the live-rollout bench (hot-swap vs "
                              "restart-adoption publish->servable "
@@ -2667,12 +2882,15 @@ if __name__ == "__main__":
         run_rollout(out_path=args.out, seed=args.seed)
     elif args.day or args.workload == "day":
         run_day(out_path=args.out, seed=args.seed,
-                domain_spread=not args.no_domain_spread)
+                domain_spread=not args.no_domain_spread,
+                two_tenant=args.day_tenants)
     elif args.online or args.workload == "online":
         run_online(out_path=args.out, seed=args.seed,
                    total_events=args.events or 6144)
     elif args.serving or args.workload == "serving":
-        if args.disagg:
+        if args.router:
+            run_serving_router(out_path=args.out, seed=args.seed)
+        elif args.disagg:
             run_serving_disagg(out_path=args.out, qps=args.qps,
                                n_requests=args.requests,
                                seed=args.seed,

@@ -108,6 +108,10 @@ class ScaleDecision:
     burn_long: "float | None" = None
     burn_short: "float | None" = None
     firing: bool = False
+    #: decision provenance (multi-tenant serving): the tenant whose
+    #: per-tenant burn fired — None for fleet-level verdicts or
+    #: single-tenant deployments
+    tenant: "str | None" = None
 
     def to_fields(self) -> dict:
         return {"direction": self.direction, "target": self.target,
@@ -116,7 +120,7 @@ class ScaleDecision:
                               if self.burn_long is not None else None),
                 "burn_short": (round(self.burn_short, 4)
                                if self.burn_short is not None else None),
-                "firing": self.firing}
+                "firing": self.firing, "tenant": self.tenant}
 
 
 def serving_records_fn(run_dir: str):
@@ -137,9 +141,15 @@ class Autoscaler:
     clear timer, cooldown); all clocks injectable."""
 
     def __init__(self, policy: "AutoscalePolicy | None" = None, *,
-                 records_fn=None, clock=time.time):
+                 records_fn=None, tenants=None, clock=time.time):
         self.policy = policy or AutoscalePolicy()
         self._records_fn = records_fn
+        #: TenantConfig set (serving/tenancy.py): when given, each
+        #: decision also evaluates PER-TENANT burn (each tenant's own
+        #: threshold/objective over the policy's windows) and names the
+        #: worst-burning firing tenant — decision provenance for the
+        #: multi-tenant router
+        self._tenants = tuple(tenants) if tenants else ()
         self._clock = clock
         self._last_decide: "float | None" = None
         self._fire_streak = 0
@@ -182,8 +192,10 @@ class Autoscaler:
                      for w in windows)
         bl = windows[0]["burn_long"] if windows else None
         bs = windows[0]["burn_short"] if windows else None
+        tenant, tenant_evals = self._tenant_burns(records, now)
         self.last_eval = {"wall": now, "burn_long": bl, "burn_short": bs,
-                          "firing": firing, "records": len(records)}
+                          "firing": firing, "records": len(records),
+                          "tenant": tenant, "tenants": tenant_evals}
         if firing:
             self._fire_streak += 1
             self._clear_since = None
@@ -209,7 +221,7 @@ class Autoscaler:
                 and n_replicas < p.max_replicas):
             return ScaleDecision(
                 "up", min(p.max_replicas, n_replicas + p.scale_step),
-                "slo_burn", now, bl, bs, firing)
+                "slo_burn", now, bl, bs, firing, tenant=tenant)
         if (self._clear_since is not None
                 and now - self._clear_since >= p.clear_hold_s
                 and n_replicas > p.min_replicas):
@@ -217,6 +229,51 @@ class Autoscaler:
                 "down", max(p.min_replicas, n_replicas - p.scale_step),
                 "burn_clear", now, bl, bs, firing)
         return None
+
+    def _tenant_burns(self, records: list, now: float):
+        """Per-tenant burn attribution: each tenant's records evaluated
+        against ITS OWN threshold/objective over the policy's windows.
+        Returns ``(worst_firing_tenant_or_None, {name: eval})``."""
+        if not self._tenants:
+            return None, None
+        p = self.policy
+        by_t: dict = {}
+        for r in records:
+            t = r.get("tenant")
+            if t:
+                by_t.setdefault(t, []).append(r)
+        evals: dict = {}
+        worst = None
+        for cfg in self._tenants:
+            recs = by_t.get(cfg.name)
+            if not recs:
+                continue
+            t_slo = tv_slo.SLO(f"{cfg.name}/p99_latency", "latency",
+                               objective=cfg.slo_objective,
+                               threshold_s=cfg.slo_latency_s,
+                               windows=p.slo.windows)
+            wins = tv_slo.burn_windows(recs, t_slo, now=now)
+
+            def _ev(w, recs=recs) -> int:
+                lo = now - w["short_s"]
+                return sum(1 for r in recs
+                           if isinstance(r.get("wall"), (int, float))
+                           and lo < r["wall"] <= now)
+
+            t_firing = any(w["firing"] and _ev(w) >= p.min_evidence
+                           for w in wins)
+            t_bs = wins[0]["burn_short"] if wins else None
+            evals[cfg.name] = {
+                "burn_short": (round(t_bs, 4) if t_bs is not None
+                               else None),
+                "firing": t_firing, "records": len(recs),
+                "share": round(len(recs) / len(records), 4)
+                if records else None}
+            if t_firing and t_bs is not None and (
+                    worst is None
+                    or t_bs > evals[worst]["burn_short"]):
+                worst = cfg.name
+        return worst, evals
 
 
 class CapacityArbiter:
@@ -275,6 +332,8 @@ class CapacityArbiter:
         self._g_serve = reg.gauge("fleet/capacity/serve_replicas")
         self._g_burn = reg.gauge("fleet/capacity/burn_short")
         self._g_budget.set(budget)
+        self._reg = reg
+        self._g_tenant: dict = {}
 
     # -- helpers -----------------------------------------------------------
     def _train_n(self) -> int:
@@ -301,6 +360,18 @@ class CapacityArbiter:
         ev = self.engine.last_eval
         if ev and ev.get("burn_short") is not None:
             self._g_burn.set(round(ev["burn_short"], 4))
+        if ev and ev.get("tenants"):
+            # per-tenant capacity view: burn + share of recent
+            # completions, exported as fleet/tenant/<name>/* gauges
+            for name, te in ev["tenants"].items():
+                for field in ("burn_short", "share"):
+                    if te.get(field) is None:
+                        continue
+                    key = f"fleet/tenant/{name}/{field}"
+                    g = self._g_tenant.get(key)
+                    if g is None:
+                        g = self._g_tenant[key] = self._reg.gauge(key)
+                    g.set(te[field])
         if self._state != "idle" and self._state_since is not None \
                 and now - self._state_since > self.state_timeout_s:
             if self._pending is not None:

@@ -43,6 +43,16 @@ prefix-cache blocks spill to a :class:`HostTier` and re-adopt on hit
 (``InferenceEngine(spill_tier=...)``). Greedy outputs stay
 byte-identical to the monolithic engine throughout.
 
+Multi-tenant frontend (ISSUE 20): :mod:`router` + :mod:`tenancy` put a
+crash-tolerant, tenant-aware router in front of the replica fleet —
+prefix-cache-affinity routing (falling back to least-loaded by scraped
+queue depth, measured against random), weighted-fair admission with
+priority classes (interactive ahead of batch, batch aged past its
+starvation deadline promoted), per-tenant token-bucket quotas + SLO
+burn windows, and a line-buffered decision journal that re-routes a
+killed replica's in-flight work and survives a router kill without
+double-serving. Chaos: ``python tools/chaos_sweep.py --router``.
+
 Quick start::
 
     from distributed_tensorflow_tpu import serving
@@ -100,6 +110,21 @@ from distributed_tensorflow_tpu.serving.replica import (
     seeded_requests,
     serving_replica,
 )
+from distributed_tensorflow_tpu.serving.router import (
+    Router,
+    RouterJournal,
+    RoutingPolicy,
+    prefix_chain_keys,
+    seeded_tenant_workload,
+)
+from distributed_tensorflow_tpu.serving.tenancy import (
+    TenancyController,
+    TenantConfig,
+    TokenBucket,
+    default_tenants,
+    evaluate_tenants,
+    fair_shares,
+)
 
 __all__ = [
     "InferenceEngine",
@@ -113,4 +138,8 @@ __all__ = [
     "make_draft_fn", "make_extend_fn", "make_prefill_fn",
     "model_forward", "param_shardings", "truncated_draft",
     "completed_ids", "seeded_requests", "serving_replica",
+    "Router", "RouterJournal", "RoutingPolicy", "prefix_chain_keys",
+    "seeded_tenant_workload",
+    "TenancyController", "TenantConfig", "TokenBucket",
+    "default_tenants", "evaluate_tenants", "fair_shares",
 ]

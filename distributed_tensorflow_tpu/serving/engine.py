@@ -693,6 +693,7 @@ class InferenceEngine:
         self._m_queued.set(len(self.scheduler.queue))
         telemetry.event("serve.admit", id=request.id,
                         span_id=request_span_id(request.id),
+                        tenant=request.tenant, pclass=request.pclass,
                         prompt_tokens=len(request.tokens),
                         queued=len(self.scheduler.queue))
         return evicted
@@ -1022,6 +1023,7 @@ class InferenceEngine:
             "serve.request", id=req.id, dur_s=round(latency, 6),
             span_id=request_span_id(req.id),
             model_version=self.weights_version,
+            tenant=req.tenant, pclass=req.pclass,
             prompt_tokens=prompt_tokens, new_tokens=len(generated),
             replayed_tokens=replayed,
             ttft_s=round(ttft, 6) if ttft is not None else None,
@@ -1029,6 +1031,7 @@ class InferenceEngine:
         return {"id": req.id, "tokens": tokens,
                 "prompt_tokens": prompt_tokens,
                 "model_version": self.weights_version,
+                "tenant": req.tenant, "pclass": req.pclass,
                 "latency_s": latency, "ttft_s": ttft,
                 "replayed_tokens": replayed,
                 "preemptions": seq.preemptions}

@@ -592,3 +592,248 @@ def serving_replica(run_dir: str, n_requests: int, seed: int,
             f"({'drained' if drained else 'complete'}), "
             f"{retries} injected-fault retries")
     return task, served, len(mine) - len(todo) + served
+
+
+# ---------------------------------------------------------------------------
+# Routed replicas (multi-tenant frontend, serving/router.py)
+# ---------------------------------------------------------------------------
+
+def inbox_path(run_dir: str, task: int) -> str:
+    """The line-buffered per-replica inbox the router appends routed
+    requests to and :func:`routed_replica` tails."""
+    return os.path.join(run_dir, f"inbox-{task}.jsonl")
+
+
+def replica_metrics_dir(run_dir: str, task: int) -> str:
+    """Where replica ``task`` exports its live metrics
+    (``metrics-live.prom``) — one directory per replica so the router
+    can scrape each one's queue depth (and judge liveness by mtime)."""
+    return os.path.join(run_dir, f"metrics-{task}")
+
+
+def request_to_wire(request: Request, meta: "dict | None" = None
+                    ) -> dict:
+    """One inbox line: the request's full content (the inbox IS the
+    handoff — a respawned replica re-reads it from the top) plus the
+    router's routing metadata (``reroute`` marks re-dispatch after a
+    replica death; the server prices those completions
+    ``reroute_replay``)."""
+    return {"id": request.id, "tokens": list(request.tokens),
+            "max_new_tokens": request.max_new_tokens,
+            "eos_id": request.eos_id,
+            "arrival_s": request.arrival_s,
+            "tenant": request.tenant, "pclass": request.pclass,
+            **(meta or {})}
+
+
+def request_from_wire(rec: dict) -> Request:
+    return Request(id=rec["id"], tokens=tuple(rec["tokens"]),
+                   max_new_tokens=int(rec.get("max_new_tokens", 16)),
+                   eos_id=rec.get("eos_id"),
+                   arrival_s=float(rec.get("arrival_s", 0.0)),
+                   tenant=rec.get("tenant"),
+                   pclass=rec.get("pclass") or "interactive")
+
+
+def _read_complete_lines(f) -> "list[str]":
+    """New COMPLETE lines since the last call; a partial trailing line
+    (the router mid-append) rewinds and is retried next poll."""
+    lines = []
+    while True:
+        pos = f.tell()
+        line = f.readline()
+        if not line:
+            break
+        if not line.endswith("\n"):
+            f.seek(pos)         # torn tail: the router is mid-write
+            break
+        lines.append(line)
+    return lines
+
+
+def routed_replica(run_dir: str, seed: int, *,
+                   max_retries: int = 50,
+                   engine_kwargs: "dict | None" = None,
+                   step_delay_s: float = 0.0,
+                   export_interval_s: float = 0.5):
+    """One generation of one ROUTER-FED serving replica.
+
+    Unlike :func:`serving_replica` (static workload shard), this worker
+    owns no workload: it tails its inbox file (:func:`inbox_path` —
+    line-buffered appends from the router), serves whatever lands
+    there, and logs completions to the same ``served-<task>.jsonl``
+    contract. Restart safety is the same union argument extended by the
+    inbox: a respawned incarnation re-reads the inbox from the top,
+    skips every id in the fleet-wide completion union, and re-serves
+    the rest — plus whatever the router RE-ROUTES here from a replica
+    that died (``reroute``-flagged lines; their completions emit
+    ``serve.rerouted`` so the goodput ledger prices the duplicate work
+    into the ``reroute_replay`` bucket).
+
+    The replica runs its own :class:`~distributed_tensorflow_tpu.
+    telemetry.exporter.MetricsExporter` into
+    :func:`replica_metrics_dir` — the scrape the router's least-loaded
+    fallback and liveness detection read. Prefix caching is ON by
+    default (affinity routing is pointless without it).
+
+    Exits when the router's ``eof`` sentinel has been read AND the
+    engine is idle. Returns ``(task, served_this_gen, total_done)``."""
+    from distributed_tensorflow_tpu.cluster import bootstrap, elastic
+
+    runtime = bootstrap.initialize()
+    import contextlib
+
+    import jax
+    if runtime.num_processes <= 1:
+        with contextlib.suppress(Exception):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "none")
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+    from distributed_tensorflow_tpu.resilience.faults import FaultInjected
+    from distributed_tensorflow_tpu.serving.engine import InferenceEngine
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    from distributed_tensorflow_tpu.telemetry import exporter as tv_exp
+    from distributed_tensorflow_tpu.telemetry import goodput
+
+    task = runtime.process_id
+    tdir = os.environ.get(tv_events.ENV_TELEMETRY_DIR)
+    if tdir:
+        tv_events.configure(tdir, process_id=task)
+    goodput.activate(goodput.GoodputLedger())
+
+    cfg = TransformerConfig.tiny(max_seq_len=64)
+    kwargs = dict(num_blocks=96, block_size=8, max_slots=4,
+                  max_prompt_len=40, queue_capacity=4096,
+                  prefix_caching=True)
+    kwargs.update(engine_kwargs or {})
+    params = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0),
+        jax.numpy.zeros((1, 8), jax.numpy.int32))["params"]
+    engine = InferenceEngine(cfg, params, **kwargs)
+
+    # warm the compiled programs BEFORE anchoring the epoch (compile is
+    # startup, not client-visible queueing), exactly like the spike path
+    gen = elastic.generation()
+    engine.submit(Request(id=f"warmup-{task}-g{gen}", tokens=(1, 2, 3),
+                          max_new_tokens=2))
+    engine.run_until_idle(retry_faults=True)
+
+    mdir = replica_metrics_dir(run_dir, task)
+    os.makedirs(mdir, exist_ok=True)
+    exp = tv_exp.MetricsExporter(interval_s=export_interval_s, dir=mdir)
+
+    # the ROUTER anchors the run epoch once it sees the whole fleet's
+    # exporters up (arrivals must not start during compile warmup);
+    # wait for its anchor, with a standalone-use fallback
+    import time as _time
+    epoch_path = os.path.join(run_dir, "run-epoch.json")
+    wait_until = _time.time() + 60.0
+    while not os.path.exists(epoch_path) and _time.time() < wait_until:
+        elastic.heartbeat(0)
+        _time.sleep(0.05)
+    epoch = run_epoch(run_dir)
+
+    done = completed_ids_all(run_dir)
+    inbox = inbox_path(run_dir, task)
+    open(inbox, "a").close()         # the router may not have written yet
+    log_path = os.path.join(run_dir, f"served-{task}.jsonl")
+
+    served = 0
+    step = 0
+    retries = 0
+    eof = False
+    submitted: set = set()
+    reroute_ids: set = set()
+    import time as _time
+    print(f"[gen {gen} route-serve-{task}] up, {len(done)} in "
+          f"completion union", flush=True)
+
+    def _log_finished(log, finished):
+        nonlocal served
+        ledger = goodput.active_ledger()
+        for rec in finished:
+            if rec["id"].startswith("warmup-"):
+                continue
+            log.write(json.dumps({
+                "id": rec["id"], "tokens": rec["tokens"],
+                "prompt_tokens": rec["prompt_tokens"],
+                "latency_s": round(rec["latency_s"], 6),
+                "tenant": rec.get("tenant"),
+                "pclass": rec.get("pclass"),
+                "reroute": rec["id"] in reroute_ids,
+                "gen": gen}) + "\n")
+            served += 1
+            if rec["id"] in reroute_ids:
+                # duplicate/recovery work: the whole re-served request
+                # prices into the reroute_replay badput bucket
+                nt = len(rec["tokens"])
+                tv_events.event("serve.rerouted", id=rec["id"],
+                                tenant=rec.get("tenant"),
+                                new_tokens=nt)
+                if ledger is not None:
+                    ledger.tokens(0, rerouted=nt)
+
+    with open(log_path, "a", buffering=1) as log, open(inbox) as inb:
+        while True:
+            elastic.heartbeat(step)
+            progressed = False
+            for line in _read_complete_lines(inb):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("eof"):
+                    eof = True
+                    continue
+                rid = rec.get("id")
+                if rid is None or rid in submitted or rid in done:
+                    continue
+                submitted.add(rid)
+                if rec.get("reroute"):
+                    reroute_ids.add(rid)
+                r = request_from_wire(rec)
+                # backdate to the TRUE arrival: routing hops and
+                # re-routes cannot reset the client's latency clock
+                engine.submit(r, arrival_wall=epoch + r.arrival_s)
+                progressed = True
+            mode = elastic.drain_mode()
+            if mode is not None:
+                while not engine.scheduler.idle:
+                    elastic.heartbeat(step)
+                    try:
+                        _log_finished(log, engine.step())
+                    except FaultInjected:
+                        retries += 1
+                        if retries > max_retries:
+                            raise
+                tv_events.event("serve.drain", task=task, mode=mode,
+                                completed=served, requeued=0)
+                break
+            if not engine.scheduler.idle:
+                if step_delay_s:
+                    _time.sleep(step_delay_s)
+                try:
+                    _log_finished(log, engine.step())
+                except FaultInjected:
+                    retries += 1
+                    if retries > max_retries:
+                        raise
+                step += 1
+            elif eof:
+                break
+            elif not progressed:
+                _time.sleep(0.01)        # inbox quiet, engine idle
+
+    elastic.heartbeat(step)
+    tv_events.event("serve.alloc_check", task=task,
+                    **engine.block_accounting())
+    exp.stop()
+    print(f"[gen {gen} route-serve-{task}] served {served} this "
+          f"generation ({retries} injected-fault retries)", flush=True)
+    goodput.activate(None)
+    if tdir:
+        tv_events.shutdown()
+    bootstrap.shutdown()
+    return task, served, len(done) + served

@@ -77,6 +77,12 @@ class Request:
     eos_id: int | None = None
     arrival_s: float = 0.0
     generated_prefix: tuple = ()
+    #: owning tenant (multi-tenant router, serving/tenancy.py); None
+    #: for single-tenant workloads — stamped through serve.admit /
+    #: serve.request / serve.reject so per-tenant SLOs can partition
+    tenant: str | None = None
+    #: priority class ("interactive" | "batch")
+    pclass: str = "interactive"
 
     def __post_init__(self):
         object.__setattr__(self, "tokens", tuple(int(t)
@@ -159,6 +165,9 @@ class AdmissionQueue:
                 self.rejected += 1
                 self._m_rejected.increment()
                 telemetry.event("serve.reject", id=request.id,
+                                tenant=request.tenant,
+                                pclass=request.pclass,
+                                cause="overload",
                                 queued=len(self._q),
                                 capacity=self.capacity,
                                 policy=self.policy)
@@ -168,6 +177,9 @@ class AdmissionQueue:
             self.evicted += 1
             self._m_evicted.increment()
             telemetry.event("serve.reject", id=evicted.id,
+                            tenant=evicted.tenant,
+                            pclass=evicted.pclass,
+                            cause="overload",
                             queued=len(self._q),
                             capacity=self.capacity,
                             policy=self.policy, evicted_for=request.id)

@@ -73,8 +73,8 @@ from distributed_tensorflow_tpu.telemetry import registry as _registry
 #: Badput bucket names, in render order. ``idle`` is the residual that
 #: makes the identity exact.
 BADPUT_BUCKETS = ("startup", "infeed_wait", "ckpt_block", "recovery",
-                  "scale_transition", "preempt_replay", "kv_migrate",
-                  "rollout", "idle")
+                  "scale_transition", "preempt_replay",
+                  "reroute_replay", "kv_migrate", "rollout", "idle")
 
 #: Step events whose duration is (mostly) goodput.
 _STEP_EVENTS = frozenset({"train.step", "serve.step"})
@@ -118,6 +118,7 @@ def _worker_ledger(events: "list[dict]",
     serve_s = 0.0          # serve.step seconds (split by replay below)
     fresh_tokens = 0
     replayed_tokens = 0
+    rerouted_tokens = 0    # tokens served under a router re-route
 
     for ev in events:
         wall = ev.get("wall")
@@ -193,6 +194,13 @@ def _worker_ledger(events: "list[dict]",
                 replayed_tokens += int(rt)
                 if isinstance(nt, (int, float)):
                     fresh_tokens += max(0, int(nt) - int(rt))
+        elif name == "serve.rerouted":
+            # the router re-dispatched this request after its first
+            # replica died mid-flight: this replica's serve share of it
+            # is duplicate/recovery work, priced reroute_replay below
+            nt = ev.get("new_tokens")
+            if isinstance(nt, (int, float)):
+                rerouted_tokens += int(nt)
         elif name == "run.start":
             bad["startup" if in_startup else "idle"] += wall - cursor
             cursor = wall
@@ -205,12 +213,20 @@ def _worker_ledger(events: "list[dict]",
 
     # serving: the replayed share of decode/prefill work re-generated
     # tokens a preemption (or replica death) already produced once —
-    # badput, not goodput
+    # badput, not goodput. Tokens served under a router RE-ROUTE are
+    # priced separately (``reroute_replay``): the whole re-served
+    # request is conservatively treated as recovery work (an upper
+    # bound — the dead replica may not have finished it), so the
+    # measured re-route cost can never be understated.
     total_tokens = fresh_tokens + replayed_tokens
     replay_frac = (replayed_tokens / total_tokens) if total_tokens else 0.0
+    reroute_frac = (min(rerouted_tokens, fresh_tokens) / total_tokens) \
+        if total_tokens else 0.0
     bad["preempt_replay"] += serve_s * replay_frac
-    out["goodput_s"] += serve_s * (1.0 - replay_frac)
+    bad["reroute_replay"] += serve_s * reroute_frac
+    out["goodput_s"] += serve_s * (1.0 - replay_frac - reroute_frac)
     out["replayed_tokens"] = replayed_tokens
+    out["rerouted_tokens"] = rerouted_tokens
 
     if first_wall is not None:
         out["wall_s"] = last_wall - first_wall
@@ -323,6 +339,7 @@ class GoodputLedger:
         self._serve_s = 0.0
         self._fresh = 0
         self._replayed = 0
+        self._rerouted = 0
         self._attributed = 0.0
         self._bucket = "startup"       # current accruing bucket
         self._reg = reg or _registry.get_registry()
@@ -360,10 +377,15 @@ class GoodputLedger:
             self._serve_s += self._claim(dur_s)
             self._bucket = "idle"
 
-    def tokens(self, fresh: int, replayed: int = 0):
+    def tokens(self, fresh: int, replayed: int = 0,
+               rerouted: int = 0):
+        """``rerouted`` marks fresh tokens that re-served a request a
+        dead replica already had in flight (router re-route) — their
+        serve share prices ``reroute_replay`` at snapshot time."""
         with self._lock:
             self._fresh += max(0, int(fresh))
             self._replayed += max(0, int(replayed))
+            self._rerouted += max(0, int(rerouted))
 
     def record(self, bucket: str, seconds: float):
         """Explicit badput (e.g. the supervisor pricing a recovery)."""
@@ -400,10 +422,13 @@ class GoodputLedger:
             wall = self._clock() - self._t0
             total_tok = self._fresh + self._replayed
             rf = (self._replayed / total_tok) if total_tok else 0.0
+            xf = (min(self._rerouted, self._fresh) / total_tok) \
+                if total_tok else 0.0
             bad = {b: self._named.get(b, 0.0) for b in BADPUT_BUCKETS
                    if b != "idle"}
             bad["preempt_replay"] += self._serve_s * rf
-            good = self._good_train + self._serve_s * (1.0 - rf)
+            bad["reroute_replay"] += self._serve_s * xf
+            good = self._good_train + self._serve_s * (1.0 - rf - xf)
             bad["idle"] = max(0.0, wall - good
                               - sum(bad.values()))
         return {"wall_s": wall, "goodput_s": good,

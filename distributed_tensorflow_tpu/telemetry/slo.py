@@ -235,6 +235,8 @@ def records_from_events(events_by_pid: "dict") -> "list[dict]":
                 "latency_s": ev.get("dur_s"),
                 "ttft_s": ev.get("ttft_s"),
                 "model_version": ev.get("model_version"),
+                "tenant": ev.get("tenant"),
+                "pclass": ev.get("pclass"),
                 "ok": not ev.get("error"),
             })
     records.sort(key=lambda r: r.get("wall") or 0.0)

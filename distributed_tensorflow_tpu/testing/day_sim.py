@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import random
 import tempfile
 import threading
 import time
@@ -124,7 +125,9 @@ class DaySim:
                  train_step_s: float = 0.04, snap_every: int = 4,
                  exchange_timeout_s: float = 2.0,
                  max_restarts: int = 4,
-                 drain_timeout_s: float = 15.0):
+                 drain_timeout_s: float = 15.0,
+                 two_tenant: bool = False,
+                 batch_frac: float = 0.25):
         if num_servers < 1 or num_trainers < 2:
             raise ValueError("need >=1 server and >=2 trainers")
         self.seed = seed
@@ -149,13 +152,27 @@ class DaySim:
             workers_per_domain=workers_per_domain)
         self._runner: "fleet_sim.SimRunner | None" = None
         self._day_over = threading.Event()
-        #: the shared fleet admission queue: arrival wall stamps.
+        #: optional two-tenant serving stream (ISSUE 20): a seeded
+        #: ``batch_frac`` share of arrivals belongs to the batch tenant
+        #: and admits AFTER interactive each tick — the router
+        #: frontend's batch-sheds-first policy on the diurnal curve.
+        #: Batch therefore only queues behind interactive inside the
+        #: already-attributed overload/recovery windows, so the audit's
+        #: unattributed gate still holds.
+        self.two_tenant = two_tenant
+        self.batch_frac = batch_frac
+        self._tenant_rng = random.Random(f"day-tenants:{seed}")
+        #: the shared fleet admission queues: arrival wall stamps.
         #: Owned by the sim (not any worker incarnation), so a reform
-        #: parks the backlog instead of dropping it.
+        #: parks the backlog instead of dropping it. ``_queue_batch``
+        #: stays empty unless ``two_tenant``.
         self._queue: "collections.deque[float]" = collections.deque()
+        self._queue_batch: "collections.deque[float]" = \
+            collections.deque()
         self._q_lock = threading.Lock()
         self._generated = 0
         self._completed = 0
+        self._completed_batch = 0
         self._done_lock = threading.Lock()
         self._phase_name = "pre"
 
@@ -178,23 +195,38 @@ class DaySim:
             ctx.check_kill()
             tick_start = time.time()
             with self._q_lock:
-                popped = [self._queue.popleft()
+                # interactive admits first; batch takes whatever
+                # capacity is left this tick (the two-tenant day's
+                # shed-first policy — a no-op pop when single-tenant)
+                popped = [(self._queue.popleft(), "interactive")
                           for _ in range(min(self.server_capacity,
                                              len(self._queue)))]
+                popped += [(self._queue_batch.popleft(), "batch")
+                           for _ in range(
+                               min(self.server_capacity - len(popped),
+                                   len(self._queue_batch)))]
             now = time.time()
-            for arrival in popped:
+            n_batch = 0
+            for arrival, kind in popped:
                 # queueing delay + deterministic service time = the
                 # honest completion latency; logged atomically with the
                 # pop, so an admitted request is never lost to a kill
                 lat = max(0.0, now - arrival) + self.service_s
-                log.event("serve.request", kind="interactive",
+                stamp = {}
+                if self.two_tenant:
+                    stamp["tenant"] = ("batchco" if kind == "batch"
+                                       else "acme")
+                    stamp["pclass"] = kind
+                    n_batch += kind == "batch"
+                log.event("serve.request", kind=kind,
                           dur_s=round(lat, 6),
                           ttft_s=round(0.5 * lat, 6),
                           new_tokens=32, replayed_tokens=0,
                           model_version="v1", error=False,
-                          phase=self._phase_name)
+                          phase=self._phase_name, **stamp)
             with self._done_lock:
                 self._completed += len(popped)
+                self._completed_batch += n_batch
             ctx.sleep(self.serve_tick_s)
             log.event("serve.step",
                       dur_s=round(time.time() - tick_start, 6),
@@ -363,8 +395,15 @@ class DaySim:
                     if n:
                         carry -= n
                         stamp = time.time()
+                        n_batch = sum(
+                            self._tenant_rng.random() < self.batch_frac
+                            for _ in range(n)) if self.two_tenant \
+                            else 0
                         with self._q_lock:
-                            self._queue.extend([stamp] * n)
+                            self._queue.extend(
+                                [stamp] * (n - n_batch))
+                            self._queue_batch.extend(
+                                [stamp] * n_batch)
                         self._generated += n
                     if kill_at is not None and now >= kill_at[0]:
                         victims = self._runner.terminate_domain(
@@ -389,7 +428,10 @@ class DaySim:
                 time.sleep(0.01)
         finally:
             driver.event("day.load", generated=self._generated,
-                         completed=self._completed)
+                         completed=self._completed,
+                         completed_batch=(self._completed_batch
+                                          if self.two_tenant
+                                          else None))
             driver.event("day.end")
             self._day_over.set()
             sup_thread.join(timeout=20.0)
@@ -407,6 +449,11 @@ class DaySim:
             "wall_s": round(wall, 3),
             "generated": self._generated,
             "completed": self._completed,
+            "two_tenant": ({"batch_completed": self._completed_batch,
+                            "interactive_completed":
+                                self._completed
+                                - self._completed_batch}
+                           if self.two_tenant else None),
             "phases": [dataclasses.asdict(p) for p in self.phases],
             "rack_kill": kill_fired,
             "scales_applied": supervisor.scales_applied,

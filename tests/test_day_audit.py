@@ -187,6 +187,35 @@ def test_day_domain_spread_passes_gates(tmp_path):
 
 
 @pytest.mark.slow
+def test_day_two_tenant_stream_passes_gates(tmp_path):
+    """The optional two-tenant day (ISSUE 20): a seeded batch share of
+    the serving stream admits after interactive each tick. Batch only
+    queues extra inside already-attributed overload/recovery windows,
+    so every audit gate still holds — and the records carry the tenant
+    stamps per-tenant SLO evaluation partitions on."""
+    from distributed_tensorflow_tpu.telemetry import events as tv_events
+    from distributed_tensorflow_tpu.testing import day_sim
+
+    logdir = str(tmp_path / "tenants")
+    rep = day_sim.DaySim(seed=1, logdir=logdir,
+                         two_tenant=True).run()
+    assert rep["completed_run"], rep["error"]
+    tt = rep["two_tenant"]
+    assert tt["batch_completed"] > 0
+    assert tt["interactive_completed"] > tt["batch_completed"]
+    evs = tv_events.read_run(logdir)
+    out = audit.audit_day(evs)
+    fails = audit.check_audit(out, require_warm_restore=True,
+                              goodput_floor=0.5)
+    assert fails == []
+    assert out["requests"]["dropped"] == 0
+    stamps = {(e.get("tenant"), e.get("kind"))
+              for es in evs.values() for e in es
+              if e.get("ev") == "serve.request"}
+    assert stamps == {("acme", "interactive"), ("batchco", "batch")}
+
+
+@pytest.mark.slow
 def test_day_blind_ring_fails_warm_restore_gate(tmp_path):
     """The acceptance-criteria negative: same day, same rack kill, but
     the blind (pid-1)%N replica ring — the kill takes owners and their

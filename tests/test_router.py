@@ -1,0 +1,471 @@
+"""Multi-tenant serving frontend (serving/router.py + tenancy.py).
+
+Layers under test, bottom up:
+
+- the weighted max-min allocator (``fair_shares``) against hand-worked
+  examples, and ``plan_tick``'s batch-subordinate split with the aged
+  (anti-starvation) promotion;
+- token-bucket quotas: an over-quota offer is rejected with
+  ``cause="quota"`` charged to the RIGHT tenant, and refills admit
+  again later;
+- the routing cascade (affinity > least-loaded > seeded random) on a
+  synthetic block map, including dead-replica exclusion and the
+  full-block-only chain-key rule;
+- dispatch flow control: stale/saturated replicas hold the queue AT
+  THE ROUTER (no credit accrual, no sheds), release is priority-
+  ordered, batch sheds first under budget pressure, DRR credit makes
+  progress on requests costlier than one tick's budget;
+- re-route damping: never to another stale replica, never past
+  ``MAX_REROUTES``, never when no survivor exists;
+- the decision journal: replay after a torn tail is idempotent — a
+  resumed router re-offers nothing, double-routes nothing, and keeps
+  routed-but-unacked work with its replica;
+- per-tenant SLO partitioning (one tenant's overrun cannot fire
+  another's verdict).
+
+Everything runs on a fake clock — determinism is the point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_tpu.serving.router import (
+    AffinityMap,
+    ROUTER_JOURNAL,
+    Router,
+    RouterJournal,
+    RoutingPolicy,
+    prefix_chain_keys,
+    seeded_tenant_workload,
+)
+from distributed_tensorflow_tpu.serving.scheduler import Request
+from distributed_tensorflow_tpu.serving.tenancy import (
+    TenancyController,
+    TenantConfig,
+    TokenBucket,
+    evaluate_tenants,
+    fair_shares,
+    partition_records,
+)
+
+
+def _req(rid, *, n_tokens=8, new=4, tenant="inter",
+         pclass="interactive"):
+    return Request(id=rid, tokens=tuple(range(1, n_tokens + 1)),
+                   max_new_tokens=new, tenant=tenant, pclass=pclass)
+
+
+def _tenants(**overrides):
+    base = dict(
+        inter=TenantConfig(name="inter", pclass="interactive",
+                           weight=2.0, slo_latency_s=2.0),
+        batch=TenantConfig(name="batch", pclass="batch", weight=1.0,
+                           slo_latency_s=10.0, starvation_frac=0.5),
+    )
+    base.update(overrides)
+    return tuple(base.values())
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _router(tmp_path=None, *, tenants=None, policy="least_loaded",
+            replicas=("r0", "r1"), budget=1000, clock=None,
+            **kw):
+    clock = clock or FakeClock()
+    calls = []
+    r = Router(replicas=replicas, tenants=tenants or _tenants(),
+               submit_fn=lambda rep, req, meta: calls.append(
+                   (rep, req.id, meta)),
+               policy=policy, block_size=4,
+               tick_token_budget=budget,
+               run_dir=str(tmp_path) if tmp_path else None,
+               clock=clock, **kw)
+    return r, calls, clock
+
+
+# -- fair shares + plan_tick (hand-computed) --------------------------------
+
+class TestFairShares:
+    def test_hand_worked_example(self):
+        # round 1: weights {2,1,1} split 100 as a=50 b=25 c=25;
+        # a (demand 50) and c (demand 10) fit -> granted exactly,
+        # surplus 40 returns; round 2: b alone, budget-bound at 40
+        out = fair_shares({"a": 50, "b": 100, "c": 10},
+                          {"a": 2, "b": 1, "c": 1}, 100)
+        assert out == {"a": 50.0, "b": 40.0, "c": 10.0}
+
+    def test_budget_covers_all(self):
+        out = fair_shares({"a": 5, "b": 7}, {"a": 1, "b": 1}, 100)
+        assert out == {"a": 5.0, "b": 7.0}
+
+    def test_zero_budget(self):
+        out = fair_shares({"a": 5}, {"a": 1}, 0)
+        assert out == {"a": 0.0}
+
+    def test_order_independent(self):
+        d1 = {"a": 30, "b": 80, "c": 20}
+        d2 = dict(reversed(list(d1.items())))
+        w = {"a": 1, "b": 2, "c": 1}
+        assert fair_shares(d1, w, 60) == fair_shares(d2, w, 60)
+
+
+class TestPlanTick:
+    def test_batch_subordinate(self):
+        tc = TenancyController(_tenants())
+        # interactive (weight 2) takes its full demand first; batch
+        # divides the remainder
+        alloc = tc.plan_tick({"inter": 80, "batch": 50}, budget=100)
+        assert alloc["inter"] == 80.0
+        assert alloc["batch"] == 20.0
+
+    def test_aged_batch_promoted(self):
+        tc = TenancyController(_tenants())
+        # aged batch joins the first-pool weighted-fair split
+        # (weights 2:1 over 100 -> inter 66.7, batch 33.3; batch's
+        # demand 30 fits, surplus to inter)
+        alloc = tc.plan_tick({"inter": 80, "batch": 30}, budget=100,
+                             aged={"batch"})
+        assert alloc["batch"] == 30.0
+        assert alloc["inter"] == 70.0
+
+    def test_starvation_deadline_derived(self):
+        cfg = TenantConfig(name="b", pclass="batch",
+                           slo_latency_s=10.0, starvation_frac=0.5)
+        assert cfg.starvation_deadline_s == 5.0
+
+
+# -- quotas ------------------------------------------------------------------
+
+class TestQuota:
+    def test_bucket_refills(self):
+        b = TokenBucket(rate=10.0, burst=20.0, now=0.0)
+        assert b.take(20, now=0.0)
+        assert not b.take(1, now=0.0)
+        assert b.take(10, now=1.0)          # 10 tokens refilled
+
+    def test_offer_rejects_right_tenant_with_quota_cause(self):
+        tenants = _tenants(
+            burst=TenantConfig(name="burst", pclass="interactive",
+                               quota_tokens_per_s=1.0, quota_burst=10.0,
+                               slo_latency_s=2.0))
+        r, calls, clock = _router(tenants=tenants)
+        big = _req("burst-0000", n_tokens=10, new=4, tenant="burst")
+        assert r.offer(big) == "rejected:quota"       # cost 14 > 10
+        assert r.offer(_req("inter-0000")) == "admitted"
+        c = r.tenancy.counters
+        assert c["burst"]["rejected"] == {"quota": 1}
+        assert c["inter"]["rejected"] == {}
+        # the rejection is a DECISION: re-offering is a duplicate
+        assert r.offer(big) == "duplicate"
+        # refill admits the same-shaped request later
+        clock.t = 10.0
+        ok = _req("burst-0001", n_tokens=5, new=4, tenant="burst")
+        assert r.offer(ok) == "admitted"
+
+    def test_unknown_tenant_raises(self):
+        r, _, _ = _router()
+        with pytest.raises(KeyError):
+            r.offer(_req("x-0000", tenant="nobody"))
+
+
+# -- routing cascade ---------------------------------------------------------
+
+class TestRoutingPolicy:
+    def test_chain_keys_full_blocks_only(self):
+        # 9 tokens, block 4: only tokens[:-1]=8 chain -> 2 keys; the
+        # final prompt position never counts as cacheable
+        toks = tuple(range(9))
+        keys = prefix_chain_keys(toks, 4)
+        assert len(keys) == 2
+        assert prefix_chain_keys(toks[:5], 4) == keys[:1]
+        # content-addressed: same tokens, same keys
+        assert prefix_chain_keys(tuple(range(9)), 4) == keys
+
+    def test_affinity_beats_load(self):
+        p = RoutingPolicy(["r0", "r1"], block_size=4,
+                          policy="affinity", seed=0)
+        session = tuple(range(10, 19))          # 2 full blocks
+        p.observe_route(session, "r0")
+        p.observe_depth("r0", 99)               # r0 heavily loaded
+        p.observe_depth("r1", 0)
+        # affinity still wins: the KV is THERE
+        assert p.route(session) == ("r0", "affinity")
+        # a novel prompt falls through to least-loaded
+        rep, reason = p.route(tuple(range(100, 109)))
+        assert (rep, reason) == ("r1", "least_loaded")
+
+    def test_dead_replica_excluded_and_forgotten(self):
+        p = RoutingPolicy(["r0", "r1"], block_size=4,
+                          policy="affinity", seed=0)
+        session = tuple(range(10, 19))
+        p.observe_route(session, "r0")
+        rep, reason = p.route(session, exclude=("r0",))
+        assert rep == "r1" and reason != "affinity"
+        p.forget("r0")
+        rep, reason = p.route(session)
+        assert reason != "affinity"             # its cache died with it
+
+    def test_random_ignores_depth(self):
+        p = RoutingPolicy(["r0", "r1"], block_size=4, policy="random",
+                          seed=3)
+        p.observe_depth("r0", 99)
+        reasons = {p.route((1, 2, 3, 4, 5))[1] for _ in range(8)}
+        assert reasons == {"random"}
+
+    def test_no_live_replica_raises(self):
+        p = RoutingPolicy(["r0"], block_size=4)
+        with pytest.raises(RuntimeError):
+            p.route((1, 2, 3), exclude=("r0",))
+
+    def test_affinity_map_lru_bound(self):
+        m = AffinityMap(4, capacity=2)
+        m.observe(tuple(range(5)), "r0")        # 1 key
+        m.observe(tuple(range(10, 15)), "r1")   # 1 key
+        m.observe(tuple(range(20, 25)), "r1")   # evicts the oldest
+        assert m.lookup(tuple(range(5)), {"r0", "r1"}) is None
+        assert m.lookup(tuple(range(10, 15)), {"r0", "r1"}) is not None
+
+
+# -- dispatch: flow control, priority order, sheds, DRR ----------------------
+
+class TestDispatch:
+    def test_all_stale_holds_queue_without_sheds_or_credit(self):
+        r, calls, clock = _router(budget=8)
+        r.offer(_req("inter-0000"))
+        r.offer(_req("batch-0000", tenant="batch", pclass="batch"))
+        for _ in range(5):
+            assert r.dispatch(stale={"r0", "r1"}) == []
+        assert r.queued == 2 and not calls
+        assert r.tenancy.counters["batch"]["sheds"] == 0
+        # no credit hoarded across the held ticks: one open tick at a
+        # budget below one request's cost still dispatches nothing...
+        assert r.dispatch(budget=8) == []
+        # ...but DRR carry across OPEN ticks eventually covers it
+        assert len(r.dispatch(budget=8)) >= 1
+
+    def test_release_is_priority_ordered(self):
+        r, calls, _ = _router(budget=1000)
+        r.offer(_req("batch-0000", tenant="batch", pclass="batch"))
+        r.offer(_req("batch-0001", tenant="batch", pclass="batch"))
+        r.offer(_req("inter-0000"))
+        r.offer(_req("inter-0001"))
+        out = r.dispatch()
+        assert [q.pclass for q in out[:2]] == ["interactive"] * 2
+        assert len(out) == 4                    # budget covers all
+
+    def test_inflight_cap_closes_replica(self):
+        r, calls, _ = _router(max_inflight_per_replica=1)
+        for i in range(5):
+            r.offer(_req(f"inter-{i:04d}"))
+        assert len(r.dispatch()) == 2           # one per replica
+        assert r.queued == 3
+        assert r.dispatch() == []               # fleet saturated
+        routed = [rid for _, rid, _ in calls]
+        r.note_completed(routed)                # acks free the slots
+        assert len(r.dispatch()) == 2
+
+    def test_batch_sheds_first_under_pressure(self):
+        r, calls, _ = _router(budget=12)
+        r.offer(_req("inter-0000", n_tokens=8, new=4))       # cost 12
+        r.offer(_req("batch-0000", tenant="batch",
+                     pclass="batch", n_tokens=8, new=4))
+        out = r.dispatch()
+        assert [q.tenant for q in out] == ["inter"]
+        assert r.tenancy.counters["batch"]["sheds"] == 1
+        assert r.queued == 1
+
+    def test_aged_batch_not_shed(self):
+        r, calls, clock = _router(budget=12)
+        r.offer(_req("batch-0000", tenant="batch",
+                     pclass="batch", n_tokens=8, new=4))
+        clock.t = 6.0            # past 10s*0.5 starvation deadline
+        out = r.dispatch()
+        assert [q.tenant for q in out] == ["batch"]
+        assert r.tenancy.counters["batch"]["sheds"] == 0
+
+
+# -- re-route damping --------------------------------------------------------
+
+class TestReroute:
+    def _loaded(self, tmp_path=None, **kw):
+        r, calls, clock = _router(tmp_path, **kw)
+        r.offer(_req("inter-0000"))
+        r.offer(_req("inter-0001"))
+        r.dispatch()
+        return r, calls, clock
+
+    def test_reroute_moves_to_survivor(self):
+        r, calls, _ = self._loaded()
+        dead = calls[0][0]
+        survivor = "r1" if dead == "r0" else "r0"
+        n = r.replica_died(dead)
+        assert n >= 1
+        assert all(st["replica"] == survivor
+                   for st in r.inflight.values())
+
+    def test_never_to_another_stale_replica(self):
+        r, calls, _ = self._loaded(replicas=("r0", "r1", "r2"))
+        owners = {rep for rep, _, _ in calls}
+        stale = owners | {"r1"}
+        if len(stale) == 3:                     # keep one survivor
+            stale.discard("r2")
+        n = r.replica_died(next(iter(owners)), exclude=stale)
+        for st in r.inflight.values():
+            assert st["replica"] not in stale or n == 0
+
+    def test_no_survivor_means_no_reroute(self):
+        r, calls, _ = self._loaded()
+        assert r.replica_died("r0", exclude={"r1"}) == 0
+        assert r.tick_reroutes(stale={"r0", "r1"}) == 0
+        assert r.reroutes == 0
+
+    def test_max_reroutes_cap(self):
+        r, calls, _ = self._loaded(replicas=("r0", "r1", "r2"))
+        moved = 0
+        for _ in range(6):                      # ping-pong attempts
+            owners = {st["replica"] for st in r.inflight.values()}
+            n = 0
+            for o in sorted(owners):
+                n += r.replica_died(o)
+            moved += n
+            if n == 0:
+                break
+        assert all(st["reroutes"] <= Router.MAX_REROUTES
+                   for st in r.inflight.values())
+        assert moved <= 2 * Router.MAX_REROUTES
+
+    def test_ack_timeout_sweep_needs_age(self):
+        r, calls, clock = self._loaded(reroute_timeout_s=3.0)
+        assert r.tick_reroutes(stale={calls[0][0]}) == 0   # too fresh
+        clock.t = 5.0
+        assert r.tick_reroutes(stale={calls[0][0]}) >= 1
+
+
+# -- journal: torn tail, idempotent resume -----------------------------------
+
+class TestJournal:
+    def test_torn_tail_skipped(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = RouterJournal(path)
+        j.record("route", id="a", replica="r0")
+        j.record("ack", id="a")
+        j.close()
+        with open(path, "a") as f:
+            f.write('{"seq": 3, "kind": "route", "id": "b"')  # torn
+        recs = RouterJournal.replay(path)
+        assert [r["kind"] for r in recs] == ["route", "ack"]
+
+    def test_resume_is_idempotent(self, tmp_path):
+        tenants = _tenants(
+            burst=TenantConfig(name="burst", pclass="interactive",
+                               quota_tokens_per_s=1.0,
+                               quota_burst=10.0, slo_latency_s=2.0))
+        r1, calls1, _ = _router(tmp_path, tenants=tenants)
+        a, b = _req("inter-0000"), _req("inter-0001")
+        r1.offer(a)
+        r1.offer(b)
+        r1.dispatch()
+        owner = {rid: rep for rep, rid, _ in calls1}
+        r1.note_completed(["inter-0000"])
+        assert r1.offer(_req("burst-0000", n_tokens=10, new=4,
+                             tenant="burst")) == "rejected:quota"
+        # SIGKILL stand-in: journal abandoned unflushed-close, plus a
+        # torn trailing line
+        with open(os.path.join(str(tmp_path), ROUTER_JOURNAL),
+                  "a") as f:
+            f.write('{"kind": "route", "id": "torn-')
+
+        r2, calls2, _ = _router(tmp_path, tenants=tenants)
+        assert not calls2                       # resume NEVER re-submits
+        assert r2.resumed == 1
+        assert "inter-0000" in r2.acked
+        # routed-but-unacked stays with its replica
+        assert r2.inflight["inter-0001"]["replica"] == \
+            owner["inter-0001"]
+        # every prior decision is final
+        assert r2.offer(a) == "duplicate"
+        assert r2.offer(b) == "duplicate"
+        assert r2.offer(_req("burst-0000", n_tokens=10, new=4,
+                             tenant="burst")) == "duplicate"
+        # resumed entries carry no Request body: a replica death does
+        # NOT replay them from the router (the respawned replica's
+        # inbox re-read is their recovery path)
+        assert r2.replica_died(owner["inter-0001"]) == 0
+        # new traffic routes normally
+        assert r2.offer(_req("inter-0002")) == "admitted"
+        assert len(r2.dispatch()) == 1
+        assert len(calls2) == 1
+
+    def test_double_resume_stable(self, tmp_path):
+        r1, _, _ = _router(tmp_path)
+        r1.offer(_req("inter-0000"))
+        r1.dispatch()
+        r2, c2, _ = _router(tmp_path)
+        r3, c3, _ = _router(tmp_path)
+        assert r2.resumed == r3.resumed == 1
+        assert not c2 and not c3
+
+
+# -- per-tenant SLOs ---------------------------------------------------------
+
+class TestTenantSLOs:
+    def test_partition_by_stamp(self):
+        recs = [{"tenant": "a", "wall": 0.0},
+                {"tenant": "b", "wall": 1.0}, {"wall": 2.0}]
+        parts = partition_records(recs)
+        assert set(parts) == {"a", "b", "-"}
+
+    def test_one_tenants_overrun_cannot_fire_anothers(self):
+        fast = TenantConfig(name="fast", pclass="interactive",
+                            slo_latency_s=0.1)
+        slow = TenantConfig(name="slow", pclass="batch",
+                            slo_latency_s=10.0)
+        recs = []
+        for i in range(50):
+            recs.append({"tenant": "fast", "wall": float(i),
+                         "latency_s": 0.01, "ok": True})
+            recs.append({"tenant": "slow", "wall": float(i) + 0.5,
+                         "latency_s": 8.0, "ok": True})
+        out = evaluate_tenants(recs, (fast, slow), now=50.0)
+        assert not out["fast"]["fast/p99_latency"]["firing"]
+        assert not out["slow"]["slow/p99_latency"]["firing"]
+        # now the slow tenant blows ITS OWN budget; fast is untouched
+        recs2 = [dict(r, latency_s=20.0) if r["tenant"] == "slow"
+                 else r for r in recs]
+        out2 = evaluate_tenants(recs2, (fast, slow), now=50.0)
+        assert out2["slow"]["slow/p99_latency"]["firing"]
+        assert not out2["fast"]["fast/p99_latency"]["firing"]
+
+
+# -- seeded workload ---------------------------------------------------------
+
+class TestWorkload:
+    def test_deterministic_and_sessionful(self):
+        w1 = seeded_tenant_workload(7, duration_s=5.0)
+        w2 = seeded_tenant_workload(7, duration_s=5.0)
+        assert [(r.id, r.tokens) for r in w1] == \
+            [(r.id, r.tokens) for r in w2]
+        assert w1 != seeded_tenant_workload(8, duration_s=5.0)
+        # arrivals sorted; every request stamped
+        assert all(a.arrival_s <= b.arrival_s
+                   for a, b in zip(w1, w1[1:]))
+        assert all(r.tenant and r.pclass for r in w1)
+
+    def test_spike_only_boosts_interactive(self):
+        base = seeded_tenant_workload(3, duration_s=8.0)
+        spiked = seeded_tenant_workload(3, duration_s=8.0,
+                                        spike=(2.0, 5.0, 4.0))
+        def count(w, pclass):
+            return sum(1 for r in w if r.pclass == pclass)
+        assert count(spiked, "interactive") > count(base,
+                                                    "interactive")

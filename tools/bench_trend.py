@@ -217,6 +217,11 @@ def _serving_point(extra: dict) -> str:
         point += f"sp{extra['speculative_k']}"
     if extra.get("disagg"):
         point += "dg"
+    if extra.get("router"):
+        # routed multi-tenant rows (ISSUE 20) key one series PER
+        # PRIORITY CLASS — an interactive p99 must never regression-
+        # gate against a batch p99 measured in the same round
+        point += f"rt{(extra.get('pclass') or 'all')[:3]}"
     return point
 
 
@@ -254,7 +259,12 @@ def load_serving_history(repo: str = REPO) -> "dict[str, dict[int, dict]]":
             # serving-speed columns (ISSUE 14): hit/acceptance rates
             # gate NON-inverted; r01-era rows without them simply
             # don't extend the series
-            for rate in ("cache_hit_rate", "accepted_draft_rate"):
+            # router rows (ISSUE 20) add the affinity-vs-random uplift
+            # as a floor: session-affinity routing losing its measured
+            # cache advantage over random spraying is a regression even
+            # if raw throughput holds
+            for rate in ("cache_hit_rate", "accepted_draft_rate",
+                         "affinity_uplift"):
                 if isinstance(extra.get(rate), (int, float)):
                     series.setdefault(f"serving/{rate}/{pt}",
                                       {})[rnd] = {

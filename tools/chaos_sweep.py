@@ -147,6 +147,7 @@ Usage::
     python tools/chaos_sweep.py --kill --shrink --workers 3 --seeds 3
     python tools/chaos_sweep.py --serve --seeds 3     # serving sweep
     python tools/chaos_sweep.py --serve --disagg --seeds 3  # disagg
+    python tools/chaos_sweep.py --router --seeds 3    # multi-tenant router
     python tools/chaos_sweep.py --data --seeds 3      # input-worker sweep
     python tools/chaos_sweep.py --rollout --seeds 3   # live-rollout sweep
     python tools/chaos_sweep.py --offload --seeds 3   # activation-spill sweep
@@ -159,6 +160,7 @@ only). Exit code is non-zero if any seed fails (CI-friendly).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -849,6 +851,128 @@ def run_serve_seed(seed: int, *, workers: int, requests: int,
     return ok, dt
 
 
+def _router_summary_gates(summary: dict) -> "list[str]":
+    """The --router survival conditions over one run's
+    ``router-summary.json`` (examples/serve_router.py analyze):
+    zero dropped, byte-identical duplicates, no double-routing across
+    the router restart, affinity beating the same-chaos random
+    baseline, the interactive class re-meeting its SLO after the
+    outage drains, batch not starved past its own SLO, batch shed
+    first under pressure, the quota tenant rejected with the right
+    cause, and the goodput identity with the re-route cost priced."""
+    bad = []
+    if summary.get("dropped"):
+        bad.append(f"dropped requests: {summary['dropped']}")
+    if summary.get("duplicates_mismatched"):
+        bad.append(f"{summary['duplicates_mismatched']} duplicate "
+                   f"serve(s) were NOT byte-identical")
+    if summary.get("double_routes"):
+        bad.append(f"{summary['double_routes']} rid(s) double-ROUTED "
+                   f"(journal resume must never re-decide)")
+    if not (summary.get("affinity_hit_rate", 0.0)
+            > summary.get("random_hit_rate", 1.0)):
+        bad.append(
+            f"affinity hit rate {summary.get('affinity_hit_rate')} "
+            f"not above random {summary.get('random_hit_rate')}")
+    if not summary.get("interactive_recovered"):
+        bad.append(
+            f"interactive never re-met its SLO after the outage "
+            f"(window p99 {summary.get('interactive_recovery_p99_s')}s"
+            f", {summary.get('recovery_samples')})")
+    if summary.get("batch_starved_past_slo"):
+        bad.append(f"batch starved past its own SLO "
+                   f"(recovery p99 "
+                   f"{summary.get('batch_recovery_p99_s')}s)")
+    if not summary.get("sheds"):
+        bad.append("batch was never shed under pressure (priority "
+                   "classes did not engage)")
+    quota = {k: v for k, v
+             in (summary.get("rejects_by_tenant_cause") or {}).items()
+             if k.endswith("/quota")}
+    if not quota:
+        bad.append("the quota tenant's overrun was never rejected "
+                   "with cause=quota")
+    err = summary.get("identity_error_frac")
+    if err is None or err > 0.01:
+        bad.append(f"goodput identity violated ({err})")
+    if summary.get("reroutes") \
+            and summary.get("badput_reroute_replay_s", 0.0) <= 0.0:
+        bad.append("re-routes happened but no reroute_replay badput "
+                   "was priced")
+    if summary.get("badput_recovery_s", 0.0) <= 0.0:
+        bad.append("replica kill left no recovery badput (was the "
+                   "outage measured at all?)")
+    return bad
+
+
+def run_router_seed(seed: int, *, workers: int, keep_dirs: bool) \
+        -> tuple[bool, float]:
+    """One multi-tenant routed-serving run with a seed-derived replica
+    SIGKILL AND a seeded router SIGKILL mid-spike, plus the same-chaos
+    random-routing baseline phase (module docstring, ``--router``).
+    Survival = clean exit + router/recovery telemetry +
+    ``_router_summary_gates`` over the run's router-summary.json."""
+    run_dir = tempfile.mkdtemp(prefix=f"chaos_router_s{seed}_")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "serve_router.py"),
+           "--run-dir", run_dir, "--seed", str(seed),
+           "--workers", str(workers), "--kill-seed", str(seed)]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, cwd=REPO, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT)
+    ok = proc.returncode == 0
+    if not ok:
+        tail = proc.stdout.decode(errors="replace").splitlines()[-20:]
+        print(f"--- seed {seed} FAILED (rc={proc.returncode}) ---")
+        print("\n".join(tail))
+    if ok:
+        gate_cmd = [sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    os.path.join(run_dir, "affinity", "telemetry"),
+                    "--check",
+                    "--require", "router.route",
+                    "--require", "router.shed",
+                    "--require", "serve.reject",
+                    "--require", "serve.request",
+                    "--require", "recovery.restart",
+                    "--require", "recovery.run_complete"]
+        gate = subprocess.run(gate_cmd, cwd=REPO, env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if gate.returncode != 0:
+            ok = False
+            print(f"--- seed {seed}: run finished but telemetry gate "
+                  f"FAILED (rc={gate.returncode}) ---")
+            print(gate.stdout.decode(errors="replace").strip())
+    if ok:
+        with open(os.path.join(run_dir, "router-summary.json")) as f:
+            summary = json.load(f)
+        violations = _router_summary_gates(summary)
+        if violations:
+            ok = False
+            print(f"--- seed {seed}: router gates FAILED ---")
+            for v in violations:
+                print(f"    {v}")
+        else:
+            print(f"    seed {seed}: {summary['served_unique']} "
+                  f"served / 0 dropped, {summary['duplicates']} "
+                  f"byte-identical dup(s), "
+                  f"{summary['reroutes']} reroute(s), affinity "
+                  f"{summary['affinity_hit_rate']:.1%} vs random "
+                  f"{summary['random_hit_rate']:.1%}, recovery p99 "
+                  f"{summary['interactive_recovery_p99_s']}s")
+    dt = time.monotonic() - t0
+    if not keep_dirs and ok:
+        import shutil
+        shutil.rmtree(run_dir, ignore_errors=True)
+    elif not ok:
+        print(f"    (run dir kept for inspection: {run_dir})")
+    return ok, dt
+
+
 def _spike_gates(summary: dict,
                  goodput_floor: "float | None") -> "list[str]":
     """The --spike survival conditions over one run's recomputed
@@ -1293,6 +1417,16 @@ def main(argv=None) -> int:
                          "decode replica holding adopted blocks; adds "
                          "the allocator-conservation and kv_migrate-"
                          "pricing gates")
+    ap.add_argument("--router", action="store_true",
+                    help="sweep the multi-tenant routed-serving axis "
+                         "(examples/serve_router.py): per seed a "
+                         "replica SIGKILL mid-load AND a router "
+                         "SIGKILL mid-spike, with a same-chaos "
+                         "random-routing baseline; zero-dropped, "
+                         "byte-identical-duplicate, no-double-route, "
+                         "affinity>random, SLO-recovery, batch-"
+                         "no-starvation, quota-reject and priced-"
+                         "reroute gates")
     ap.add_argument("--spike", action="store_true",
                     help="sweep seeded traffic spikes through a shared "
                          "training+serving fleet: the autoscaler must "
@@ -1393,13 +1527,17 @@ def main(argv=None) -> int:
         ap.error("--shrink needs at least 2 workers to shrink from")
     if sum(bool(x) for x in (args.serve, args.kill, args.data,
                              args.spike, args.online, args.rollout,
-                             args.offload, args.day)) > 1:
+                             args.offload, args.day,
+                             args.router)) > 1:
         ap.error("--kill, --serve, --data, --spike, --online, "
-                 "--rollout, --offload and --day are separate sweep "
-                 "axes")
+                 "--rollout, --offload, --day and --router are "
+                 "separate sweep axes")
     results = []
     for s in range(args.base_seed, args.base_seed + args.seeds):
-        if args.day:
+        if args.router:
+            ok, dt = run_router_seed(s, workers=args.workers,
+                                     keep_dirs=args.keep_dirs)
+        elif args.day:
             ok, dt = run_day_seed(s, keep_dirs=args.keep_dirs,
                                   goodput_floor=args.goodput_floor)
         elif args.offload:

@@ -83,6 +83,28 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
             tv_audit.itemize_slos(tv_audit.day_records(events_by_pid),
                                   slos, slo_report, cause_ws)
 
+    # per-tenant SLO burn (ISSUE 20): tenant-stamped completions are
+    # ADDITIONALLY evaluated per tenant against its own burn windows —
+    # one tenant's overrun cannot fire another's verdict. Without the
+    # run's real TenantConfig to hand, interactive tenants inherit the
+    # report's latency threshold and batch tenants 10x it (the README
+    # priority-class split).
+    tenant_report = None
+    t_records = [r for r in (records or []) if r.get("tenant")]
+    if t_records:
+        from distributed_tensorflow_tpu.serving import tenancy as tn
+        seen: dict = {}
+        for r in t_records:
+            seen.setdefault(r["tenant"], r.get("pclass"))
+        cfgs = [tn.TenantConfig(
+                    name, pclass=(pc if pc in tn.PRIORITY_CLASSES
+                                  else "interactive"),
+                    slo_latency_s=(latency_s * 10 if pc == "batch"
+                                   else latency_s))
+                for name, pc in sorted(seen.items())]
+        tenant_report = tn.evaluate_tenants(t_records, cfgs,
+                                            windows=windows)
+
     # online freshness SLO (ISSUE 15): update->servable burn over the
     # evaluator's snapshot stamps. Folded into the same slo dict so
     # --slo-budget gates it identically; names never collide with the
@@ -152,7 +174,7 @@ def build_report(run_dir: str, *, latency_s: float = 0.5,
         }
 
     return {"ledger": ledger, "slo": slo_report, "stalls": stalls,
-            "online": online,
+            "tenants": tenant_report, "online": online,
             "scale": {"decisions": scale_decisions,
                       "applied": scale_applied},
             "live_scrape": live,
@@ -213,6 +235,18 @@ def render_text(report: dict) -> str:
                            f"{w['short_s']:g}s: burn {bl}/{bs} "
                            f"(max {w['max_burn']:g})"
                            + ("  FIRING" if w["firing"] else ""))
+    if report.get("tenants"):
+        out.append("per-tenant SLOs:")
+        for tenant, slos in sorted(report["tenants"].items()):
+            for name, res in slos.items():
+                state = "FIRING" if res["firing"] else "ok"
+                thr = (f" <= {res['threshold_s'] * 1e3:g}ms"
+                       if res["threshold_s"] else "")
+                out.append(f"  {name:<22} [{state}] objective "
+                           f"{res['objective']:.1%}{thr}  "
+                           f"{res['bad']}/{res['requests']} bad  "
+                           f"budget consumed "
+                           f"{res['budget_consumed']:.2f}x")
     on = report.get("online")
     if on:
         out.append(f"online: {on['snapshots']} snapshot(s) served, "

@@ -114,6 +114,14 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
     serve_cached_tokens = 0
     serve_drafts_proposed = 0
     serve_drafts_accepted = 0
+    # multi-tenant router (ISSUE 20): router.* + tenant-stamped serve.*
+    router_routes = collections.Counter()     # route reason -> n
+    router_reroutes = collections.Counter()   # reroute cause -> n
+    router_sheds = collections.Counter()      # tenant -> shed ticks
+    router_rejects = collections.Counter()    # "tenant/cause" -> n
+    router_tenants: dict = {}                 # tenant -> summary event
+    router_resumes = 0
+    class_latency: dict = {}                  # pclass -> [dur_s]
     # online streaming (ISSUE 15): stream.* / embed.* telemetry
     online_produced = 0            # newest produced offset
     online_produced_wall = None
@@ -184,6 +192,9 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 d = ev.get("dur_s")
                 if isinstance(d, (int, float)):
                     serve_latency.append(d)
+                    if ev.get("tenant"):
+                        class_latency.setdefault(
+                            ev.get("pclass") or "?", []).append(d)
                 nt = ev.get("new_tokens")
                 if isinstance(nt, (int, float)):
                     serve_tokens += int(nt)
@@ -202,6 +213,23 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                 ct = ev.get("cached_tokens")
                 if isinstance(ct, (int, float)):
                     serve_cached_tokens += int(ct)
+            elif name == "router.route":
+                router_routes[ev.get("reason") or "?"] += 1
+            elif name == "router.reroute":
+                router_reroutes[ev.get("cause") or "?"] += 1
+            elif name == "router.shed":
+                router_sheds[ev.get("tenant") or "?"] += 1
+            elif name == "serve.reject":
+                router_rejects[f"{ev.get('tenant') or '-'}"
+                               f"/{ev.get('cause') or '-'}"] += 1
+            elif name == "router.tenant":
+                router_tenants[ev.get("tenant") or "?"] = {
+                    k: ev.get(k) for k in
+                    ("pclass", "admitted", "rejected_quota",
+                     "rejected_total", "sheds", "tokens_admitted",
+                     "quota_utilization")}
+            elif name == "router.resume":
+                router_resumes += 1
             elif name == "stream.produced":
                 o = ev.get("offset")
                 if isinstance(o, (int, float)) and o >= online_produced:
@@ -376,6 +404,19 @@ def summarize(events_by_pid: "dict[int, list[dict]]") -> dict:
                                           / serve_drafts_proposed, 4)
                                     if serve_drafts_proposed else None),
         } if (serve_latency or serve_steps) else None,
+        "router": {
+            "routes": sum(router_routes.values()),
+            "route_reasons": dict(router_routes),
+            "reroutes": dict(router_reroutes),
+            "sheds": dict(router_sheds),
+            "rejects_by_tenant_cause": dict(router_rejects),
+            "resumes": router_resumes,
+            "tenants": router_tenants,
+            "class_latency": {pc: _percentiles(v)
+                              for pc, v in sorted(
+                                  class_latency.items())},
+        } if (router_routes or router_rejects
+              or router_tenants) else None,
         "online": {
             "events_produced": online_produced,
             "events_applied": online_events,
@@ -608,6 +649,45 @@ def render_text(report: dict, rollup: dict) -> str:
                        f"{sv['accepted_draft_rate']:.1%} "
                        f"({sv['drafts_accepted']}/"
                        f"{sv['drafts_proposed']} draft tokens)")
+    if report.get("router"):
+        rt = report["router"]
+        reasons = "  ".join(f"{k} {v}" for k, v in
+                            sorted(rt["route_reasons"].items()))
+        line = f"router: {rt['routes']} routed"
+        if reasons:
+            line += f" ({reasons})"
+        if rt["reroutes"]:
+            causes = "  ".join(f"{k} {v}" for k, v in
+                               sorted(rt["reroutes"].items()))
+            line += (f", {sum(rt['reroutes'].values())} "
+                     f"rerouted ({causes})")
+        if rt["resumes"]:
+            line += f", {rt['resumes']} journal resume(s)"
+        out.append(line)
+        for pc, lat in rt["class_latency"].items():
+            out.append(f"  {pc:<12} p50 {_fmt_ms(lat['p50'])}  "
+                       f"p95 {_fmt_ms(lat['p95'])}  "
+                       f"p99 {_fmt_ms(lat['p99'])}  "
+                       f"max {_fmt_ms(lat['max'])}  "
+                       f"({lat['count']} served)")
+        for name, t in sorted(rt["tenants"].items()):
+            qu = t.get("quota_utilization")
+            out.append(f"  tenant {name} ({t.get('pclass')}): "
+                       f"{t.get('admitted')} admitted "
+                       f"({t.get('tokens_admitted')} tokens), "
+                       f"{t.get('rejected_total')} rejected, "
+                       f"{t.get('sheds')} shed tick(s)"
+                       + (f", quota {qu:.1%} used"
+                          if isinstance(qu, (int, float)) else ""))
+        if rt["rejects_by_tenant_cause"]:
+            rej = "  ".join(
+                f"{k} {v}" for k, v in
+                sorted(rt["rejects_by_tenant_cause"].items()))
+            out.append(f"  rejects by tenant/cause: {rej}")
+        if rt["sheds"]:
+            sh = "  ".join(f"{k} {v}" for k, v in
+                           sorted(rt["sheds"].items()))
+            out.append(f"  shed ticks by tenant: {sh}")
     if report.get("online"):
         on = report["online"]
         out.append(f"online: {on['events_applied']} event(s) applied "

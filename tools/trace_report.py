@@ -247,6 +247,23 @@ def main(argv=None) -> int:
     mig_pairs = sum(
         1 for sid, dirs in _migrate_pairs(mig_spans).items()
         if "export" in dirs and "adopt" in dirs)
+    # router re-routes (ISSUE 20): every request already threads one
+    # req/<rid> flow chain (router.route -> serve.admit -> ... ->
+    # serve.request); a re-routed rid's chain ALSO crosses from the
+    # dead replica's spans to the survivor's — count those arrows
+    rr_rids = {ev.get("id") for evs in events_by_pid.values()
+               for ev in evs if ev.get("ev") == "router.reroute"}
+    rr_rids.discard(None)
+    rr_cross = 0
+    if rr_rids:
+        pids_by_rid: dict = {}
+        for pid, evs in events_by_pid.items():
+            for ev in evs:
+                rid = ev.get("id")
+                if rid in rr_rids and str(ev.get("ev", "")
+                                          ).startswith("serve."):
+                    pids_by_rid.setdefault(rid, set()).add(pid)
+        rr_cross = sum(1 for p in pids_by_rid.values() if len(p) >= 2)
     summary = {
         "trace": out_path,
         "processes": meta["processes"],
@@ -261,6 +278,8 @@ def main(argv=None) -> int:
         "day_spans": n_day,
         "kv_migrate_spans": len(mig_spans),
         "kv_migrate_pairs": mig_pairs,
+        "router_reroute_spans": len(rr_rids),
+        "router_reroute_cross_replica": rr_cross,
     }
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
@@ -287,6 +306,10 @@ def main(argv=None) -> int:
         if mig_spans:
             print(f"  kv.migrate: {len(mig_spans)} spans, "
                   f"{mig_pairs} export->adopt flow arrows")
+        if rr_rids:
+            print(f"  router: {len(rr_rids)} re-routed request "
+                  f"span(s), {rr_cross} crossing replicas "
+                  f"(req/<rid> flow arrows)")
         print("  open at https://ui.perfetto.dev or chrome://tracing")
 
     if args.check:
